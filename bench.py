@@ -1,0 +1,59 @@
+"""Benchmark driver entry. Prints ONE JSON line.
+
+Round-1 headline: LeNet/MNIST dygraph Model.fit images/sec/chip
+(BASELINE.md config 1) via the compiled-train-step path. vs_baseline is
+reported as 0.0 while the reference publishes no in-repo numbers
+(BASELINE.md: "published: {}")."""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ.setdefault("PADDLE_TPU_SYNTH_SAMPLES", "8192")
+
+import numpy as np
+
+
+def bench_lenet_fit():
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.vision.datasets import MNIST
+
+    paddle.seed(0)
+    batch_size = 256
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-3)
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())
+    train = MNIST(mode="train")
+
+    x = np.stack([train[i][0] for i in range(batch_size)]).astype(np.float32)
+    y = np.asarray([train[i][1] for i in range(batch_size)], np.int64)
+
+    # warmup: compile the fused train step
+    model.train_batch([x], [y])
+    model.train_batch([x], [y])
+
+    n_steps = 50
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        model.train_batch([x], [y])
+    # train_batch returns host loss (blocks), so timing is accurate
+    dt = time.perf_counter() - t0
+    ips = n_steps * batch_size / dt
+    return ips
+
+
+def main():
+    ips = bench_lenet_fit()
+    print(json.dumps({
+        "metric": "lenet_mnist_dygraph_fit_images_per_sec_per_chip",
+        "value": round(float(ips), 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Brand-new implementation (JAX/XLA/Pallas/pjit compute path) providing the
+capabilities of the reference PaddlePaddle snapshot surveyed in SURVEY.md.
+The top-level namespace mirrors the reference's `paddle` package so user
+code ports by changing the import."""
+from __future__ import annotations
+
+import jax as _jax
+
+# int64 is the reference's default index/label dtype; enable 64-bit types
+# so the API surface matches (floats stay explicitly float32/bfloat16 —
+# TPU-first code never emits f64 unless the user asks).
+_jax.config.update("jax_enable_x64", True)
+
+# dtypes
+from .framework.dtype import (bool_ as bool, uint8, int8, int16, int32,  # noqa: A004
+                              int64, float16, bfloat16, float32, float64,
+                              complex64, complex128, DType as dtype,
+                              set_default_dtype, get_default_dtype)
+# places & device
+from .framework.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace,
+                              TPUPlace, XPUPlace, get_device, set_device,
+                              is_compiled_with_cuda, is_compiled_with_rocm,
+                              is_compiled_with_npu, is_compiled_with_xpu)
+# tensor + modes
+from .framework.tensor import Tensor, to_tensor
+from .framework.tensor import Parameter  # noqa: F401
+from .framework.state import no_grad, in_dygraph_mode
+from .framework.random import seed, get_rng_state, set_rng_state
+from .framework.flags import get_flags, set_flags
+from .framework import state as _state
+
+# the whole tensor-op surface lives at top level (reference exposes
+# paddle.add, paddle.matmul, ... at package root)
+from .tensor import *  # noqa: F401,F403
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import framework  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import jit  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from . import distributed  # noqa: F401
+from . import device  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def enable_static():
+    _state.STATE.static_mode = True
+
+
+def disable_static():
+    _state.STATE.static_mode = False
+
+
+def is_grad_enabled():
+    return _state.STATE.grad_enabled
+
+
+def set_grad_enabled(mode):
+    class _Guard:
+        def __init__(self, prev):
+            self._prev = prev
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            _state.STATE.grad_enabled = self._prev
+            return False
+
+    prev = _state.STATE.grad_enabled
+    _state.STATE.grad_enabled = bool(mode)
+    return _Guard(prev)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    from .framework.autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs, retain_graph, create_graph,
+                 only_inputs, allow_unused, no_grad_vars)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parameter-count summary (reference: hapi/model_summary.py)."""
+    total = 0
+    trainable = 0
+    for _, p in net.named_parameters():
+        n = p.size
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    print(f"Non-trainable params: {total - trainable}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,201 @@
+"""Automatic mixed precision.
+
+TPU-native equivalent of the reference's AMP stack
+(/root/reference/python/paddle/amp/auto_cast.py:21,
+amp/grad_scaler.py:26-243, C++ imperative/amp_auto_cast.cc, ops
+operators/amp/check_finite_and_unscale_op and update_loss_scaling_op).
+
+On TPU the mixed dtype is bfloat16 (MXU-native, same exponent range as
+fp32) so loss scaling is mathematically unnecessary — but the GradScaler
+API and its loss-scaling state machine are implemented for parity and for
+float16 use. O1 = per-op white/black list casting (hooked into dispatch);
+O2 = parameters cast to bf16, master weights kept by the optimizer
+(our optimizers already keep fp32 accumulators/master math)."""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import state
+from ..framework.tensor import Tensor
+from ..framework.dtype import to_np
+
+__all__ = ["auto_cast", "decorate", "GradScaler", "AmpState",
+           "WHITE_LIST", "BLACK_LIST"]
+
+# reference lists: python/paddle/fluid/dygraph/amp/auto_cast.py:33,44
+WHITE_LIST = {
+    "matmul_v2", "mul", "conv2d_op", "conv2d_transpose_op", "bmm", "mv",
+    "addmm", "einsum_op", "dot", "fused_attention", "flash_attention",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "reduce_mean", "reduce_sum",
+    "softmax_op", "log_softmax_op", "softmax_with_cross_entropy",
+    "bce_loss_op", "bce_with_logits_op", "layer_norm_op", "p_norm",
+    "frobenius_norm", "cumsum", "logsumexp", "reduce_prod", "kldiv_loss_op",
+    "nll_loss_op", "square_error_cost_op",
+}
+
+
+class AmpState:
+    def __init__(self, enable=True, level="O1", dtype="bfloat16",
+                 custom_white_list=None, custom_black_list=None):
+        self.enable = enable
+        self.level = level
+        self.dtype = dtype
+        self.white = set(WHITE_LIST)
+        self.black = set(BLACK_LIST)
+        if custom_white_list:
+            self.white |= set(custom_white_list)
+            self.black -= set(custom_white_list)
+        if custom_black_list:
+            self.black |= set(custom_black_list)
+            self.white -= set(custom_black_list)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """reference: paddle.amp.auto_cast (amp/auto_cast.py:21)."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level must be O0/O1/O2, got {level}")
+    prev = state.STATE.amp_state
+    state.STATE.amp_state = AmpState(
+        enable and level != "O0", level, dtype,
+        custom_white_list, custom_black_list) if enable else None
+    try:
+        yield
+    finally:
+        state.STATE.amp_state = prev
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the compute dtype (reference:
+    amp/auto_cast.py amp_decorate). Optimizer master math stays fp32 via
+    optimizer accumulators."""
+    from ..nn.layer_base import Layer
+
+    single = isinstance(models, Layer)
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Loss-scaling state machine (reference: amp/grad_scaler.py:26 over
+    fluid/dygraph/amp/loss_scaler.py:40 and the
+    check_finite_and_unscale/update_loss_scaling kernels)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._parameter_list or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p._grad is None:
+                continue
+            g = p._grad._data
+            if not bool(jnp.all(jnp.isfinite(g))):
+                found = True
+            p._grad._data = g * inv
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+def amp_cast_inputs(op_name: str, arrays):
+    """Called from dispatch when an AmpState is active: O1 white/black-list
+    input casting (reference: imperative/amp_auto_cast.cc)."""
+    amp = state.STATE.amp_state
+    if amp is None or not amp.enable:
+        return arrays
+    target = to_np(amp.dtype)
+    if op_name in amp.white:
+        return [a.astype(target)
+                if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+                and a.dtype != target else a
+                for a in arrays]
+    if op_name in amp.black:
+        f32 = np.float32
+        return [a.astype(f32)
+                if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16)
+                else a
+                for a in arrays]
+    return arrays

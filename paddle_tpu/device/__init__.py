@@ -1,0 +1,17 @@
+"""paddle.device parity (reference: python/paddle/device/__init__.py)."""
+from ..framework.place import (get_device, set_device, is_compiled_with_cuda,
+                               is_compiled_with_npu, is_compiled_with_rocm,
+                               is_compiled_with_xpu, is_compiled_with_tpu)
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_device_count(device_type=None):
+    import jax
+    try:
+        return len(jax.devices(device_type)) if device_type else len(jax.devices())
+    except RuntimeError:
+        return 0

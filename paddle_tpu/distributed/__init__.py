@@ -1,0 +1,13 @@
+"""Distributed package — phase-5 per SURVEY §7. This module grows into the
+Fleet-equivalent; for now it provides env/rank facts used by samplers."""
+from __future__ import annotations
+
+import os
+
+
+def get_rank(group=None):
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def get_world_size(group=None):
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))

@@ -1,0 +1,8 @@
+from .dtype import (DType, convert_dtype, get_default_dtype,
+                    set_default_dtype)
+from .place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace, Place,
+                    TPUPlace, XPUPlace, get_device, set_device)
+from .tensor import Parameter, Tensor, to_tensor
+from .state import in_dygraph_mode, in_static_mode, no_grad
+from .random import seed, get_rng_state, set_rng_state
+from .flags import get_flags, set_flags

@@ -1,0 +1,228 @@
+"""Eager op dispatch + single kernel registry.
+
+TPU-native replacement for the reference's dual fluid/pten kernel dispatch
+(/root/reference/paddle/fluid/framework/operator.cc:1083-1186 and
+paddle/fluid/imperative/prepared_operator.cc:228-449). There is ONE registry
+from day 1 (the reference's pten migration endpoint, SURVEY §2.1): every op is
+a pure jax-level function; dispatch
+
+  * unwraps Tensor args to jax arrays,
+  * runs the op through a cached per-op XLA executable (the analogue of the
+    reference's kernel cache — compile once per (op, attrs, avals)),
+  * wraps outputs in Tensors,
+  * records a tape node for autograd when any input requires grad
+    (reference: Tracer::TraceOp + CreateGradOpNode, imperative/tracer.cc:146).
+
+Under an outer trace (to_static / pjit / shard_map) ops call straight into the
+jax function so the whole program fuses into one XLA module.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import state
+from .flags import flag
+from .dtype import DType
+
+# ---------------------------------------------------------------------------
+# registry
+
+OPS: Dict[str, "Primitive"] = {}
+
+_seq_counter = [0]
+
+
+def _next_seq() -> int:
+    _seq_counter[0] += 1
+    return _seq_counter[0]
+
+
+class TapeNode:
+    """One recorded eager op (reference: GradOpNode, imperative/layer.h)."""
+
+    __slots__ = ("name", "fn", "attr_key", "in_arrays", "in_tensors",
+                 "out_refs", "out_avals", "need_mask", "seq")
+
+    def __init__(self, name, fn, attr_key, in_arrays, in_tensors,
+                 out_refs, out_avals, need_mask, seq):
+        self.name = name
+        self.fn = fn
+        self.attr_key = attr_key
+        self.in_arrays = in_arrays      # primal arrays (residuals for vjp)
+        self.in_tensors = in_tensors    # Tensor refs (for grad routing)
+        self.out_refs = out_refs        # weakrefs to output Tensors
+        self.out_avals = out_avals      # (shape, np_dtype) per output
+        self.need_mask = need_mask      # which inputs need grad
+        self.seq = seq
+
+
+def _hashable(v) -> bool:
+    try:
+        hash(v)
+        return True
+    except TypeError:
+        return False
+
+
+def _attr_key(attrs: dict) -> Tuple:
+    items = []
+    for k in sorted(attrs):
+        v = attrs[k]
+        if isinstance(v, list):
+            v = tuple(v)
+        if isinstance(v, DType):
+            v = v.name
+        if not _hashable(v):
+            return None  # dynamic attr → no jit cache
+        items.append((k, v))
+    return tuple(items)
+
+
+@functools.lru_cache(maxsize=8192)
+def _fwd_exec(fn: Callable, attr_key: Tuple) -> Callable:
+    attrs = dict(attr_key)
+    return jax.jit(lambda *arrays: fn(*arrays, **attrs))
+
+
+@functools.lru_cache(maxsize=8192)
+def _bwd_exec(fn: Callable, attr_key: Tuple, need_mask: Tuple[bool, ...],
+              out_float_mask: Tuple[bool, ...]) -> Callable:
+    """Jitted vjp: recomputes the forward inside the backward executable
+    (XLA DCEs what is unneeded; this is the remat-style tradeoff that keeps
+    eager memory low — primals are the only residuals we retain)."""
+    attrs = dict(attr_key)
+
+    def f_float(*arrays):
+        outs = fn(*arrays, **attrs)
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        return tuple(o for o, m in zip(outs, out_float_mask) if m)
+
+    def bwd(primals, cts):
+        _, vjp_fn = jax.vjp(f_float, *primals)
+        grads = vjp_fn(tuple(cts))
+        return tuple(g for g, m in zip(grads, need_mask) if m)
+
+    return jax.jit(bwd)
+
+
+def _is_float(dt) -> bool:
+    return jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating)
+
+
+class Primitive:
+    """A registered op: Tensor-level callable over a pure jax function."""
+
+    __slots__ = ("name", "fn", "nondiff", "dynamic")
+
+    def __init__(self, name: str, fn: Callable, nondiff: bool = False,
+                 dynamic: bool = False):
+        self.name = name
+        self.fn = fn
+        self.nondiff = nondiff
+        self.dynamic = dynamic  # dynamic output shape: never jit-cache
+        OPS[name] = self
+
+    def __call__(self, *args, **attrs):
+        from .tensor import Tensor
+        from .autograd import GLOBAL_TAPE
+
+        # --- static-graph staging -----------------------------------------
+        if state.in_static_mode() and not state.in_trace():
+            from ..static.program import stage_op
+            staged = stage_op(self, args, attrs)
+            if staged is not NotImplemented:
+                return staged
+
+        # --- unwrap ---------------------------------------------------------
+        arrays = []
+        in_tensors = []
+        requires = []
+        for a in args:
+            if isinstance(a, Tensor):
+                arrays.append(a._data)
+                in_tensors.append(a)
+                requires.append(not a.stop_gradient)
+            else:
+                arrays.append(a)
+                in_tensors.append(None)
+                requires.append(False)
+
+        # --- AMP O1 input casting (reference: imperative/amp_auto_cast.cc,
+        # tracer.cc:180-187) --------------------------------------------------
+        if state.STATE.amp_state is not None:
+            from ..amp import amp_cast_inputs
+            arrays = amp_cast_inputs(self.name, arrays)
+
+        # --- execute --------------------------------------------------------
+        key = _attr_key(attrs)
+        traced = state.in_trace() or any(
+            isinstance(x, jax.core.Tracer) for x in arrays)
+        if traced or key is None or self.dynamic or not flag("eager_op_jit"):
+            outs = self.fn(*arrays, **attrs)
+        else:
+            outs = _fwd_exec(self.fn, key)(*arrays)
+
+        single = not isinstance(outs, tuple)
+        outs_t = (outs,) if single else outs
+
+        # --- wrap -----------------------------------------------------------
+        record = (state.grad_enabled() and not self.nondiff and any(requires))
+        out_tensors = tuple(
+            Tensor(o, stop_gradient=not record, _internal=True) for o in outs_t)
+
+        # --- tape -----------------------------------------------------------
+        if record:
+            import weakref
+            node = TapeNode(
+                name=self.name, fn=self.fn,
+                attr_key=key if key is not None else tuple(sorted(attrs.items(), key=lambda kv: kv[0])) if all(_hashable(v) for v in attrs.values()) else None,
+                in_arrays=tuple(arrays),
+                in_tensors=tuple(in_tensors),
+                out_refs=tuple(weakref.ref(t) for t in out_tensors),
+                out_avals=tuple((tuple(o.shape), o.dtype) for o in outs_t),
+                need_mask=tuple(requires),
+                seq=_next_seq(),
+            )
+            if node.attr_key is None:
+                # dynamic attrs: stash the raw dict for a non-jitted vjp
+                node.attr_key = ("__raw__", tuple(attrs.items()))
+            for t in out_tensors:
+                t._node = node
+            GLOBAL_TAPE.append(node)
+
+        if flag("benchmark") or flag("check_nan_inf"):
+            for t in out_tensors:
+                if not isinstance(t._data, jax.core.Tracer):
+                    t._data.block_until_ready()
+                    if flag("check_nan_inf") and _is_float(t._data.dtype):
+                        if not bool(jnp.all(jnp.isfinite(t._data))):
+                            raise FloatingPointError(
+                                f"op {self.name} produced non-finite values "
+                                f"(FLAGS_check_nan_inf)")
+
+        return out_tensors[0] if single else out_tensors
+
+
+def primitive(name: str, nondiff: bool = False, dynamic: bool = False):
+    """Decorator registering a pure jax function as a framework op."""
+
+    def deco(fn):
+        prim = Primitive(name, fn, nondiff=nondiff, dynamic=dynamic)
+        functools.update_wrapper(prim.__call__.__func__, fn, updated=())
+        return prim
+
+    return deco
+
+
+def raw(x):
+    """Tensor-or-array → jax array (helper for op implementations)."""
+    from .tensor import Tensor
+    if isinstance(x, Tensor):
+        return x._data
+    return x

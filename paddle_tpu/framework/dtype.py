@@ -1,0 +1,124 @@
+"""Dtype system.
+
+TPU-native equivalent of the reference's VarType/proto dtypes
+(/root/reference/paddle/fluid/framework/framework.proto:97-127) and the
+pten DataType enum. One dtype domain backed by numpy/jax dtypes; bfloat16 is
+first-class (TPU MXU native), float64 is supported but discouraged on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import ml_dtypes  # noqa: F401  (bundled with jax)
+    _BF16 = np.dtype("bfloat16")
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+
+class DType:
+    """A framework dtype: thin, hashable wrapper around a numpy dtype.
+
+    Compares equal to its string name and to the underlying numpy dtype, so
+    ``x.dtype == 'float32'`` and ``x.dtype == paddle_tpu.float32`` both work
+    (API parity with the reference's ``paddle.float32`` objects).
+    """
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype) if np_dtype is not None else None
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or str(self.np_dtype) == other
+        try:
+            return np.dtype(other) == self.np_dtype
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        r = self.__eq__(other)
+        return r if r is NotImplemented else not r
+
+    @property
+    def is_floating(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def is_integer(self):
+        return self.name in ("int8", "uint8", "int16", "int32", "int64")
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _BF16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+        float32, float64, complex64, complex128]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_BY_NAME["float"] = float32
+_BY_NAME["double"] = float64
+_BY_NAME["int"] = int32
+_BY_NAME["long"] = int64
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize str / numpy dtype / DType / jax dtype to a framework DType."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _BY_NAME:
+            return _BY_NAME[dtype]
+        raise ValueError(f"unknown dtype name: {dtype!r}")
+    name = str(np.dtype(dtype))
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+def to_np(dtype):
+    """DType-or-anything → numpy dtype usable by jax."""
+    return convert_dtype(dtype).np_dtype
+
+
+# Default dtype machinery (reference: paddle.set_default_dtype,
+# python/paddle/framework/framework.py in the reference).
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if not d.is_floating:
+        raise TypeError("default dtype must be floating point, got %s" % d)
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name

@@ -1,0 +1,70 @@
+"""Global flag registry.
+
+TPU-native equivalent of the reference's gflags surface
+(/root/reference/paddle/fluid/platform/flags.cc:48- and python get/set at
+/root/reference/python/paddle/fluid/framework.py:6461,6485). Flags are plain
+typed python values seeded from FLAGS_* environment variables at import.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_FLAGS: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    env = os.environ.get("FLAGS_" + name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes", "on")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _FLAGS[name] = value
+    return value
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f[6:] if f.startswith("FLAGS_") else f
+        if key not in _FLAGS:
+            raise ValueError(f"unknown flag {f!r}")
+        out[f] = _FLAGS[key]
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    for f, v in flags.items():
+        key = f[6:] if f.startswith("FLAGS_") else f
+        if key not in _FLAGS:
+            raise ValueError(f"unknown flag {f!r}")
+        _FLAGS[key] = v
+
+
+def flag(name: str):
+    return _FLAGS[name]
+
+
+# Core flags (subset of the reference's ~51 exported gflags that are
+# meaningful on TPU; stream/cudnn/allocator flags have no XLA analogue).
+define_flag("check_nan_inf", False,
+            "after each eager op, sync and abort on non-finite outputs "
+            "(reference: FLAGS_check_nan_inf, operator.cc:1222)")
+define_flag("benchmark", False,
+            "block on every eager op result (reference: FLAGS_benchmark)")
+define_flag("eager_op_jit", True,
+            "compile+cache each eager op as its own XLA executable; "
+            "False falls back to op-by-op dispatch without jit")
+define_flag("seed", 0, "global random seed when nonzero")
+define_flag("allocator_strategy", "xla",
+            "accepted for parity; XLA/PJRT owns device memory")
+define_flag("tpu_matmul_precision", "default",
+            "jax matmul precision: default|high|highest")

@@ -1,0 +1,52 @@
+"""Global RNG.
+
+TPU-native equivalent of the reference's per-device Generator
+(/root/reference/paddle/fluid/framework/generator.h, python `paddle.seed` in
+python/paddle/framework/random.py). Randomness is functional (jax PRNG keys):
+a process-global key splits once per random op. Under a trace (to_static /
+compiled train step), the key is swapped for a traced input by the tracing
+wrapper so every execution of the compiled program draws fresh randomness —
+the TPU replacement for the reference's stateful curand generators.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+class GlobalRNG:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self.key = jax.random.PRNGKey(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self.key = jax.random.PRNGKey(self._seed)
+
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def state(self):
+        return self.key
+
+    def set_state(self, key):
+        self.key = key
+
+
+RNG = GlobalRNG(0)
+
+
+def seed(s: int):
+    """paddle.seed parity."""
+    RNG.manual_seed(int(s))
+    np.random.seed(int(s) % (2**32))
+    return RNG
+
+
+def get_rng_state():
+    return RNG.state()
+
+
+def set_rng_state(state):
+    RNG.set_state(state)

@@ -1,0 +1,92 @@
+"""Global execution-mode state.
+
+TPU-native equivalent of the reference's dygraph/static mode switch
+(/root/reference/python/paddle/fluid/framework.py `_dygraph_tracer` /
+`in_dygraph_mode`) and the tracer's `has_grad` gate
+(/root/reference/paddle/fluid/imperative/tracer.cc:146). One process-wide
+state object; thread-locality is not needed for the v1 engine.
+"""
+from __future__ import annotations
+
+import contextlib
+
+
+class _State:
+    def __init__(self):
+        self.static_mode = False      # paddle.enable_static()
+        self.grad_enabled = True      # paddle.no_grad()
+        self.trace_depth = 0          # >0 while tracing under to_static/pjit
+        self.amp_state = None         # set by paddle_tpu.amp.auto_cast
+        self.static_program = None    # current default Program in static mode
+        self.retain_grads = False
+
+
+STATE = _State()
+
+
+def in_dygraph_mode() -> bool:
+    return not STATE.static_mode
+
+
+def in_static_mode() -> bool:
+    return STATE.static_mode
+
+
+def in_trace() -> bool:
+    return STATE.trace_depth > 0
+
+
+def grad_enabled() -> bool:
+    return STATE.grad_enabled and not STATE.static_mode
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    prev = STATE.grad_enabled
+    STATE.grad_enabled = False
+    try:
+        yield
+    finally:
+        STATE.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad_guard():
+    prev = STATE.grad_enabled
+    STATE.grad_enabled = True
+    try:
+        yield
+    finally:
+        STATE.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def trace_guard():
+    STATE.trace_depth += 1
+    try:
+        yield
+    finally:
+        STATE.trace_depth -= 1
+
+
+class no_grad:
+    """paddle.no_grad: usable as decorator and context manager."""
+
+    def __enter__(self):
+        self._prev = STATE.grad_enabled
+        STATE.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        STATE.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper

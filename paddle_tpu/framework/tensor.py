@@ -1,0 +1,268 @@
+"""The eager Tensor.
+
+TPU-native equivalent of the reference's VarBase/DenseTensor pair
+(/root/reference/paddle/fluid/imperative/layer.h:66,
+/root/reference/paddle/pten/core/dense_tensor.h:29). A Tensor wraps one
+jax.Array (device memory owned by PJRT) — or a jax Tracer while the enclosing
+program is being staged to XLA, which is how the same dygraph code compiles
+whole-program under to_static/pjit. LoD (ragged) metadata is intentionally
+absent: sequence workloads use dense tensors + masks/segment ids (SURVEY §7).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import state
+from .dtype import DType, convert_dtype, get_default_dtype, to_np
+from .place import Place, get_place
+
+_uid_counter = [0]
+
+
+def _next_uid():
+    _uid_counter[0] += 1
+    return _uid_counter[0]
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "_grad", "_node", "name",
+                 "persistable", "trainable", "_uid", "_backward_hooks",
+                 "__weakref__", "__dict__")
+
+    def __init__(self, data, dtype=None, place: Optional[Place] = None,
+                 stop_gradient: bool = True, name: str = None,
+                 _internal: bool = False):
+        if _internal:
+            self._data = data
+        else:
+            self._data = _to_array(data, dtype, place)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self.name = name or f"tensor_{_next_uid()}"
+        self.persistable = False
+        self.trainable = True
+        self._uid = _next_uid()
+        self._backward_hooks = None
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self) -> DType:
+        return convert_dtype(str(self._data.dtype))
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    def dim(self) -> int:
+        return self._data.ndim
+
+    def rank(self):
+        return self._data.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    def numel(self) -> int:
+        return self.size
+
+    @property
+    def place(self) -> Place:
+        return get_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    # -- grad --------------------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = value
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._data), _internal=True)
+        else:
+            self._grad = None
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from .autograd import backward as _backward
+        _backward(self, grad_tensor=grad_tensor, retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        """Hook fired on this tensor's gradient during backward
+        (reference: VarBase grad hooks, imperative/hooks.h)."""
+        if self._backward_hooks is None:
+            self._backward_hooks = []
+        self._backward_hooks.append(hook)
+
+        class _Removable:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Removable(self._backward_hooks, hook)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, _internal=True)
+        return t
+
+    def clone(self) -> "Tensor":
+        from ..tensor.math import _identity
+        return _identity(self)
+
+    # -- host interop ------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- dtype/device moves ------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from ..tensor.manipulation import cast
+        return cast(self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient, _internal=True)
+
+    def cuda(self, *a, **k) -> "Tensor":
+        return Tensor(jax.device_put(self._data, get_place().jax_device()),
+                      stop_gradient=self.stop_gradient, _internal=True)
+
+    def tpu(self) -> "Tensor":
+        return self.cuda()
+
+    def pin_memory(self):
+        return self
+
+    # -- in-place (optimizer/update paths; grad does not flow through) -----
+    def set_value(self, value):
+        self._data = _to_array(value, self.dtype, None)
+        return self
+
+    def copy_(self, other, *a):
+        self._data = other._data if isinstance(other, Tensor) else _to_array(other, None, None)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def scale_(self, scale=1.0, bias=0.0):
+        self._data = self._data * scale + bias
+        return self
+
+    # -- python protocol ---------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        prefix = "Tensor(shape={}, dtype={}, stop_gradient={},\n       ".format(
+            self.shape, self.dtype.name, self.stop_gradient)
+        if isinstance(self._data, jax.core.Tracer):
+            return prefix + repr(self._data) + ")"
+        return prefix + np.array2string(self.numpy(), prefix="       ") + ")"
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __format__(self, spec):
+        if isinstance(self._data, jax.core.Tracer) or self.ndim > 0:
+            return repr(self)
+        return format(self.numpy().item(), spec)
+
+    # NOTE: arithmetic/compare/indexing dunders are attached by
+    # paddle_tpu.tensor.__init__ (monkey-patch pattern mirroring the
+    # reference's varbase_patch_methods.py).
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: ParamBase, fluid/framework.py:5600)."""
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _to_array(data, dtype, place):
+    """Anything → jax array on the current device."""
+    if isinstance(data, Tensor):
+        data = data._data
+    if isinstance(data, (jax.Array,)) or isinstance(data, jax.core.Tracer):
+        arr = data
+        if dtype is not None:
+            arr = arr.astype(to_np(dtype))
+        return arr
+    np_dtype = to_np(dtype) if dtype is not None else None
+    a = np.asarray(data, dtype=np_dtype)
+    if np_dtype is None and a.dtype == np.float64:
+        # default float dtype (reference defaults float32; float64 is an
+        # explicit opt-in — also what TPUs want)
+        a = a.astype(to_np(get_default_dtype()))
+    device = (place or get_place()).jax_device()
+    return jax.device_put(a, device)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor parity."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,270 @@
+"""paddle.Model high-level API (reference:
+/root/reference/python/paddle/hapi/model.py:906 — prepare/fit/evaluate/
+predict/save/load with callbacks). One adapter (dygraph) since eager code
+also traces to XLA; `prepare(..., jit=True)` (default) compiles the whole
+train step — the TPU replacement for the reference's static-graph adapter."""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+from ..framework import io as fio
+from ..framework import state
+from ..framework.autograd import reset_tape
+from ..framework.tensor import Tensor
+from ..io import DataLoader
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
+
+__all__ = ["Model"]
+
+
+class _InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+
+InputSpec = _InputSpec
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._train_step_fn = None
+        self._use_jit = True
+
+    # -- prepare -----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, jit=True,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        self._use_jit = jit
+        self._train_step_fn = None
+        return self
+
+    # -- single-batch APIs -------------------------------------------------
+    def _to_tensors(self, data):
+        if isinstance(data, (list, tuple)):
+            return [d if isinstance(d, Tensor) else Tensor(np.asarray(d))
+                    for d in data]
+        return [data if isinstance(data, Tensor) else Tensor(np.asarray(data))]
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            raise RuntimeError("call prepare(loss=...) first")
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        loss = self._loss(*outs, *labels)
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = self._to_tensors(inputs)
+        labels = self._to_tensors(labels) if labels is not None else []
+        if self._use_jit:
+            return self._jit_train_batch(inputs, labels, update)
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._run_metrics(outputs, labels)
+        return self._pack(loss, metrics)
+
+    def _jit_train_batch(self, inputs, labels, update=True):
+        """Whole-train-step XLA compilation via the jit engine."""
+        if self._train_step_fn is None:
+            from ..jit.engine import make_train_step
+            self._train_step_fn = make_train_step(
+                self.network, self._loss, self._optimizer)
+        loss, outputs = self._train_step_fn(inputs, labels)
+        metrics = self._run_metrics(outputs, labels)
+        return self._pack(loss, metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = self._to_tensors(inputs)
+        labels = self._to_tensors(labels) if labels is not None else []
+        with state.no_grad_guard():
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+        metrics = self._run_metrics(outputs, labels)
+        return self._pack(loss, metrics)
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = self._to_tensors(inputs)
+        with state.no_grad_guard():
+            outputs = self.network(*inputs)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    def _run_metrics(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        results = {}
+        for m in self._metrics:
+            r = m.compute(*outs, *labels)
+            r = m.update(r) if not isinstance(r, (list, tuple)) else m.update(*r)
+            name = m.name()
+            results[name if isinstance(name, str) else name[0]] = r
+        return results
+
+    def _pack(self, loss, metrics):
+        loss_v = float(loss.numpy()) if isinstance(loss, Tensor) else loss
+        logs = {"loss": loss_v}
+        logs.update(metrics)
+        return logs
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False, False,
+                                      num_workers) if eval_data is not None else None
+
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)]
+        if save_dir:
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        if callbacks:
+            cbks += list(callbacks)
+        cbk = CallbackList(cbks)
+        cbk.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbk.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
+
+        self.stop_training = False
+        cbk.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            cbk.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbk.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                logs = self.train_batch(inputs, labels)
+                cbk.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            # epoch metrics
+            for m in self._metrics:
+                name = m.name()
+                logs[name if isinstance(name, str) else name[0]] = m.accumulate()
+            cbk.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbk)
+            if self.stop_training or (num_iters is not None and it_count >= num_iters):
+                break
+        cbk.on_train_end()
+        reset_tape()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        cbk = CallbackList([ProgBarLogger(log_freq, verbose=verbose)] +
+                           (list(callbacks) if callbacks else []))
+        cbk.set_model(self)
+        cbk.set_params({"verbose": verbose})
+        return self._run_eval(loader, cbk)
+
+    def _run_eval(self, loader, cbk):
+        cbk.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            cbk.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            logs = self.eval_batch(inputs, labels)
+            losses.append(logs["loss"])
+            cbk.on_eval_batch_end(step, logs)
+        result = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            name = m.name()
+            result[name if isinstance(name, str) else name[0]] = m.accumulate()
+        cbk.on_eval_end(result)
+        reset_tape()
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, has_label=False)
+            outputs.append(self.predict_batch(inputs))
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        grouped = [[o[i] for o in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g) for g in grouped]
+        return grouped
+
+    def _split_batch(self, batch, has_label=True):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2 and has_label:
+                n_label = len(self._labels) if self._labels else 1
+                inputs = list(batch[:-n_label])
+                labels = list(batch[-n_label:])
+                return inputs, labels
+            return list(batch), []
+        return [batch], []
+
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__getitem__") and hasattr(data, "__len__"):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # assume iterable of batches
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        sd = fio.load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fio.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        import paddle_tpu
+        return paddle_tpu.summary(self.network, input_size)

@@ -1,0 +1,58 @@
+"""paddle.jit parity surface (reference: python/paddle/fluid/dygraph/jit.py):
+to_static decorator, save/load of compiled inference functions. Compilation
+is jax.jit staging (see engine.py), not AST transpilation."""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .engine import TracedLayer, make_eval_step, make_train_step  # noqa: F401
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              property=False):  # noqa: A002
+    def deco(fn):
+        from ..nn.layer_base import Layer
+        if isinstance(fn, Layer):
+            traced = TracedLayer(fn.forward, layer=fn)
+            fn.forward = traced
+            return fn
+        wrapper = TracedLayer(fn)
+        functools.update_wrapper(wrapper, fn, updated=())
+        return wrapper
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    return fn
+
+
+def enable_to_static(flag):
+    pass
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save: persist params + structure for TranslatedLayer-style
+    reload (reference: fluid/dygraph/jit.py:529). v1 saves state_dict +
+    class pickle; AOT StableHLO export lives in paddle_tpu.inference."""
+    from ..framework import io as fio
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    fio.save(layer.state_dict(), path + ".pdiparams")
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump({"class": type(layer).__module__ + "." + type(layer).__qualname__},
+                    f)
+
+
+def load(path, **configs):
+    raise NotImplementedError(
+        "paddle_tpu.jit.load: use paddle_tpu.inference.Predictor for "
+        "deployment loading (planned)")

@@ -1,0 +1,7 @@
+from .layer_base import Layer, ParamAttr
+from . import functional
+from . import initializer
+from .layers import *  # noqa: F401,F403
+from .layers import __all__ as _layers_all
+
+__all__ = ["Layer", "ParamAttr", "functional", "initializer"] + list(_layers_all)

@@ -1,0 +1,651 @@
+"""paddle.nn.functional parity surface (reference:
+python/paddle/nn/functional/*.py) over the TPU primitive library."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...framework.random import RNG
+from ...framework import state
+from ...ops import nn_ops as _nn
+from ...ops import math as _m
+from ...ops import manipulation as _mp
+
+# -- activations ------------------------------------------------------------
+relu = _nn.relu
+relu6 = _nn.relu6
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _nn.leaky_relu(x, negative_slope=float(negative_slope))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _nn.prelu(x, weight, data_format=data_format)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _nn.elu(x, alpha=float(alpha))
+
+
+selu = _nn.selu
+
+
+def celu(x, alpha=1.0, name=None):
+    return _nn.celu(x, alpha=float(alpha))
+
+
+def gelu(x, approximate=False, name=None):
+    return _nn.gelu(x, approximate=bool(approximate))
+
+
+sigmoid = _nn.sigmoid
+silu = _nn.silu
+swish = _nn.swish
+tanh = _nn.tanh
+mish = _nn.mish
+softsign = _nn.softsign
+tanhshrink = _nn.tanhshrink
+log_sigmoid = _nn.log_sigmoid
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return _nn.hardtanh(x, min=float(min), max=float(max))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _nn.hardshrink(x, threshold=float(threshold))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _nn.softshrink(x, threshold=float(threshold))
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return _nn.hardsigmoid(x, slope=float(slope), offset=float(offset))
+
+
+hardswish = _nn.hardswish
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _nn.softplus(x, beta=float(beta), threshold=float(threshold))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _nn.thresholded_relu(x, threshold=float(threshold))
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _nn.maxout(x, groups=int(groups), axis=int(axis))
+
+
+def glu(x, axis=-1, name=None):
+    return _nn.glu(x, axis=int(axis))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return _nn.softmax(x, axis=int(axis))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return _nn.log_softmax(x, axis=int(axis))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    return _nn._gumbel_softmax(x, RNG.next_key(), temperature=float(temperature),
+                               hard=bool(hard), axis=int(axis))
+
+
+# -- linear -----------------------------------------------------------------
+
+
+def linear(x, weight, bias=None, name=None):
+    out = _m.matmul(x, weight)
+    if bias is not None:
+        out = _m.add(out, bias)
+    return out
+
+
+# -- conv / pool ------------------------------------------------------------
+
+
+def _pair(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    return v if len(v) == n else v * n
+
+
+def _norm_padding(padding, n):
+    """paddle padding: int, list of n ints, list of n pairs, or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return ((int(padding), int(padding)),) * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return tuple((int(p), int(p)) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple((int(padding[2 * i]), int(padding[2 * i + 1]))
+                     for i in range(n))
+    return tuple(tuple(int(q) for q in p) for p in padding)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups,
+                   data_format, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups,
+                   data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups,
+                   data_format, 3)
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, data_format, n):
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    out = _nn.conv(x, weight, stride=_pair(stride, n),
+                   padding=_norm_padding(padding, n),
+                   dilation=_pair(dilation, n), groups=int(groups),
+                   channel_last=channel_last)
+    if bias is not None:
+        shape = ((1,) * (n + 1) + (-1,)) if channel_last else ((1, -1) + (1,) * n)
+        out = _m.add(out, _mp.reshape(bias, shape))
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL", name=None, output_size=None):
+    return _convnd_t(x, weight, bias, stride, padding, output_padding,
+                     dilation, groups, data_format, 1)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW", name=None, output_size=None):
+    return _convnd_t(x, weight, bias, stride, padding, output_padding,
+                     dilation, groups, data_format, 2)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW", name=None, output_size=None):
+    return _convnd_t(x, weight, bias, stride, padding, output_padding,
+                     dilation, groups, data_format, 3)
+
+
+def _convnd_t(x, weight, bias, stride, padding, output_padding, dilation,
+              groups, data_format, n):
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    pad = _norm_padding(padding, n)
+    if isinstance(pad, str):
+        raise ValueError("SAME/VALID not supported for conv_transpose")
+    out = _nn.conv_transpose(
+        x, weight, stride=_pair(stride, n), padding=pad,
+        output_padding=_pair(output_padding, n), dilation=_pair(dilation, n),
+        groups=int(groups), channel_last=channel_last)
+    if bias is not None:
+        shape = ((1,) * (n + 1) + (-1,)) if channel_last else ((1, -1) + (1,) * n)
+        out = _m.add(out, _mp.reshape(bias, shape))
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, name=None):
+    return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True,
+                 "NCL", 1)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True,
+                 data_format, 2)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True,
+                 data_format, 3)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, ceil_mode, exclusive,
+                 "NCL", 1)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, ceil_mode, exclusive,
+                 data_format, 2)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, ceil_mode, exclusive,
+                 data_format, 3)
+
+
+def _pool(x, ptype, kernel, stride, padding, ceil_mode, exclusive,
+          data_format, n):
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    stride = stride if stride is not None else kernel
+    pad = _norm_padding(padding, n)
+    if isinstance(pad, str):
+        pad = ((0, 0),) * n if pad == "VALID" else pad
+    return _nn.pool(x, pool_type=ptype, kernel=_pair(kernel, n),
+                    stride=_pair(stride, n), padding=pad,
+                    ceil_mode=bool(ceil_mode), exclusive=bool(exclusive),
+                    channel_last=channel_last)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _nn.adaptive_pool(x, output_size=_pair(output_size, 1),
+                             pool_type="avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _nn.adaptive_pool(x, output_size=_adp_size(output_size, 2),
+                             pool_type="avg",
+                             channel_last=data_format[-1] == "C" and len(data_format) > 2)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _nn.adaptive_pool(x, output_size=_adp_size(output_size, 3),
+                             pool_type="avg",
+                             channel_last=data_format[-1] == "C" and len(data_format) > 2)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _nn.adaptive_pool(x, output_size=_pair(output_size, 1),
+                             pool_type="max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _nn.adaptive_pool(x, output_size=_adp_size(output_size, 2),
+                             pool_type="max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _nn.adaptive_pool(x, output_size=_adp_size(output_size, 3),
+                             pool_type="max")
+
+
+def _adp_size(v, n):
+    if isinstance(v, (int, np.integer)) or v is None:
+        return (v if v is None else int(v),) * n
+    return tuple(None if s is None else int(s) for s in v)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return _nn.unfold(x, kernel_sizes=_pair(kernel_sizes, 2),
+                      strides=_pair(strides, 2),
+                      paddings=_pair(paddings, 2),
+                      dilations=_pair(dilations, 2))
+
+
+# -- norm -------------------------------------------------------------------
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, (int, np.integer)):
+        n_axes = 1
+    else:
+        n_axes = len(tuple(normalized_shape))
+    return _nn.layer_norm(x, weight, bias, epsilon=float(epsilon),
+                          begin_norm_axis=x.ndim - n_axes)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return _nn.batch_norm_infer(x, weight, bias, running_mean, running_var,
+                                    epsilon=float(epsilon),
+                                    channel_last=channel_last)
+    y, bmean, bvar = _nn.batch_norm_train(x, weight, bias,
+                                          epsilon=float(epsilon),
+                                          channel_last=channel_last)
+    # functional running-stat update (reference mutates in-kernel); under a
+    # trace this assigns tracers which the jit engine captures as outputs
+    if running_mean is not None:
+        import jax
+        m = float(momentum)
+        bm, bv = jax.lax.stop_gradient(bmean._data), jax.lax.stop_gradient(bvar._data)
+        running_mean._data = m * running_mean._data + (1 - m) * bm
+        running_var._data = m * running_var._data + (1 - m) * bv
+    return y
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    return _nn.instance_norm(x, weight, bias, epsilon=float(eps))
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    return _nn.group_norm(x, weight, bias, num_groups=int(num_groups),
+                          epsilon=float(epsilon),
+                          channel_last=data_format[-1] == "C" and len(data_format) > 2)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _nn.local_response_norm(x, size=int(size), alpha=float(alpha),
+                                   beta=float(beta), k=float(k))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _nn.normalize(x, p=float(p), axis=int(axis), epsilon=float(epsilon))
+
+
+# -- dropout ----------------------------------------------------------------
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return _m.scale(x, scale=1.0 - p)
+        return x
+    if p == 1.0:
+        from ...ops.creation import zeros_like
+        return _m.multiply(x, zeros_like(x))
+    return _nn._dropout(x, RNG.next_key(), p=float(p), mode=mode)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return dropout(x, p, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    return dropout(x, p, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return _nn._alpha_dropout(x, RNG.next_key(), p=float(p))
+
+
+# -- embedding --------------------------------------------------------------
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _nn.embedding_lookup(weight, x, padding_idx=padding_idx)
+
+
+def one_hot(x, num_classes, name=None):
+    return _nn.one_hot(x, num_classes=int(num_classes))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    from ...ops.creation import diag_embed as _de
+    return _de(x, offset=offset, dim1=dim1, dim2=dim2)
+
+
+# -- losses -----------------------------------------------------------------
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return _m.mean(loss)
+    if reduction == "sum":
+        return _m.sum_(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    if use_softmax:
+        loss = _nn.softmax_with_cross_entropy(
+            input, label, soft_label=bool(soft_label),
+            ignore_index=int(ignore_index), axis=int(axis))
+    else:
+        loss = _nn.nll_loss_from_probs(input, label) if False else \
+            _m.neg(_m.sum_(_m.multiply(_m.log(input),
+                                       label if soft_label else one_hot(label, input.shape[axis])),
+                           axis=axis, keepdim=True))
+    loss = _mp.squeeze(loss, axis=axis) if loss.ndim > 1 and loss.shape[axis if axis >= 0 else loss.ndim + axis] == 1 else loss
+    if weight is not None:
+        lab = label if not soft_label else None
+        if lab is not None:
+            w = _nn.embedding_lookup(weight, lab)
+            loss = _m.multiply(loss, w)
+            if reduction == "mean":
+                return _m.divide(_m.sum_(loss), _m.sum_(w))
+    if reduction == "mean" and int(ignore_index) >= 0 and not soft_label:
+        valid = _mp.cast(_m.not_equal(label, ignore_index), input.dtype.name)
+        denom = _m.maximum(_m.sum_(valid), _mp.cast(_m.not_equal(valid, valid), input.dtype.name) + 1e-8)
+        return _m.divide(_m.sum_(loss), denom)
+    return _reduce_loss(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = _nn.softmax_with_cross_entropy(logits, label,
+                                          soft_label=bool(soft_label),
+                                          ignore_index=int(ignore_index),
+                                          axis=int(axis))
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _reduce_loss(_nn.square_error_cost(input, label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _reduce_loss(_m.abs_(_m.subtract(input, label)), reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    loss = _nn.nll_loss(input, label, ignore_index=int(ignore_index))
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    loss = _nn.bce_loss(input, label)
+    if weight is not None:
+        loss = _m.multiply(loss, weight)
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    if pos_weight is not None:
+        loss = _nn.bce_with_logits(logit, label, pos_weight)
+    else:
+        loss = _nn.bce_with_logits(logit, label)
+    if weight is not None:
+        loss = _m.multiply(loss, weight)
+    return _reduce_loss(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    loss = _nn.kldiv_loss(input, label)
+    if reduction == "batchmean":
+        return _m.divide(_m.sum_(loss), float(input.shape[0]))
+    return _reduce_loss(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _reduce_loss(_nn.huber_loss(input, label, delta=float(delta)),
+                        reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return _reduce_loss(
+        _nn.margin_ranking_loss(input, other, label, margin=float(margin)),
+        reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    return _reduce_loss(
+        _nn.hinge_embedding_loss(input, label, margin=float(margin)),
+        reduction)
+
+
+def square_error_cost(input, label):
+    return _nn.square_error_cost(input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    eps = float(epsilon)
+    from ...ops.creation import ones_like
+    return _m.neg(_m.add(
+        _m.multiply(label, _m.log(_m.add(input, eps))),
+        _m.multiply(_m.subtract(ones_like(label), label),
+                    _m.log(_m.subtract(_m.add(1.0 + eps, _m.neg(input)), 0.0)))))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _nn.cosine_similarity(x1, x2, axis=int(axis), eps=float(eps))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return _nn.label_smooth(label, epsilon=float(epsilon))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    p = sigmoid(logit)
+    ce = _nn.bce_with_logits(logit, label)
+    p_t = _m.add(_m.multiply(p, label),
+                 _m.multiply(_m.subtract(1.0, p), _m.subtract(1.0, label)))
+    mod = _m.pow_(_m.subtract(1.0, p_t), gamma)
+    a_t = _m.add(_m.multiply(label, alpha),
+                 _m.multiply(_m.subtract(1.0, label), 1.0 - alpha))
+    loss = _m.multiply(_m.multiply(a_t, mod), ce)
+    if normalizer is not None:
+        loss = _m.divide(loss, normalizer)
+    return _reduce_loss(loss, reduction)
+
+
+# -- vision / misc ----------------------------------------------------------
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    nsp = x.ndim - 2
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * nsp
+        sp = x.shape[1:-1] if channel_last else x.shape[2:]
+        size = [int(s * f) for s, f in zip(sp, sf)]
+    else:
+        if isinstance(size, Tensor):
+            size = size.numpy().tolist()
+        size = [int(s) if not isinstance(s, Tensor) else int(s.numpy()) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    return _nn.interpolate(x, size=tuple(size), mode=mode,
+                           align_corners=bool(align_corners),
+                           channel_last=channel_last)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _nn.pixel_shuffle(x, upscale_factor=int(upscale_factor),
+                             channel_last=data_format == "NHWC")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return _nn.channel_shuffle(x, groups=int(groups),
+                               channel_last=data_format == "NHWC")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    return _mp.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return _nn.zero_pad(x, padding=tuple(int(p) for p in padding),
+                        channel_last=data_format == "NHWC")
+
+
+def unstack(x, axis=0, num=None):
+    return _mp.unstack(x, axis, num)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    import jax.numpy as jnp
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    data = _mp.reshape(x, (n, seg_num, c, h, w))
+    c1 = int(c * shift_ratio)
+    fold = data._data
+    left = jnp.concatenate([fold[:, 1:, :c1], jnp.zeros_like(fold[:, :1, :c1])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(fold[:, :1, c1:2 * c1]),
+                             fold[:, :-1, c1:2 * c1]], axis=1)
+    mid = fold[:, :, 2 * c1:]
+    out = jnp.concatenate([left, right, mid], axis=2)
+    return Tensor(out.reshape(nt, c, h, w), _internal=True)
+
+
+# attention (reference: incubate fused_multi_head_attention /
+# sparse_attention; here a plain SDPA that XLA fuses; the Pallas flash
+# kernel lives in paddle_tpu/ops/pallas_ops.py and is used when available)
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    import math as pymath
+    d = query.shape[-1]
+    scores = _m.multiply(_m.matmul(query, key, transpose_y=True),
+                         1.0 / pymath.sqrt(d))
+    if is_causal:
+        import jax.numpy as jnp
+        L, S = scores.shape[-2], scores.shape[-1]
+        causal = Tensor(jnp.tril(jnp.ones((L, S), bool)), _internal=True)
+        scores = _m.where(causal, scores,
+                          Tensor(np.asarray(-1e9, np.float32)))
+    if attn_mask is not None:
+        scores = _m.add(scores, attn_mask)
+    attn = softmax(scores, axis=-1)
+    if dropout_p > 0.0 and training:
+        attn = dropout(attn, dropout_p, training=training)
+    return _m.matmul(attn, value)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    import jax.numpy as jnp
+    if maxlen is None:
+        maxlen = int(x.numpy().max())
+    r = jnp.arange(maxlen)
+    from ...framework.dtype import to_np
+    m = (r[None, :] < (x._data if isinstance(x, Tensor) else x)[..., None])
+    return Tensor(m.astype(to_np(dtype)), _internal=True)

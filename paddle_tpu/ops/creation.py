@@ -1,0 +1,203 @@
+"""Tensor creation ops (reference: fill_constant_op.cc, range_op,
+linspace_op, eye_op, tril/triu ops, diag ops in
+/root/reference/paddle/fluid/operators/ and python/paddle/tensor/creation.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import primitive, raw
+from ..framework.dtype import get_default_dtype, to_np
+from ..framework.tensor import Tensor
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s) if not isinstance(s, Tensor) else int(s.numpy()) for s in shape]
+
+
+@primitive("fill_constant", nondiff=True)
+def _full(*, shape, fill_value, dtype):
+    return jnp.full(shape, fill_value, dtype=to_np(dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None:
+        dtype = get_default_dtype() if isinstance(fill_value, float) else (
+            "bool" if isinstance(fill_value, bool) else
+            "int64" if isinstance(fill_value, int) else get_default_dtype())
+    return _full(shape=tuple(_shape_list(shape)), fill_value=float(fill_value)
+                 if not isinstance(fill_value, bool) else fill_value,
+                 dtype=str(to_np(dtype)))
+
+
+def zeros(shape, dtype=None, name=None):
+    return full(shape, 0.0 if dtype is None else 0, dtype or get_default_dtype())
+
+
+def ones(shape, dtype=None, name=None):
+    return full(shape, 1.0 if dtype is None else 1, dtype or get_default_dtype())
+
+
+@primitive("fill_like", nondiff=True)
+def _full_like(x, *, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=to_np(dtype) if dtype else None)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return _full_like(x, fill_value=fill_value,
+                      dtype=str(to_np(dtype)) if dtype is not None else None)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return full_like(x, 0, dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return full_like(x, 1, dtype)
+
+
+@primitive("arange", nondiff=True)
+def _arange(*, start, end, step, dtype):
+    return jnp.arange(start, end, step, dtype=to_np(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer))
+                                for v in (start, end, step))
+                 else get_default_dtype())
+    return _arange(start=start, end=end, step=step, dtype=str(to_np(dtype)))
+
+
+@primitive("linspace", nondiff=True)
+def _linspace(*, start, stop, num, dtype):
+    return jnp.linspace(start, stop, num, dtype=to_np(dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return _linspace(start=_v(start), stop=_v(stop), num=int(_v(num)),
+                     dtype=str(to_np(dtype or get_default_dtype())))
+
+
+@primitive("logspace", nondiff=True)
+def _logspace(*, start, stop, num, base, dtype):
+    return jnp.logspace(start, stop, num, base=base, dtype=to_np(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return _logspace(start=_v(start), stop=_v(stop), num=int(_v(num)),
+                     base=_v(base), dtype=str(to_np(dtype or get_default_dtype())))
+
+
+@primitive("eye_op", nondiff=True)
+def _eye(*, num_rows, num_columns, dtype):
+    return jnp.eye(num_rows, num_columns, dtype=to_np(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _eye(num_rows=int(num_rows),
+                num_columns=int(num_columns if num_columns is not None else num_rows),
+                dtype=str(to_np(dtype or get_default_dtype())))
+
+
+@primitive("tril_op")
+def tril(x, *, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@primitive("triu_op")
+def triu(x, *, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@primitive("diag_v2")
+def diag(x, *, offset=0, padding_value=0):
+    if x.ndim == 1:
+        d = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            n = d.shape[0]
+            mask = jnp.eye(n, k=offset, dtype=bool)
+            d = jnp.where(mask, d, padding_value)
+        return d
+    return jnp.diagonal(x, offset=offset)
+
+
+@primitive("diagflat")
+def diagflat(x, *, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@primitive("diag_embed")
+def diag_embed(x, *, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    if offset >= 0:
+        out = out.at[..., idx, idx + offset].set(x)
+    else:
+        out = out.at[..., idx - offset, idx].set(x)
+    if (dim1, dim2) not in ((-2, -1), (x.ndim - 1, x.ndim)):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+@primitive("diagonal")
+def diagonal(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@primitive("meshgrid_op", nondiff=True)
+def _meshgrid(*xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(_meshgrid(*args))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def clone(x, name=None):
+    from .math import _identity
+    return _identity(x)
+
+
+def assign(x, output=None):
+    from .math import _identity
+    if isinstance(x, (np.ndarray, list, tuple, int, float, bool)):
+        x = Tensor(np.asarray(x))
+    out = _identity(x)
+    if output is not None:
+        output._data = out._data
+        return output
+    return out
+
+
+@primitive("complex_op")
+def complex_(real, imag):
+    return jax.lax.complex(real, imag) if False else real + 1j * imag
+
+
+import jax  # noqa: E402  (used above lazily)

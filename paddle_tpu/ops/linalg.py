@@ -1,0 +1,216 @@
+"""Linear algebra ops (reference: cholesky_op.cu, svd_op.cc, inverse_op.cc,
+solve_op.cc, eig*, matrix_rank, norm ops, triangular_solve in
+/root/reference/paddle/fluid/operators/ and python/paddle/tensor/linalg.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import primitive
+
+
+@primitive("p_norm")
+def _p_norm(x, *, porder=2.0, axis=None, keepdim=False):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if porder == np.inf:
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if porder == -np.inf:
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if porder == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), porder), axis=axis, keepdims=keepdim),
+        1.0 / porder)
+
+
+@primitive("frobenius_norm")
+def _fro_norm(x, *, axis=None, keepdim=False):
+    if axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=tuple(axis), keepdims=keepdim))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if p == "fro":
+        if axis is None or isinstance(axis, (list, tuple)):
+            return _fro_norm(x, axis=tuple(axis) if axis is not None else None,
+                             keepdim=keepdim)
+        return _p_norm(x, porder=2.0, axis=int(axis), keepdim=keepdim)
+    if isinstance(axis, (list, tuple)) and len(axis) == 2:
+        # matrix norms
+        if p in (np.inf, -np.inf, 1, -1):
+            return _matrix_norm(x, porder=float(p), axis=tuple(axis),
+                                keepdim=keepdim)
+        raise ValueError(f"unsupported matrix norm order {p}")
+    return _p_norm(x, porder=float(p),
+                   axis=int(axis) if axis is not None else None,
+                   keepdim=keepdim)
+
+
+@primitive("matrix_norm")
+def _matrix_norm(x, *, porder, axis, keepdim=False):
+    a0, a1 = axis
+    if porder in (np.inf, -np.inf):
+        red = jnp.sum(jnp.abs(x), axis=a1, keepdims=True)
+        out = jnp.max(red, axis=a0, keepdims=True) if porder > 0 \
+            else jnp.min(red, axis=a0, keepdims=True)
+    else:
+        red = jnp.sum(jnp.abs(x), axis=a0, keepdims=True)
+        out = jnp.max(red, axis=a1, keepdims=True) if porder > 0 \
+            else jnp.min(red, axis=a1, keepdims=True)
+    if not keepdim:
+        out = jnp.squeeze(out, axis=tuple(sorted((a0 % x.ndim, a1 % x.ndim),
+                                                 reverse=True)))
+    return out
+
+
+@primitive("cholesky_op")
+def cholesky(x, *, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@primitive("cholesky_solve_op")
+def cholesky_solve(x, y, *, upper=False):
+    yy = jnp.swapaxes(y, -1, -2) if upper else y
+    z = jax.scipy.linalg.solve_triangular(yy, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(yy, -1, -2), z, lower=False)
+
+
+@primitive("inverse_op")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@primitive("pinv_op")
+def pinv(x, *, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@primitive("matrix_power_op")
+def matrix_power(x, *, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@primitive("det_op")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@primitive("slogdet_op")
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@primitive("svd_op")
+def svd(x, *, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh
+
+
+@primitive("qr_op")
+def qr(x, *, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+@primitive("lu_op")
+def lu(x):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+
+@primitive("eig_op")
+def eig(x):
+    # no TPU eig; XLA runs it on host CPU
+    w, v = jnp.linalg.eig(x)
+    return w, v
+
+
+@primitive("eigh_op")
+def eigh(x, *, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+@primitive("eigvals_op")
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@primitive("eigvalsh_op")
+def eigvalsh(x, *, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@primitive("matrix_rank_op", nondiff=True)
+def matrix_rank(x, *, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol).astype(jnp.int32)
+
+
+@primitive("solve_op")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@primitive("triangular_solve_op")
+def triangular_solve(x, y, *, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@primitive("lstsq_op")
+def lstsq(x, y, *, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank.astype(jnp.int32), sv
+
+
+@primitive("multi_dot_op")
+def multi_dot(*xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+@primitive("histogram_op", nondiff=True)
+def histogram(x, *, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        mn, mx = jnp.min(x), jnp.max(x)
+    else:
+        mn, mx = min, max
+    h, _ = jnp.histogram(x, bins=bins, range=(mn, mx))
+    return h.astype(jnp.int64)
+
+
+@primitive("bincount_op", nondiff=True)
+def bincount(x, *, minlength=0):
+    return jnp.bincount(x.astype(jnp.int32), minlength=minlength,
+                        length=None).astype(jnp.int64)
+
+
+@primitive("trace_op")
+def trace(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@primitive("einsum_op")
+def _einsum(*operands, equation):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return _einsum(*operands, equation=equation)
+
+
+@primitive("corrcoef_op")
+def corrcoef(x, *, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@primitive("cov_op")
+def cov(x, *, rowvar=True, ddof=True):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0)

@@ -1,0 +1,413 @@
+"""Shape/layout manipulation ops (reference: reshape_op.cc, transpose_op.cc,
+concat_op.cc, split_op.cc, squeeze/unsqueeze, stack/unstack, gather/scatter,
+pad, tile/expand, flip/roll in /root/reference/paddle/fluid/operators/ and
+python/paddle/tensor/manipulation.py). All static-shape → XLA-friendly."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import primitive
+from ..framework.dtype import to_np
+from ..framework.tensor import Tensor
+
+
+def _int_tuple(v):
+    if isinstance(v, Tensor):
+        return tuple(int(x) for x in v.numpy().tolist())
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(x) if not isinstance(x, Tensor) else int(x.numpy())
+                 for x in v)
+
+
+@primitive("cast")
+def _cast(x, *, dtype):
+    return x.astype(to_np(dtype))
+
+
+def cast(x, dtype):
+    return _cast(x, dtype=str(to_np(dtype)))
+
+
+@primitive("reshape2")
+def _reshape(x, *, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    return _reshape(x, shape=_int_tuple(shape))
+
+
+@primitive("transpose2")
+def _transpose(x, *, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose(x, perm=_int_tuple(perm))
+
+
+def t(x):
+    if x.ndim <= 1:
+        return x
+    return _transpose(x, perm=(1, 0))
+
+
+@primitive("flatten_contiguous_range")
+def _flatten(x, *, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    new_shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten(x, start_axis=start_axis, stop_axis=stop_axis)
+
+
+@primitive("squeeze2")
+def _squeeze(x, *, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = tuple(a % x.ndim for a in (axis if isinstance(axis, tuple) else (axis,))
+                 if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    return _squeeze(x, axis=_int_tuple(axis) if axis is not None else None)
+
+
+@primitive("unsqueeze2")
+def _unsqueeze(x, *, axis):
+    out = x
+    for a in sorted(axis):
+        out = jnp.expand_dims(out, a if a >= 0 else a + out.ndim + 1)
+    return out
+
+
+def unsqueeze(x, axis, name=None):
+    return _unsqueeze(x, axis=_int_tuple(axis))
+
+
+@primitive("concat_op")
+def _concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    return _concat(*x, axis=int(axis))
+
+
+@primitive("stack_op")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(*x, axis=int(axis))
+
+
+@primitive("unstack_op")
+def _unstack(x, *, axis=0, num=None):
+    n = num or x.shape[axis]
+    return tuple(jnp.squeeze(p, axis=axis)
+                 for p in jnp.split(x, n, axis=axis))
+
+
+def unstack(x, axis=0, num=None):
+    return list(_unstack(x, axis=axis, num=num))
+
+
+@primitive("split_op")
+def _split(x, *, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    bounds = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, bounds, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    if isinstance(num_or_sections, (list, tuple)):
+        secs = list(num_or_sections)
+        total = x.shape[int(axis)]
+        known = sum(s for s in secs if s != -1)
+        secs = [s if s != -1 else total - known for s in secs]
+        return list(_split(x, sections=tuple(secs), axis=int(axis)))
+    return list(_split(x, sections=int(num_or_sections), axis=int(axis)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@primitive("slice_op")
+def _slice(x, *, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+        e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s2, e2)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    return _slice(x, axes=_int_tuple(axes), starts=_int_tuple(starts),
+                  ends=_int_tuple(ends))
+
+
+@primitive("strided_slice_op")
+def _strided_slice(x, *, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _strided_slice(x, axes=_int_tuple(axes), starts=_int_tuple(starts),
+                          ends=_int_tuple(ends), strides=_int_tuple(strides))
+
+
+@primitive("getitem")
+def _getitem(x, *, index):
+    return x[index]
+
+
+@primitive("getitem_dyn")
+def _getitem_dyn(x, *idx_arrays, index_template):
+    it = iter(idx_arrays)
+    idx = tuple(next(it) if i == "__arr__" else i for i in index_template)
+    return x[idx]
+
+
+@primitive("gather_op")
+def gather(x, index, *, axis=0):
+    return jnp.take(x, index.astype(jnp.int32), axis=axis)
+
+
+@primitive("gather_nd")
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return x[idx]
+
+
+@primitive("take_along_axis_op")
+def take_along_axis(x, indices, *, axis):
+    return jnp.take_along_axis(x, indices.astype(jnp.int32), axis=axis)
+
+
+@primitive("put_along_axis_op")
+def put_along_axis(x, indices, values, *, axis, reduce="assign"):
+    idx = indices.astype(jnp.int32)
+    if reduce == "assign":
+        return jnp.put_along_axis(x, idx, values, axis=axis, inplace=False)
+    if reduce == "add":
+        # build scatter-add via .at
+        idxs = [jnp.arange(s).reshape([-1 if i == d else 1
+                                       for i in range(x.ndim)])
+                for d, s in enumerate(idx.shape)]
+        idxs[axis] = idx
+        return x.at[tuple(jnp.broadcast_to(i, idx.shape) for i in idxs)].add(values)
+    if reduce == "multiply" or reduce == "mul":
+        idxs = [jnp.arange(s).reshape([-1 if i == d else 1
+                                       for i in range(x.ndim)])
+                for d, s in enumerate(idx.shape)]
+        idxs[axis] = idx
+        return x.at[tuple(jnp.broadcast_to(i, idx.shape) for i in idxs)].multiply(values)
+    raise ValueError(f"unknown reduce {reduce!r}")
+
+
+@primitive("scatter_op")
+def scatter(x, index, updates, *, overwrite=True):
+    idx = index.astype(jnp.int32)
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx[:, 0]
+    if overwrite:
+        return x.at[idx].set(updates)
+    # paddle !overwrite: zero the target rows then accumulate
+    zeroed = x.at[idx].set(jnp.zeros_like(updates))
+    return zeroed.at[idx].add(updates)
+
+
+@primitive("scatter_nd_add_op")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    z = jnp.zeros(tuple(int(s) for s in shape),
+                  dtype=updates._data.dtype if isinstance(updates, Tensor)
+                  else updates.dtype)
+    return scatter_nd_add(Tensor(z, _internal=True), index, updates)
+
+
+@primitive("index_select_op")
+def index_select(x, index, *, axis=0):
+    return jnp.take(x, index.astype(jnp.int32), axis=axis)
+
+
+@primitive("index_sample_op")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)
+
+
+@primitive("tile_op")
+def _tile(x, *, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(x, repeat_times=_int_tuple(repeat_times))
+
+
+@primitive("expand_v2")
+def _expand(x, *, shape):
+    tgt = tuple(x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+                for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+def expand(x, shape, name=None):
+    return _expand(x, shape=_int_tuple(shape))
+
+
+def expand_as(x, y, name=None):
+    return _expand(x, shape=tuple(y.shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return _expand(x, shape=_int_tuple(shape))
+
+
+@primitive("broadcast_tensors_op")
+def _broadcast_tensors(*xs):
+    shape = jnp.broadcast_shapes(*[x.shape for x in xs])
+    return tuple(jnp.broadcast_to(x, shape) for x in xs)
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(_broadcast_tensors(*inputs))
+
+
+@primitive("flip_op")
+def _flip(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    return _flip(x, axis=_int_tuple(axis))
+
+
+@primitive("roll_op")
+def _roll(x, *, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _roll(x, shifts=_int_tuple(shifts) if not isinstance(shifts, int) else shifts,
+                 axis=_int_tuple(axis) if axis is not None and not isinstance(axis, int) else axis)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _rot90(x, k=k, axes=tuple(axes))
+
+
+@primitive("rot90_op")
+def _rot90(x, *, k, axes):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@primitive("pad3d_op")
+def _pad(x, *, paddings, mode="constant", value=0.0):
+    return jnp.pad(x, paddings, mode=mode if mode != "circular" else "wrap",
+                   **({"constant_values": value} if mode == "constant" else {}))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    pad = _int_tuple(pad)
+    nd = x.ndim
+    if len(pad) == nd * 2:
+        # paddle flat form low0,high0,low1,high1... over ALL dims
+        pads = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(nd))
+    else:
+        # NCHW-style: pad applies to spatial dims, reversed pairs (W first)
+        n_spatial = len(pad) // 2
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        pairs = pairs[::-1]
+        if data_format.endswith("C"):  # NHWC/NDHWC/NLC
+            pads = ((0, 0),) + tuple(pairs) + ((0, 0),)
+        else:
+            pads = ((0, 0), (0, 0)) + tuple(pairs)
+        pads = tuple(pads) + tuple((0, 0) for _ in range(nd - len(pads)))
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    return _pad(x, paddings=pads, mode=jmode if mode != "constant" else "constant",
+                value=value)
+
+
+@primitive("repeat_interleave_op")
+def _repeat_interleave(x, *, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        # dynamic repeats: host fallback
+        return Tensor(np.repeat(x.numpy(), repeats.numpy(),
+                                axis=axis))
+    return _repeat_interleave(x, repeats=int(repeats), axis=axis)
+
+
+@primitive("moveaxis_op")
+def _moveaxis(x, *, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def moveaxis(x, source, destination, name=None):
+    return _moveaxis(x, source=_int_tuple(source),
+                     destination=_int_tuple(destination))
+
+
+@primitive("as_complex_op")
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@primitive("as_real_op")
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@primitive("unbind_op")
+def _unbind(x, *, axis=0):
+    return tuple(jnp.squeeze(p, axis=axis)
+                 for p in jnp.split(x, x.shape[axis], axis=axis))
+
+
+def unbind(x, axis=0):
+    return list(_unbind(x, axis=axis))
+
+
+@primitive("unique_consecutive_op", nondiff=True)
+def _unique_consecutive(x):
+    keep = jnp.concatenate([jnp.array([True]), x[1:] != x[:-1]])
+    return x[keep]
+
+
+@primitive("shard_index_op", nondiff=True)
+def shard_index(x, *, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+import jax  # noqa: E402

@@ -1,0 +1,735 @@
+"""Math primitives: elementwise, matmul, reductions, comparisons.
+
+TPU-native kernel surface replacing the reference's
+operators/elementwise/*, operators/reduce_ops/*, activation_op.cc and
+matmul_v2_op.cc (/root/reference/paddle/fluid/operators/). Every op is a pure
+jax function — XLA fuses elementwise chains into matmul epilogues on its own,
+which is the TPU answer to the reference's fused_elemwise_activation ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.dispatch import primitive
+
+# ---------------------------------------------------------------------------
+# elementwise binary
+
+
+@primitive("elementwise_add")
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@primitive("elementwise_sub")
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@primitive("elementwise_mul")
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@primitive("elementwise_div")
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@primitive("elementwise_floordiv")
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@primitive("elementwise_mod")
+def remainder(x, y):
+    return jnp.mod(x, y)
+
+
+@primitive("elementwise_pow")
+def pow_(x, y):
+    return jnp.power(x, y)
+
+
+@primitive("elementwise_max")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@primitive("elementwise_min")
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@primitive("elementwise_fmax")
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@primitive("elementwise_fmin")
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@primitive("atan2")
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+# ---------------------------------------------------------------------------
+# unary
+
+
+@primitive("scale")
+def scale(x, *, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@primitive("neg")
+def neg(x):
+    return jnp.negative(x)
+
+
+@primitive("abs")
+def abs_(x):
+    return jnp.abs(x)
+
+
+@primitive("sign")
+def sign(x):
+    return jnp.sign(x)
+
+
+@primitive("exp")
+def exp(x):
+    return jnp.exp(x)
+
+
+@primitive("expm1")
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@primitive("log")
+def log(x):
+    return jnp.log(x)
+
+
+@primitive("log2")
+def log2(x):
+    return jnp.log2(x)
+
+
+@primitive("log10")
+def log10(x):
+    return jnp.log10(x)
+
+
+@primitive("log1p")
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@primitive("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@primitive("rsqrt")
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+@primitive("square")
+def square(x):
+    return jnp.square(x)
+
+
+@primitive("reciprocal")
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@primitive("sin")
+def sin(x):
+    return jnp.sin(x)
+
+
+@primitive("cos")
+def cos(x):
+    return jnp.cos(x)
+
+
+@primitive("tan")
+def tan(x):
+    return jnp.tan(x)
+
+
+@primitive("asin")
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@primitive("acos")
+def acos(x):
+    return jnp.arccos(x)
+
+
+@primitive("atan")
+def atan(x):
+    return jnp.arctan(x)
+
+
+@primitive("sinh")
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@primitive("cosh")
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@primitive("asinh")
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@primitive("acosh")
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@primitive("atanh")
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@primitive("ceil")
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@primitive("floor")
+def floor(x):
+    return jnp.floor(x)
+
+
+@primitive("round")
+def round_(x):
+    return jnp.round(x)
+
+
+@primitive("trunc")
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@primitive("frac")
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@primitive("erf")
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@primitive("erfinv")
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@primitive("lgamma")
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@primitive("digamma")
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@primitive("angle")
+def angle(x):
+    return jnp.angle(x)
+
+
+@primitive("conj")
+def conj(x):
+    return jnp.conj(x)
+
+
+@primitive("real")
+def real(x):
+    return jnp.real(x)
+
+
+@primitive("imag")
+def imag(x):
+    return jnp.imag(x)
+
+
+@primitive("isnan", nondiff=True)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@primitive("isinf", nondiff=True)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@primitive("isfinite", nondiff=True)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@primitive("clip")
+def clip(x, *, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@primitive("clip_t")
+def _clip_dynamic(x, min_t, max_t):
+    return jnp.clip(x, min_t, max_t)
+
+
+@primitive("stanh")
+def stanh(x, *, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@primitive("logit")
+def logit(x, *, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@primitive("nan_to_num")
+def nan_to_num(x, *, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# ---------------------------------------------------------------------------
+# matmul / dot family (the MXU path — keep operands big and bf16-friendly)
+
+
+@primitive("matmul_v2")
+def matmul(x, y, *, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@primitive("mul")
+def mul_op(x, y, *, x_num_col_dims=1, y_num_col_dims=1):
+    xm = x.reshape((int(jnp.prod(jnp.array(x.shape[:x_num_col_dims]))), -1)) \
+        if x.ndim > 2 else x
+    ym = y
+    return jnp.matmul(xm, ym)
+
+
+@primitive("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@primitive("addmm")
+def addmm(input, x, y, *, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@primitive("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@primitive("inner")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@primitive("cross")
+def cross(x, y, *, axis=None):
+    return jnp.cross(x, y, axis=axis if axis is not None else -1)
+
+
+@primitive("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@primitive("mv")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@primitive("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (tuple, list)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@primitive("reduce_sum")
+def sum_(x, *, axis=None, keepdim=False, dtype=None):
+    import numpy as np
+    from ..framework.dtype import to_np
+    out_dtype = to_np(dtype) if dtype is not None else None
+    if out_dtype is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        out_dtype = np.int64
+    return jnp.sum(x, axis=_axes(axis), keepdims=keepdim, dtype=out_dtype)
+
+
+@primitive("reduce_mean")
+def mean(x, *, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("reduce_max")
+def max_(x, *, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("reduce_min")
+def min_(x, *, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("reduce_prod")
+def prod(x, *, axis=None, keepdim=False, dtype=None):
+    from ..framework.dtype import to_np
+    return jnp.prod(x, axis=_axes(axis), keepdims=keepdim,
+                    dtype=to_np(dtype) if dtype is not None else None)
+
+
+@primitive("reduce_any", nondiff=True)
+def any_(x, *, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("reduce_all", nondiff=True)
+def all_(x, *, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("logsumexp")
+def logsumexp(x, *, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("amax")
+def amax(x, *, axis=None, keepdim=False):
+    return jnp.amax(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("amin")
+def amin(x, *, axis=None, keepdim=False):
+    return jnp.amin(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("nanmean")
+def nanmean(x, *, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("nansum")
+def nansum(x, *, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("std")
+def std(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axes(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@primitive("var")
+def var(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axes(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@primitive("median")
+def median(x, *, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@primitive("quantile")
+def quantile(x, *, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axes(axis), keepdims=keepdim)
+
+
+# cumulative
+
+
+@primitive("cumsum")
+def cumsum(x, *, axis=None):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=int(axis))
+
+
+@primitive("cumprod")
+def cumprod(x, *, dim=None):
+    if dim is None:
+        return jnp.cumprod(x.reshape(-1))
+    return jnp.cumprod(x, axis=int(dim))
+
+
+@primitive("cummax", nondiff=True)
+def cummax(x, *, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return (lax.cummax(x, axis=int(axis)),
+            jnp.argmax(x[..., None] == 0, axis=-1))  # placeholder indices
+
+
+@primitive("logcumsumexp")
+def logcumsumexp(x, *, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return lax.cumlogsumexp(x, axis=int(axis))
+
+
+# ---------------------------------------------------------------------------
+# comparison / logical (nondiff)
+
+
+@primitive("equal", nondiff=True)
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@primitive("not_equal", nondiff=True)
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@primitive("greater_than", nondiff=True)
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@primitive("greater_equal", nondiff=True)
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@primitive("less_than", nondiff=True)
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@primitive("less_equal", nondiff=True)
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@primitive("logical_and", nondiff=True)
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@primitive("logical_or", nondiff=True)
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@primitive("logical_xor", nondiff=True)
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@primitive("logical_not", nondiff=True)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@primitive("bitwise_and", nondiff=True)
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@primitive("bitwise_or", nondiff=True)
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@primitive("bitwise_xor", nondiff=True)
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@primitive("bitwise_not", nondiff=True)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@primitive("isclose", nondiff=True)
+def isclose(x, y, *, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@primitive("allclose", nondiff=True)
+def allclose(x, y, *, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@primitive("equal_all", nondiff=True)
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# search / index (value outputs differentiable where meaningful)
+
+
+@primitive("argmax", nondiff=True)
+def argmax(x, *, axis=None, keepdim=False, dtype="int64"):
+    from ..framework.dtype import to_np
+    r = jnp.argmax(x, axis=axis if axis is not None else None,
+                   keepdims=keepdim if axis is not None else False)
+    return r.astype(to_np(dtype))
+
+
+@primitive("argmin", nondiff=True)
+def argmin(x, *, axis=None, keepdim=False, dtype="int64"):
+    from ..framework.dtype import to_np
+    r = jnp.argmin(x, axis=axis if axis is not None else None,
+                   keepdims=keepdim if axis is not None else False)
+    return r.astype(to_np(dtype))
+
+
+@primitive("argsort", nondiff=True)
+def argsort(x, *, axis=-1, descending=False):
+    r = jnp.argsort(x, axis=axis, descending=descending)
+    return r.astype(jnp.int64)
+
+
+@primitive("sort_op")
+def sort(x, *, axis=-1, descending=False):
+    return jnp.sort(x, axis=axis, descending=descending)
+
+
+@primitive("top_k_v2")
+def topk(x, *, k, axis=-1, largest=True, sorted=True):
+    axis = int(axis)
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    if largest:
+        vals, idx = lax.top_k(xm, k)
+    else:
+        vals, idx = lax.top_k(-xm, k)
+        vals = -vals
+    if axis != -1 and axis != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@primitive("where")
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@primitive("masked_select", dynamic=True)
+def masked_select(x, mask):
+    # dynamic output size: eager-only (the reference's masked_select is
+    # likewise shape-dynamic; inside jit use where/gather instead)
+    return x[mask]
+
+
+@primitive("nonzero", nondiff=True, dynamic=True)
+def nonzero(x, *, as_tuple=False):
+    r = jnp.stack(jnp.nonzero(x), axis=1)
+    return r.astype(jnp.int64)
+
+
+@primitive("unique", nondiff=True, dynamic=True)
+def _unique_impl(x):
+    return jnp.unique(x)
+
+
+# ---------------------------------------------------------------------------
+# misc numeric
+
+
+@primitive("increment")
+def increment(x, *, value=1.0):
+    return x + value
+
+
+@primitive("multiplex")
+def multiplex(index, *inputs):
+    stacked = jnp.stack(inputs, axis=0)
+    return jnp.take_along_axis(
+        stacked, index.reshape(1, -1, *([1] * (stacked.ndim - 2))).astype(jnp.int32),
+        axis=0)[0]
+
+
+@primitive("lerp")
+def lerp(x, y, w):
+    return x + w * (y - x)
+
+
+@primitive("diff")
+def diff(x, *, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@primitive("rad2deg")
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@primitive("deg2rad")
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@primitive("gcd", nondiff=True)
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@primitive("lcm", nondiff=True)
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@primitive("heaviside")
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@primitive("trapezoid")
+def trapezoid(y, *, dx=1.0, axis=-1):
+    return jnp.trapezoid(y, dx=dx, axis=axis)
+
+
+@primitive("identity")
+def _identity(x):
+    return x

@@ -1,0 +1,641 @@
+"""NN primitives: activations, softmax, conv/pool, norms, dropout, embedding,
+losses. Replaces the reference's operators/activation_op.cc, conv_op.cc,
+pool_op.cc, batch_norm_op, layer_norm_op, dropout_op, lookup_table_v2,
+softmax_with_cross_entropy (/root/reference/paddle/fluid/operators/).
+Convs/matmuls go through lax conv/dot → MXU; elementwise epilogues fuse in XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.dispatch import primitive
+
+# ---------------------------------------------------------------------------
+# activations (reference activation_op.cc:1240-)
+
+
+@primitive("relu")
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+@primitive("relu6")
+def relu6(x, *, threshold=6.0):
+    return jnp.clip(x, 0, threshold)
+
+
+@primitive("leaky_relu")
+def leaky_relu(x, *, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@primitive("prelu_op")
+def prelu(x, weight, *, data_format="NCHW"):
+    if weight.size == 1:
+        w = weight.reshape(())
+    elif data_format == "NCHW" and x.ndim >= 2:
+        w = weight.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:
+        w = weight.reshape((1,) * (x.ndim - 1) + (-1,))
+    return jnp.where(x >= 0, x, w * x)
+
+
+@primitive("elu")
+def elu(x, *, alpha=1.0):
+    safe = jnp.where(x > 0, 0.0, x)
+    return jnp.where(x > 0, x, alpha * jnp.expm1(safe))
+
+
+@primitive("selu")
+def selu(x, *, scale=1.0507009873554805, alpha=1.6732632423543772):
+    safe = jnp.where(x > 0, 0.0, x)
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(safe))
+
+
+@primitive("celu")
+def celu(x, *, alpha=1.0):
+    return jnp.maximum(x, 0) + jnp.minimum(0, alpha * jnp.expm1(jnp.minimum(x, 0) / alpha))
+
+
+@primitive("gelu")
+def gelu(x, *, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@primitive("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@primitive("silu")
+def silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+@primitive("swish")
+def swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+@primitive("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@primitive("hardtanh")
+def hardtanh(x, *, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@primitive("hardshrink")
+def hardshrink(x, *, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@primitive("softshrink")
+def softshrink(x, *, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@primitive("tanhshrink")
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@primitive("hardsigmoid")
+def hardsigmoid(x, *, slope=1.0 / 6, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@primitive("hardswish")
+def hardswish(x, *, threshold=6.0, scale=6.0, offset=3.0):
+    return x * jnp.clip(x + offset, 0.0, threshold) / scale
+
+
+@primitive("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@primitive("softplus")
+def softplus(x, *, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+@primitive("softsign")
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+@primitive("thresholded_relu")
+def thresholded_relu(x, *, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+@primitive("log_sigmoid")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@primitive("maxout_op")
+def maxout(x, *, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@primitive("glu_op")
+def glu(x, *, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+# ---------------------------------------------------------------------------
+# softmax family
+
+
+@primitive("softmax_op")
+def softmax(x, *, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@primitive("log_softmax_op")
+def log_softmax(x, *, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@primitive("gumbel_softmax_op")
+def _gumbel_softmax(x, key, *, temperature=1.0, hard=False, axis=-1):
+    g = jax.random.gumbel(key, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        y = y_hard + lax.stop_gradient(-y) + y  # straight-through
+    return y
+
+
+# ---------------------------------------------------------------------------
+# conv / pool (reference conv_op.cc / pool_op.cc; lax → MXU)
+
+
+def _conv_dn(ndim, channel_last):
+    if ndim == 3:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndim == 4:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+@primitive("conv2d_op")
+def conv(x, w, *, stride=(1, 1), padding=(0, 0), dilation=(1, 1), groups=1,
+         channel_last=False):
+    nd = x.ndim
+    spec = _conv_dn(nd, channel_last)
+    if isinstance(padding, str):
+        pad = padding  # 'SAME' / 'VALID'
+    else:
+        pad = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
+    return lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=pad,
+        rhs_dilation=tuple(dilation), dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+
+
+@primitive("conv2d_transpose_op")
+def conv_transpose(x, w, *, stride=(1, 1), padding=(0, 0),
+                   output_padding=(0, 0), dilation=(1, 1), groups=1,
+                   channel_last=False):
+    nd = x.ndim
+    spec = _conv_dn(nd, channel_last)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
+    nsp = nd - 2
+    stride = tuple(stride)
+    padding = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    dilation = tuple(dilation)
+    outpad = tuple(output_padding) if not isinstance(output_padding, int) \
+        else (output_padding,) * nsp
+    # transposed conv = lhs-dilated conv with flipped effective padding
+    k = [(w.shape[dn.rhs_spec[2 + i]] - 1) * dilation[i] + 1 for i in range(nsp)]
+    pads = [(k[i] - 1 - padding[i][0],
+             k[i] - 1 - padding[i][1] + outpad[i]) for i in range(nsp)]
+    if groups > 1:
+        # w layout (paddle transpose): (in, out/groups, *k) -> grouped OIHW
+        ci = w.shape[0]
+        co_g = w.shape[1]
+        wg = w.reshape((groups, ci // groups) + w.shape[1:])
+        wg = jnp.swapaxes(wg, 1, 2)  # (g, out/g, in/g, *k)
+        w2 = wg.reshape((groups * co_g, ci // groups) + w.shape[2:])
+    else:
+        w2 = jnp.swapaxes(w, 0, 1)
+    w2 = jnp.flip(w2, axis=tuple(range(2, nd)))
+    return lax.conv_general_dilated(
+        x, w2, window_strides=(1,) * nsp, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+@primitive("pool2d_op")
+def pool(x, *, pool_type="max", kernel=(2, 2), stride=(2, 2), padding=(0, 0),
+         ceil_mode=False, exclusive=True, channel_last=False):
+    nsp = x.ndim - 2
+    kernel = tuple(kernel)
+    stride = tuple(stride)
+    pads = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    if channel_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padcfg = [(0, 0)] + pads + [(0, 0)]
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padcfg = [(0, 0), (0, 0)] + pads
+    if ceil_mode:
+        # extend high padding so the last partial window is included
+        sp_axes = range(1, 1 + nsp) if channel_last else range(2, 2 + nsp)
+        newpad = list(padcfg)
+        for i, ax in enumerate(sp_axes):
+            size = x.shape[ax]
+            k, s = kernel[i], stride[i]
+            lo, hi = pads[i]
+            out = -(-(size + lo + hi - k) // s) + 1
+            need = (out - 1) * s + k - (size + lo + hi)
+            j = ax
+            newpad[j] = (lo, hi + max(need, 0))
+        padcfg = newpad
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, dims, strides, padcfg)
+    # avg pool
+    ones = jnp.ones_like(x)
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padcfg)
+    if exclusive:
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padcfg)
+    else:
+        cnt = float(np.prod(kernel))
+    return s / cnt
+
+
+@primitive("adaptive_pool2d_op")
+def adaptive_pool(x, *, output_size, pool_type="avg", channel_last=False):
+    nsp = x.ndim - 2
+    out_sizes = tuple(output_size)
+    sp_axes = tuple(range(1, 1 + nsp)) if channel_last else tuple(range(2, 2 + nsp))
+    # when input divides evenly, use a plain pool; else mean over index buckets
+    result = x
+    for i, ax in enumerate(sp_axes):
+        in_s, out_s = result.shape[ax], out_sizes[i]
+        if out_s is None or out_s == in_s:
+            continue
+        if in_s % out_s == 0:
+            k = in_s // out_s
+            shape = result.shape[:ax] + (out_s, k) + result.shape[ax + 1:]
+            r = result.reshape(shape)
+            result = jnp.max(r, axis=ax + 1) if pool_type == "max" else jnp.mean(r, axis=ax + 1)
+        else:
+            starts = (np.arange(out_s) * in_s) // out_s
+            ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+            pieces = []
+            for s0, e0 in zip(starts, ends):
+                seg = lax.slice_in_dim(result, int(s0), int(e0), axis=ax)
+                red = jnp.max(seg, axis=ax, keepdims=True) if pool_type == "max" \
+                    else jnp.mean(seg, axis=ax, keepdims=True)
+                pieces.append(red)
+            result = jnp.concatenate(pieces, axis=ax)
+    return result
+
+
+@primitive("unfold_op")
+def unfold(x, *, kernel_sizes, strides=(1, 1), paddings=(0, 0), dilations=(1, 1)):
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=tuple(strides),
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+        if len(paddings) == 2 else [(paddings[0], paddings[1]), (paddings[2], paddings[3])],
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")))
+    n2, ckk, oh, ow = patches.shape
+    return patches.reshape(n2, ckk, oh * ow)
+
+
+# ---------------------------------------------------------------------------
+# normalization (reference batch_norm_op.cu, layer_norm_op.cu, group_norm)
+
+
+@primitive("layer_norm_op")
+def layer_norm(x, weight, bias, *, epsilon=1e-5, begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@primitive("batch_norm_infer")
+def batch_norm_infer(x, weight, bias, mean, var, *, epsilon=1e-5,
+                     channel_last=False):
+    shape = ((1,) * (x.ndim - 1) + (-1,)) if channel_last \
+        else ((1, -1) + (1,) * (x.ndim - 2))
+    inv = lax.rsqrt(var.reshape(shape) + epsilon)
+    y = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+@primitive("batch_norm_train")
+def batch_norm_train(x, weight, bias, *, epsilon=1e-5, channel_last=False):
+    """Returns (y, batch_mean, batch_var); running stats updated by the Layer
+    (functional style — the reference mutates mean/var in-kernel)."""
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (x.ndim - 1 if channel_last else 1))
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+    shape = ((1,) * (x.ndim - 1) + (-1,)) if channel_last \
+        else ((1, -1) + (1,) * (x.ndim - 2))
+    inv = lax.rsqrt(var.reshape(shape) + epsilon)
+    y = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y, mean, var
+
+
+@primitive("instance_norm_op")
+def instance_norm(x, weight, bias, *, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + epsilon)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+@primitive("group_norm_op")
+def group_norm(x, weight, bias, *, num_groups, epsilon=1e-5,
+               channel_last=False):
+    if channel_last:
+        x_t = jnp.moveaxis(x, -1, 1)
+    else:
+        x_t = x
+    n, c = x_t.shape[:2]
+    g = num_groups
+    xr = x_t.reshape((n, g, c // g) + x_t.shape[2:])
+    axes = tuple(range(2, xr.ndim))
+    mean = jnp.mean(xr, axis=axes, keepdims=True)
+    var = jnp.var(xr, axis=axes, keepdims=True)
+    y = ((xr - mean) * lax.rsqrt(var + epsilon)).reshape(x_t.shape)
+    shape = (1, -1) + (1,) * (x_t.ndim - 2)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    if channel_last:
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+@primitive("l2_normalize_op")
+def normalize(x, *, p=2.0, axis=1, epsilon=1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+@primitive("local_response_norm_op")
+def local_response_norm(x, *, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    padded = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2))
+    acc = sum(lax.slice_in_dim(padded, i, i + c, axis=1) for i in range(size))
+    return x / jnp.power(k + alpha * acc / size, beta)
+
+
+# ---------------------------------------------------------------------------
+# dropout (functional PRNG — key threaded by dispatch wrapper)
+
+
+@primitive("dropout_op")
+def _dropout(x, key, *, p=0.5, mode="upscale_in_train"):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0)
+    return jnp.where(mask, x, 0.0)
+
+
+@primitive("alpha_dropout_op")
+def _alpha_dropout(x, key, *, p=0.5):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + alpha_p**2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return a * jnp.where(mask, x, alpha_p) + b
+
+
+# ---------------------------------------------------------------------------
+# embedding (reference lookup_table_v2_op)
+
+
+@primitive("lookup_table_v2")
+def embedding_lookup(weight, ids, *, padding_idx=None):
+    out = jnp.take(weight, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+@primitive("one_hot_v2", nondiff=True)
+def one_hot(x, *, num_classes):
+    return jax.nn.one_hot(x.astype(jnp.int32), num_classes)
+
+
+# ---------------------------------------------------------------------------
+# losses (reference softmax_with_cross_entropy_op.cu, bce ops, etc.)
+
+
+@primitive("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(logits, label, *, soft_label=False,
+                               ignore_index=-100, axis=-1):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label.astype(jnp.int32)
+        if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis=axis)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.clip(lab, 0, None), axis), axis=axis)
+        loss = -picked
+        if ignore_index >= 0 or True:
+            mask = jnp.expand_dims(lab == ignore_index, axis)
+            loss = jnp.where(mask, 0.0, loss)
+    return loss
+
+
+@primitive("bce_loss_op")
+def bce_loss(input, label):
+    eps = 1e-12
+    x = jnp.clip(input, eps, 1.0 - eps)
+    return -(label * jnp.log(x) + (1.0 - label) * jnp.log1p(-x))
+
+
+@primitive("bce_with_logits_op")
+def bce_with_logits(logit, label, pos_weight=None):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1.0 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1.0 - label) * logit + max_val + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+    return loss
+
+
+@primitive("kldiv_loss_op")
+def kldiv_loss(x, target):
+    safe_t = jnp.where(target > 0, target, 1.0)
+    return jnp.where(target > 0, target * (jnp.log(safe_t) - x), 0.0)
+
+
+@primitive("huber_loss_op")
+def huber_loss(input, label, *, delta=1.0):
+    r = jnp.abs(input - label)
+    return jnp.where(r <= delta, 0.5 * r * r, delta * (r - 0.5 * delta))
+
+
+@primitive("smooth_l1_op")
+def smooth_l1(input, label, *, delta=1.0):
+    r = jnp.abs(input - label)
+    return jnp.where(r < delta, 0.5 * r * r / delta, r - 0.5 * delta)
+
+
+@primitive("nll_loss_op")
+def nll_loss(log_prob, label, *, ignore_index=-100):
+    lab = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(log_prob, jnp.clip(lab, 0, None)[:, None], axis=1)[:, 0]
+    loss = -picked
+    return jnp.where(lab == ignore_index, 0.0, loss)
+
+
+@primitive("margin_ranking_loss_op")
+def margin_ranking_loss(input, other, label, *, margin=0.0):
+    return jnp.clip(-label * (input - other) + margin, 0, None)
+
+
+@primitive("cosine_similarity_op")
+def cosine_similarity(x1, x2, *, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@primitive("hinge_embedding_loss_op")
+def hinge_embedding_loss(input, label, *, margin=1.0):
+    return jnp.where(label == 1.0, input,
+                     jnp.clip(margin - input, 0, None))
+
+
+@primitive("square_error_cost_op")
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@primitive("label_smooth_op")
+def label_smooth(label, *, epsilon=0.1):
+    k = label.shape[-1]
+    return (1.0 - epsilon) * label + epsilon / k
+
+
+# ---------------------------------------------------------------------------
+# interpolate / vision-adjacent
+
+
+@primitive("interp_op")
+def interpolate(x, *, size, mode="nearest", align_corners=False,
+                channel_last=False):
+    nsp = x.ndim - 2
+    size = tuple(size)
+    if channel_last:
+        new_shape = (x.shape[0],) + size + (x.shape[-1],)
+        sp_axes = tuple(range(1, 1 + nsp))
+    else:
+        new_shape = x.shape[:2] + size
+        sp_axes = tuple(range(2, 2 + nsp))
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if align_corners and method != "nearest":
+        out = x
+        for i, ax in enumerate(sp_axes):
+            in_s, out_s = x.shape[ax], size[i]
+            idx = jnp.linspace(0.0, in_s - 1, out_s)
+            lo = jnp.floor(idx).astype(jnp.int32)
+            hi = jnp.clip(lo + 1, 0, in_s - 1)
+            w = (idx - lo).reshape((-1,) + (1,) * (out.ndim - ax - 1))
+            a = jnp.take(out, lo, axis=ax)
+            b = jnp.take(out, hi, axis=ax)
+            out = a * (1 - w) + b * w
+        return out
+    return jax.image.resize(x, new_shape, method=method)
+
+
+@primitive("pixel_shuffle_op")
+def pixel_shuffle(x, *, upscale_factor, channel_last=False):
+    r = upscale_factor
+    if channel_last:
+        n, h, w, c = x.shape
+        out = x.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return out.reshape(n, c // (r * r), h * r, w * r)
+
+
+@primitive("channel_shuffle_op")
+def channel_shuffle(x, *, groups, channel_last=False):
+    if channel_last:
+        n, h, w, c = x.shape
+        out = x.reshape(n, h, w, groups, c // groups)
+        return jnp.swapaxes(out, -1, -2).reshape(n, h, w, c)
+    n, c, h, w = x.shape
+    out = x.reshape(n, groups, c // groups, h, w)
+    return jnp.swapaxes(out, 1, 2).reshape(n, c, h, w)
+
+
+@primitive("pad2d_zero_op")
+def zero_pad(x, *, padding, channel_last=False):
+    l, r, t, b = padding
+    if channel_last:
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+    return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))

@@ -1,0 +1,149 @@
+"""Random ops over the functional global RNG (reference:
+gaussian_random_op.cc, uniform_random_op.cc, randint_op, randperm_op,
+bernoulli_op, multinomial_op in /root/reference/paddle/fluid/operators/)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import primitive
+from ..framework.dtype import get_default_dtype, to_np
+from ..framework.random import RNG
+from ..framework.tensor import Tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().tolist())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.numpy())
+                 for s in shape)
+
+
+@primitive("gaussian_random", nondiff=True)
+def _randn(key, *, shape, mean=0.0, std=1.0, dtype="float32"):
+    return mean + std * jax.random.normal(key, shape, to_np(dtype))
+
+
+def randn(shape, dtype=None, name=None):
+    return _randn(RNG.next_key(), shape=_shape(shape),
+                  dtype=dtype or get_default_dtype())
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ()))
+        r = _randn(RNG.next_key(), shape=tuple(shp), dtype=get_default_dtype())
+        return Tensor(m + s * r._data, _internal=True)
+    return _randn(RNG.next_key(), shape=_shape(shape if shape is not None else [1]),
+                  mean=float(mean), std=float(std), dtype=get_default_dtype())
+
+
+@primitive("uniform_random", nondiff=True)
+def _rand(key, *, shape, min=0.0, max=1.0, dtype="float32"):
+    return jax.random.uniform(key, shape, to_np(dtype), min, max)
+
+
+def rand(shape, dtype=None, name=None):
+    return _rand(RNG.next_key(), shape=_shape(shape),
+                 dtype=dtype or get_default_dtype())
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else RNG.next_key()
+    return _rand(key, shape=_shape(shape), min=float(min), max=float(max),
+                 dtype=dtype or get_default_dtype())
+
+
+@primitive("randint_op", nondiff=True)
+def _randint(key, *, low, high, shape, dtype="int64"):
+    return jax.random.randint(key, shape, low, high, to_np(dtype))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return _randint(RNG.next_key(), low=int(low), high=int(high),
+                    shape=_shape(shape), dtype=dtype or "int64")
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return _randint(RNG.next_key(), low=int(low), high=int(high),
+                    shape=tuple(x.shape), dtype=dtype or x.dtype.name)
+
+
+@primitive("randperm_op", nondiff=True)
+def _randperm(key, *, n, dtype="int64"):
+    return jax.random.permutation(key, n).astype(to_np(dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return _randperm(RNG.next_key(), n=int(n), dtype=dtype)
+
+
+@primitive("bernoulli_op", nondiff=True)
+def _bernoulli(x, key):
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+def bernoulli(x, name=None):
+    return _bernoulli(x, RNG.next_key())
+
+
+@primitive("multinomial_op", nondiff=True)
+def _multinomial(x, key, *, num_samples=1, replacement=False):
+    if x.ndim == 1:
+        return jax.random.choice(
+            key, x.shape[0], (num_samples,), replace=replacement,
+            p=x / jnp.sum(x)).astype(jnp.int64)
+    keys = jax.random.split(key, x.shape[0])
+    rows = [jax.random.choice(k, x.shape[1], (num_samples,),
+                              replace=replacement,
+                              p=x[i] / jnp.sum(x[i])).astype(jnp.int64)
+            for i, k in enumerate(keys)]
+    return jnp.stack(rows)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return _multinomial(x, RNG.next_key(), num_samples=int(num_samples),
+                        replacement=bool(replacement))
+
+
+@primitive("poisson_op", nondiff=True)
+def _poisson(x, key):
+    return jax.random.poisson(key, x).astype(x.dtype)
+
+
+def poisson(x, name=None):
+    return _poisson(x, RNG.next_key())
+
+
+@primitive("exponential_op", nondiff=True)
+def _exponential(x, key, *, lam=1.0):
+    return (jax.random.exponential(key, x.shape) / lam).astype(x.dtype)
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = _exponential(x, RNG.next_key(), lam=float(lam))
+    x._data = out._data
+    return x
+
+
+def rand_like(x, dtype=None):
+    return _rand(RNG.next_key(), shape=tuple(x.shape),
+                 dtype=dtype or x.dtype.name)
+
+
+def randn_like(x, dtype=None):
+    return _randn(RNG.next_key(), shape=tuple(x.shape),
+                  dtype=dtype or x.dtype.name)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)

@@ -1,0 +1,499 @@
+"""Optimizers.
+
+TPU-native equivalent of the reference's python/paddle/optimizer/*.py over
+operators/optimizers/*. Each optimizer's update rule is ONE jitted jax
+function applied per parameter — XLA fuses the elementwise update chain; the
+LR comes in as an argument so schedulers never retrigger compilation.
+Accumulators (moments etc.) live as device arrays keyed by parameter, the
+analogue of the reference's _create_accumulators machinery
+(/root/reference/python/paddle/optimizer/optimizer.py)."""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import state
+from ..framework.tensor import Parameter, Tensor
+from .lr import LRScheduler
+from . import lr  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# grad clip (reference: python/paddle/fluid/clip.py)
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        return [(p, jnp.clip(g, self.min, self.max)) for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for p, g in params_grads
+                 if getattr(p, "need_clip", True))
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(p, g * scale if getattr(p, "need_clip", True) else g)
+                for p, g in params_grads]
+
+
+# regularizers (reference: fluid/regularizer.py)
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, p, g):
+        return g + self.coeff * p
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, p, g):
+        return g + self.coeff * jnp.sign(p)
+
+
+# ---------------------------------------------------------------------------
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py Optimizer with
+    _create_accumulators / _append_optimize_op; here: _update is a pure jax
+    fn (param, grad, lr, *accumulators) -> (new_param, *new_accumulators))."""
+
+    _accumulator_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        if weight_decay is None:
+            self._regularization = None
+        elif isinstance(weight_decay, (float, int)):
+            self._regularization = L2Decay(float(weight_decay))
+        else:
+            self._regularization = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
+        self._step_count = 0
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # -- accumulators --------------------------------------------------------
+    def _get_accumulators(self, p: Parameter):
+        acc = self._accumulators.get(id(p))
+        if acc is None:
+            acc = self._create_accumulators(p)
+            self._accumulators[id(p)] = acc
+        return acc
+
+    def _create_accumulators(self, p: Parameter):
+        return {name: jnp.zeros_like(p._data)
+                for name in self._accumulator_names}
+
+    # -- the update ----------------------------------------------------------
+    def _per_param_static_args(self, p):
+        """Hashable hyperparameter tuple for this parameter (hook for
+        per-param weight-decay exemptions à la AdamW/Lamb)."""
+        return self._static_args()
+
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        params_grads = []
+        for p in params:
+            if not getattr(p, "trainable", True) or p.stop_gradient:
+                continue
+            if p._grad is None:
+                continue
+            g = p._grad._data
+            if self._regularization is not None and getattr(p, "regularizer", None) is None:
+                g = self._regularization(p._data, g)
+            elif getattr(p, "regularizer", None) is not None:
+                g = p.regularizer(p._data, g)
+            params_grads.append((p, g))
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            accs = self._get_accumulators(p)
+            param_lr = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+            self._apply_one(p, g, lr * param_lr, accs)
+
+    def _apply_one(self, p, g, lr, accs):
+        names = self._accumulator_names
+        fn = _update_exec(self._rule_cls(p), self._per_param_static_args(p))
+        out = fn(p._data, g, np.float32(lr), np.int32(self._step_count),
+                 *[accs[n] for n in names])
+        p._data = out[0]
+        for i, n in enumerate(names):
+            accs[n] = out[1 + i]
+
+    def _static_args(self):
+        """Hashable tuple of hyperparameters baked into the jitted update."""
+        return ()
+
+    def _rule_cls(self, p):
+        """Class whose _update_rule applies to this parameter."""
+        return type(self)
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, *accs):
+        raise NotImplementedError
+
+    # -- bookkeeping ---------------------------------------------------------
+    def clear_grad(self, set_to_zero=True):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Dygraph: backward + step (reference: optimizer.minimize)."""
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        sd = {}
+        for p, accs in self._iter_named_accumulators():
+            for name, arr in accs.items():
+                sd[f"{p.name}_{name}"] = Tensor(arr, _internal=True)
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def _iter_named_accumulators(self):
+        if not self._parameter_list:
+            return
+        for p in self._parameter_list:
+            accs = self._accumulators.get(id(p))
+            if accs:
+                yield p, accs
+
+    def set_state_dict(self, state_dict):
+        sched = state_dict.get("LR_Scheduler")
+        if sched and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(sched)
+        if not self._parameter_list:
+            return
+        for p in self._parameter_list:
+            accs = self._get_accumulators(p)
+            for name in list(accs):
+                key = f"{p.name}_{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    accs[name] = jnp.asarray(
+                        v.numpy() if isinstance(v, Tensor) else v)
+
+    set_dict = set_state_dict
+
+
+@functools.lru_cache(maxsize=None)
+def _update_exec(cls, static_args):
+    rule = cls._update_rule
+
+    def fn(param, grad, lr, t, *accs):
+        return rule(static_args, param, grad, lr, t, *accs)
+
+    return jax.jit(fn, donate_argnums=(0,) + tuple(range(4, 4 + len(cls._accumulator_names))))
+
+
+# ---------------------------------------------------------------------------
+# concrete optimizers (update rules mirror the reference's
+# operators/optimizers/*.cc kernels)
+
+
+class SGD(Optimizer):
+    _accumulator_names = []
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t):
+        g = grad.astype(param.dtype)
+        return (param - lr * g,)
+
+
+class Momentum(Optimizer):
+    _accumulator_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = float(momentum)
+        self._nesterov = bool(use_nesterov)
+
+    def _static_args(self):
+        return (self._momentum, self._nesterov)
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, velocity):
+        mu, nesterov = static_args
+        g = grad.astype(param.dtype)
+        v = mu * velocity + g
+        if nesterov:
+            new_p = param - lr * (g + mu * v)
+        else:
+            new_p = param - lr * v
+        return new_p, v
+
+
+class Adam(Optimizer):
+    _accumulator_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _static_args(self):
+        return (self._beta1, self._beta2, self._epsilon)
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, m1, m2):
+        b1, b2, eps = static_args
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        m1n = b1 * m1 + (1 - b1) * g
+        m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        c1 = 1 - jnp.power(jnp.float32(b1), tf)
+        c2 = 1 - jnp.power(jnp.float32(b2), tf)
+        step = lr * (m1n / c1) / (jnp.sqrt(m2n / c2) + eps)
+        return (p32 - step).astype(param.dtype), m1n, m2n
+
+    def _create_accumulators(self, p):
+        return {n: jnp.zeros(p._data.shape, jnp.float32)
+                for n in self._accumulator_names}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._coeff = float(weight_decay) if not callable(weight_decay) else weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _static_args(self):
+        return (self._beta1, self._beta2, self._epsilon, self._coeff)
+
+    def _decay_applies(self, p):
+        return (self._apply_decay_param_fun is None
+                or self._apply_decay_param_fun(p.name))
+
+    def _per_param_static_args(self, p):
+        if self._decay_applies(p):
+            return (self._beta1, self._beta2, self._epsilon, self._coeff)
+        return (self._beta1, self._beta2, self._epsilon)
+
+    def _rule_cls(self, p):
+        return AdamW if self._decay_applies(p) else Adam
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, m1, m2):
+        b1, b2, eps, coeff = static_args
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        p32 = p32 * (1.0 - lr * coeff)
+        m1n = b1 * m1 + (1 - b1) * g
+        m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        c1 = 1 - jnp.power(jnp.float32(b1), tf)
+        c2 = 1 - jnp.power(jnp.float32(b2), tf)
+        step = lr * (m1n / c1) / (jnp.sqrt(m2n / c2) + eps)
+        return (p32 - step).astype(param.dtype), m1n, m2n
+
+
+class Adamax(Optimizer):
+    _accumulator_names = ["moment", "inf_norm"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def _static_args(self):
+        return (self._beta1, self._beta2, self._epsilon)
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, m, u):
+        b1, b2, eps = static_args
+        g = grad.astype(param.dtype)
+        mn = b1 * m + (1 - b1) * g
+        un = jnp.maximum(b2 * u, jnp.abs(g))
+        tf = t.astype(jnp.float32)
+        c1 = 1 - jnp.power(jnp.float32(b1), tf)
+        return param - lr / c1 * mn / (un + eps), mn, un
+
+
+class Adagrad(Optimizer):
+    _accumulator_names = ["moment"]
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = float(epsilon)
+        self._init_val = float(initial_accumulator_value)
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.full(p._data.shape, self._init_val, jnp.float32)}
+
+    def _static_args(self):
+        return (self._epsilon,)
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, moment):
+        (eps,) = static_args
+        g = grad.astype(jnp.float32)
+        mn = moment + jnp.square(g)
+        return (param.astype(jnp.float32) - lr * g / (jnp.sqrt(mn) + eps)
+                ).astype(param.dtype), mn
+
+
+class Adadelta(Optimizer):
+    _accumulator_names = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = float(epsilon), float(rho)
+
+    def _static_args(self):
+        return (self._epsilon, self._rho)
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, sq_g, sq_u):
+        eps, rho = static_args
+        g = grad.astype(jnp.float32)
+        sq_gn = rho * sq_g + (1 - rho) * jnp.square(g)
+        upd = -jnp.sqrt((sq_u + eps) / (sq_gn + eps)) * g
+        sq_un = rho * sq_u + (1 - rho) * jnp.square(upd)
+        return (param.astype(jnp.float32) + lr * upd).astype(param.dtype), sq_gn, sq_un
+
+
+class RMSProp(Optimizer):
+    _accumulator_names = ["mean_square", "mean_grad", "momentum_acc"]
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = float(rho), float(epsilon)
+        self._momentum, self._centered = float(momentum), bool(centered)
+
+    def _static_args(self):
+        return (self._rho, self._epsilon, self._momentum, self._centered)
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, ms, mg, mom):
+        rho, eps, mu, centered = static_args
+        g = grad.astype(jnp.float32)
+        msn = rho * ms + (1 - rho) * jnp.square(g)
+        if centered:
+            mgn = rho * mg + (1 - rho) * g
+            denom = msn - jnp.square(mgn) + eps
+        else:
+            mgn = mg
+            denom = msn + eps
+        momn = mu * mom + lr * g / jnp.sqrt(denom)
+        return (param.astype(jnp.float32) - momn).astype(param.dtype), msn, mgn, momn
+
+
+class Lamb(Optimizer):
+    _accumulator_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2 = float(beta1), float(beta2)
+        self._epsilon = float(epsilon)
+        self._lamb_wd = float(lamb_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _static_args(self):
+        return (self._beta1, self._beta2, self._epsilon, self._lamb_wd)
+
+    def _per_param_static_args(self, p):
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        return (self._beta1, self._beta2, self._epsilon, wd)
+
+    def _create_accumulators(self, p):
+        return {n: jnp.zeros(p._data.shape, jnp.float32)
+                for n in self._accumulator_names}
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, m1, m2):
+        b1, b2, eps, wd = static_args
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        m1n = b1 * m1 + (1 - b1) * g
+        m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        mhat = m1n / (1 - jnp.power(jnp.float32(b1), tf))
+        vhat = m2n / (1 - jnp.power(jnp.float32(b2), tf))
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * ratio * r).astype(param.dtype), m1n, m2n

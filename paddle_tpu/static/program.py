@@ -1,0 +1,10 @@
+"""Static-graph Program IR — staging stub for phase 3 (SURVEY §7 step 3).
+
+`stage_op` is the hook dispatch calls in static mode; until the Program IR
+lands it returns NotImplemented so ops execute eagerly even under
+enable_static (correct semantics, no graph capture yet)."""
+from __future__ import annotations
+
+
+def stage_op(prim, args, attrs):
+    return NotImplemented

@@ -1,0 +1,515 @@
+"""Public tensor-op API surface (reference: python/paddle/tensor/) and the
+Tensor method/dunder patching (reference pattern:
+python/paddle/fluid/dygraph/varbase_patch_methods.py and math_op_patch.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor, Parameter, to_tensor
+from ..framework import dispatch as _dispatch
+from ..ops import math as _m
+from ..ops import creation as _c
+from ..ops import manipulation as _mp
+from ..ops import nn_ops as _nn
+from ..ops import random_ops as _r
+from ..ops import linalg as _la
+
+# ---- re-exports -----------------------------------------------------------
+# math
+add = _m.add
+subtract = _m.subtract
+multiply = _m.multiply
+divide = _m.divide
+floor_divide = _m.floor_divide
+remainder = _m.remainder
+mod = _m.remainder
+floor_mod = _m.remainder
+maximum = _m.maximum
+minimum = _m.minimum
+fmax = _m.fmax
+fmin = _m.fmin
+atan2 = _m.atan2
+neg = _m.neg
+abs = _m.abs_  # noqa: A001
+sign = _m.sign
+exp = _m.exp
+expm1 = _m.expm1
+log = _m.log
+log2 = _m.log2
+log10 = _m.log10
+log1p = _m.log1p
+sqrt = _m.sqrt
+rsqrt = _m.rsqrt
+square = _m.square
+reciprocal = _m.reciprocal
+sin = _m.sin
+cos = _m.cos
+tan = _m.tan
+asin = _m.asin
+acos = _m.acos
+atan = _m.atan
+sinh = _m.sinh
+cosh = _m.cosh
+asinh = _m.asinh
+acosh = _m.acosh
+atanh = _m.atanh
+ceil = _m.ceil
+floor = _m.floor
+round = _m.round_  # noqa: A001
+trunc = _m.trunc
+frac = _m.frac
+erf = _m.erf
+erfinv = _m.erfinv
+lgamma = _m.lgamma
+digamma = _m.digamma
+angle = _m.angle
+conj = _m.conj
+real = _m.real
+imag = _m.imag
+isnan = _m.isnan
+isinf = _m.isinf
+isfinite = _m.isfinite
+stanh = _m.stanh
+logit = _m.logit
+nan_to_num = _m.nan_to_num
+multiplex = _m.multiplex
+lerp = _m.lerp
+diff = _m.diff
+rad2deg = _m.rad2deg
+deg2rad = _m.deg2rad
+gcd = _m.gcd
+lcm = _m.lcm
+heaviside = _m.heaviside
+trapezoid = _m.trapezoid
+increment = _m.increment
+_identity = _m._identity
+
+tanh = _nn.tanh
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return _m.pow_(x, y)
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    if isinstance(min, Tensor) or isinstance(max, Tensor):
+        lo = min if min is not None else float(np.finfo(np.float32).min)
+        hi = max if max is not None else float(np.finfo(np.float32).max)
+        return _m._clip_dynamic(x, lo, hi)
+    return _m.clip(x, min=float(min) if min is not None else None,
+                   max=float(max) if max is not None else None)
+
+
+# matmul family
+matmul = _m.matmul
+dot = _m.dot
+addmm = _m.addmm
+outer = _m.outer
+inner = _m.inner
+cross = _m.cross
+bmm = _m.bmm
+mv = _m.mv
+kron = _m.kron
+mm = _m.matmul
+
+# reductions
+sum = _m.sum_  # noqa: A001
+mean = _m.mean
+max = _m.max_  # noqa: A001
+min = _m.min_  # noqa: A001
+prod = _m.prod
+any = _m.any_  # noqa: A001
+all = _m.all_  # noqa: A001
+logsumexp = _m.logsumexp
+amax = _m.amax
+amin = _m.amin
+nanmean = _m.nanmean
+nansum = _m.nansum
+std = _m.std
+var = _m.var
+median = _m.median
+nanmedian = _m.median
+cumsum = _m.cumsum
+cumprod = _m.cumprod
+logcumsumexp = _m.logcumsumexp
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return _m.quantile(x, q=q, axis=axis, keepdim=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    from ..ops.manipulation import cast
+    nz = _m.not_equal(x, _c.zeros([1], x.dtype.name))
+    return _m.sum_(cast(nz, "int64"), axis=axis, keepdim=keepdim)
+
+
+# comparisons
+equal = _m.equal
+not_equal = _m.not_equal
+greater_than = _m.greater_than
+greater_equal = _m.greater_equal
+less_than = _m.less_than
+less_equal = _m.less_equal
+logical_and = _m.logical_and
+logical_or = _m.logical_or
+logical_xor = _m.logical_xor
+logical_not = _m.logical_not
+bitwise_and = _m.bitwise_and
+bitwise_or = _m.bitwise_or
+bitwise_xor = _m.bitwise_xor
+bitwise_not = _m.bitwise_not
+isclose = _m.isclose
+allclose = _m.allclose
+equal_all = _m.equal_all
+
+# search
+argmax = _m.argmax
+argmin = _m.argmin
+argsort = _m.argsort
+sort = _m.sort
+where = _m.where
+masked_select = _m.masked_select
+nonzero = _m.nonzero
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.numpy())
+    return _m.topk(x, k=int(k), axis=int(axis), largest=largest, sorted=sorted)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    vals = _m.sort(x, axis=axis)
+    idx = _m.argsort(x, axis=axis)
+    from ..ops.manipulation import _slice as slice_prim
+    ax = axis % x.ndim
+    v = slice_prim(vals, axes=(ax,), starts=(k - 1,), ends=(k,))
+    i = slice_prim(idx, axes=(ax,), starts=(k - 1,), ends=(k,))
+    if not keepdim:
+        v = _mp.squeeze(v, axis=ax)
+        i = _mp.squeeze(i, axis=ax)
+    return v, i
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import jax.numpy as jnp
+    data = x.numpy()
+    vals = np.take_along_axis(
+        data, np.expand_dims(np.argmax(
+            np.apply_along_axis(lambda a: np.bincount(
+                np.searchsorted(np.unique(a), a)), axis, data), axis), axis),
+        axis)
+    raise NotImplementedError("paddle_tpu.mode: planned")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = x.numpy()
+    out = np.unique(a, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return to_tensor(out)
+    res = [to_tensor(out[0])]
+    for extra in out[1:]:
+        res.append(to_tensor(extra.astype(np.int64)))
+    return tuple(res)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _mp.index_select(x, index, axis=axis)
+
+
+index_sample = _mp.index_sample
+take_along_axis = _mp.take_along_axis
+put_along_axis = _mp.put_along_axis
+
+# creation
+full = _c.full
+zeros = _c.zeros
+ones = _c.ones
+full_like = _c.full_like
+zeros_like = _c.zeros_like
+ones_like = _c.ones_like
+arange = _c.arange
+linspace = _c.linspace
+logspace = _c.logspace
+eye = _c.eye
+tril = _c.tril
+triu = _c.triu
+diag = _c.diag
+diagflat = _c.diagflat
+diag_embed = _c.diag_embed
+diagonal = _c.diagonal
+meshgrid = _c.meshgrid
+empty = _c.empty
+empty_like = _c.empty_like
+clone = _c.clone
+assign = _c.assign
+
+# manipulation
+cast = _mp.cast
+reshape = _mp.reshape
+transpose = _mp.transpose
+t = _mp.t
+flatten = _mp.flatten
+squeeze = _mp.squeeze
+unsqueeze = _mp.unsqueeze
+concat = _mp.concat
+stack = _mp.stack
+unstack = _mp.unstack
+split = _mp.split
+chunk = _mp.chunk
+slice = _mp.slice  # noqa: A001
+strided_slice = _mp.strided_slice
+gather = _mp.gather
+gather_nd = _mp.gather_nd
+scatter = _mp.scatter
+scatter_nd = _mp.scatter_nd
+scatter_nd_add = _mp.scatter_nd_add
+tile = _mp.tile
+expand = _mp.expand
+expand_as = _mp.expand_as
+broadcast_to = _mp.broadcast_to
+broadcast_tensors = _mp.broadcast_tensors
+flip = _mp.flip
+roll = _mp.roll
+rot90 = _mp.rot90
+repeat_interleave = _mp.repeat_interleave
+moveaxis = _mp.moveaxis
+as_complex = _mp.as_complex
+as_real = _mp.as_real
+unbind = _mp.unbind
+shard_index = _mp.shard_index
+
+
+def numel(x, name=None):
+    return to_tensor(np.int64(x.size))
+
+
+def shape(x):
+    return to_tensor(np.asarray(x.shape, dtype=np.int32))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return x.dtype.is_complex
+
+
+def is_integer(x):
+    return x.dtype.is_integer
+
+
+def is_floating_point(x):
+    return x.dtype.is_floating
+
+
+def rank(x):
+    return to_tensor(np.int32(x.ndim))
+
+
+# random
+randn = _r.randn
+rand = _r.rand
+normal = _r.normal
+uniform = _r.uniform
+randint = _r.randint
+randint_like = _r.randint_like
+randperm = _r.randperm
+bernoulli = _r.bernoulli
+multinomial = _r.multinomial
+poisson = _r.poisson
+standard_normal = _r.standard_normal
+
+# linalg
+norm = _la.norm
+cholesky = _la.cholesky
+cholesky_solve = _la.cholesky_solve
+inverse = _la.inverse
+matrix_power = _la.matrix_power
+det = _la.det
+slogdet = _la.slogdet
+svd = _la.svd
+qr = _la.qr
+lu = _la.lu
+eig = _la.eig
+eigh = _la.eigh
+eigvals = _la.eigvals
+eigvalsh = _la.eigvalsh
+matrix_rank = _la.matrix_rank
+solve = _la.solve
+triangular_solve = _la.triangular_solve
+lstsq = _la.lstsq
+multi_dot = _la.multi_dot
+histogram = _la.histogram
+bincount = _la.bincount
+trace = _la.trace
+einsum = _la.einsum
+pinv = _la.pinv
+corrcoef = _la.corrcoef
+cov = _la.cov
+cosine_similarity = _nn.cosine_similarity
+
+# "math" namespace module also needed by framework.tensor.clone
+from . import math  # noqa: E402,F401  (defined in math.py re-export module)
+
+
+# ---------------------------------------------------------------------------
+# Tensor method patching
+
+
+def _scalar_or_tensor(v):
+    if isinstance(v, Tensor):
+        return v
+    return v  # python scalars pass straight to jnp
+
+
+def _patch():
+    import jax.numpy as jnp
+
+    T = Tensor
+
+    def _binary(fn, reverse=False):
+        def method(self, other):
+            other = _scalar_or_tensor(other)
+            if reverse:
+                return fn(other, self)
+            return fn(self, other)
+        return method
+
+    T.__add__ = _binary(_m.add)
+    T.__radd__ = _binary(_m.add, True)
+    T.__sub__ = _binary(_m.subtract)
+    T.__rsub__ = _binary(_m.subtract, True)
+    T.__mul__ = _binary(_m.multiply)
+    T.__rmul__ = _binary(_m.multiply, True)
+    T.__truediv__ = _binary(_m.divide)
+    T.__rtruediv__ = _binary(_m.divide, True)
+    T.__floordiv__ = _binary(_m.floor_divide)
+    T.__rfloordiv__ = _binary(_m.floor_divide, True)
+    T.__mod__ = _binary(_m.remainder)
+    T.__rmod__ = _binary(_m.remainder, True)
+    T.__pow__ = _binary(_m.pow_)
+    T.__rpow__ = _binary(_m.pow_, True)
+    T.__matmul__ = _binary(_m.matmul)
+    T.__rmatmul__ = _binary(_m.matmul, True)
+    T.__neg__ = lambda self: _m.neg(self)
+    T.__abs__ = lambda self: _m.abs_(self)
+    T.__invert__ = lambda self: _m.logical_not(self)
+
+    T.__eq__ = _binary(_m.equal)
+    T.__ne__ = _binary(_m.not_equal)
+    T.__lt__ = _binary(_m.less_than)
+    T.__le__ = _binary(_m.less_equal)
+    T.__gt__ = _binary(_m.greater_than)
+    T.__ge__ = _binary(_m.greater_equal)
+    T.__and__ = _binary(_m.logical_and)
+    T.__or__ = _binary(_m.logical_or)
+    T.__xor__ = _binary(_m.logical_xor)
+
+    def _getitem(self, index):
+        if isinstance(index, Tensor):
+            if index.dtype == "bool":
+                return _m.masked_select(self, index)
+            return _mp._getitem_dyn(self, index._data,
+                                    index_template=("__arr__",))
+        def norm_item(i):
+            if isinstance(i, Tensor):
+                return "__arr__"
+            if isinstance(i, np.ndarray):
+                return "__arr__"
+            if isinstance(i, (list, tuple)):
+                return "__arr__"
+            return i
+        if isinstance(index, tuple):
+            tmpl = tuple(norm_item(i) for i in index)
+            if "__arr__" in tmpl:
+                arrays = []
+                for i in index:
+                    if isinstance(i, Tensor):
+                        arrays.append(i._data)
+                    elif isinstance(i, (np.ndarray, list)):
+                        arrays.append(jnp.asarray(i))
+                return _mp._getitem_dyn(self, *arrays, index_template=tmpl)
+            return _mp._getitem(self, index=tmpl)
+        if isinstance(index, (list, np.ndarray)):
+            return _mp._getitem_dyn(self, jnp.asarray(np.asarray(index)),
+                                    index_template=("__arr__",))
+        return _mp._getitem(self, index=index)
+
+    T.__getitem__ = _getitem
+
+    def _setitem(self, index, value):
+        v = value._data if isinstance(value, Tensor) else value
+        if isinstance(index, Tensor):
+            index = np.asarray(index.numpy())
+        self._data = self._data.at[index].set(v)
+        return self
+
+    T.__setitem__ = _setitem
+
+    # named methods (subset large enough for the API tests; grows over time)
+    method_map = {
+        "add": _m.add, "subtract": _m.subtract, "multiply": _m.multiply,
+        "divide": _m.divide, "floor_divide": _m.floor_divide,
+        "remainder": _m.remainder, "mod": _m.remainder, "pow": pow,
+        "maximum": _m.maximum, "minimum": _m.minimum,
+        "matmul": _m.matmul, "dot": _m.dot, "mm": _m.matmul, "bmm": _m.bmm,
+        "abs": _m.abs_, "neg": _m.neg, "sign": _m.sign,
+        "exp": _m.exp, "log": _m.log, "log2": _m.log2, "log10": _m.log10,
+        "log1p": _m.log1p, "sqrt": _m.sqrt, "rsqrt": _m.rsqrt,
+        "square": _m.square, "reciprocal": _m.reciprocal,
+        "sin": _m.sin, "cos": _m.cos, "tan": _m.tan, "tanh": _nn.tanh,
+        "asin": _m.asin, "acos": _m.acos, "atan": _m.atan,
+        "ceil": _m.ceil, "floor": _m.floor, "round": _m.round_,
+        "trunc": _m.trunc, "erf": _m.erf, "lgamma": _m.lgamma,
+        "isnan": _m.isnan, "isinf": _m.isinf, "isfinite": _m.isfinite,
+        "clip": clip,
+        "sum": _m.sum_, "mean": _m.mean, "max": _m.max_, "min": _m.min_,
+        "prod": _m.prod, "any": _m.any_, "all": _m.all_,
+        "std": _m.std, "var": _m.var, "median": _m.median,
+        "logsumexp": _m.logsumexp, "cumsum": _m.cumsum, "cumprod": _m.cumprod,
+        "argmax": _m.argmax, "argmin": _m.argmin, "argsort": _m.argsort,
+        "sort": _m.sort, "topk": topk, "nonzero": _m.nonzero,
+        "equal": _m.equal, "not_equal": _m.not_equal,
+        "greater_than": _m.greater_than, "greater_equal": _m.greater_equal,
+        "less_than": _m.less_than, "less_equal": _m.less_equal,
+        "logical_and": _m.logical_and, "logical_or": _m.logical_or,
+        "logical_not": _m.logical_not, "logical_xor": _m.logical_xor,
+        "isclose": _m.isclose, "allclose": _m.allclose,
+        "equal_all": _m.equal_all,
+        "reshape": reshape, "transpose": transpose, "flatten": flatten,
+        "squeeze": squeeze, "unsqueeze": unsqueeze, "split": split,
+        "chunk": chunk, "gather": gather, "gather_nd": gather_nd,
+        "scatter": scatter, "tile": tile, "expand": expand,
+        "expand_as": expand_as, "broadcast_to": broadcast_to,
+        "flip": flip, "roll": roll, "unbind": unbind, "unstack": unstack,
+        "index_select": index_select, "masked_select": masked_select,
+        "where": _m.where, "norm": norm, "trace": _la.trace,
+        "cholesky": _la.cholesky, "inverse": _la.inverse,
+        "matrix_power": _la.matrix_power, "det": _la.det,
+        "cross": _m.cross, "outer": _m.outer, "inner": _m.inner,
+        "kron": _m.kron, "diagonal": _c.diagonal, "tril": _c.tril,
+        "triu": _c.triu, "lerp": _m.lerp, "kthvalue": kthvalue,
+        "bincount": _la.bincount, "histogram": _la.histogram,
+        "repeat_interleave": repeat_interleave,
+        "unique": unique, "cast": cast,
+    }
+    for name, fn in method_map.items():
+        if not hasattr(T, name):
+            setattr(T, name, (lambda f: lambda self, *a, **k: f(self, *a, **k))(fn))
+
+    @property
+    def T_prop(self):
+        if self.ndim < 2:
+            return self
+        return transpose(self, list(range(self.ndim))[::-1])
+
+    T.T = T_prop
+
+
+_patch()

@@ -1,0 +1,3 @@
+"""Re-export module mirroring python/paddle/tensor/manipulation.py."""
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.manipulation import cast, reshape, transpose, concat, split  # noqa: F401

@@ -1,0 +1,4 @@
+"""Re-export module so `paddle_tpu.tensor.math` mirrors the reference's
+python/paddle/tensor/math.py namespace."""
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.math import _identity, sum_, mean, max_, min_, abs_, pow_, round_  # noqa: F401

@@ -1,0 +1,3 @@
+from . import transforms
+from . import datasets
+from . import models

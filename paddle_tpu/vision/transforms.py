@@ -1,0 +1,205 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/) —
+numpy/host-side preprocessing feeding the device pipeline."""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad", "RandomResizedCrop", "BrightnessTransform",
+           "Grayscale"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+def _to_hwc(img):
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    return a
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        a = _to_hwc(img).astype(np.float32)
+        if a.dtype == np.float32 and a.max() > 1.5:
+            a = a / 255.0
+        if self.data_format == "CHW":
+            a = a.transpose(2, 0, 1)
+        return Tensor(a)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        a = img.numpy() if isinstance(img, Tensor) else np.asarray(img, np.float32)
+        n = a.shape[0] if self.data_format == "CHW" else a.shape[-1]
+        mean = self.mean[:n]
+        std = self.std[:n]
+        if self.data_format == "CHW":
+            out = (a - mean[:, None, None]) / std[:, None, None]
+        else:
+            out = (a - mean) / std
+        return Tensor(out.astype(np.float32)) if isinstance(img, Tensor) else out.astype(np.float32)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _to_hwc(img).transpose(self.order)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        a = _to_hwc(img)
+        h, w = self.size
+        # simple bilinear via jax.image on host numpy
+        import jax.image
+        out = np.asarray(jax.image.resize(
+            a.astype(np.float32), (h, w, a.shape[2]), method="linear"))
+        return out.astype(a.dtype) if a.dtype == np.uint8 else out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        a = _to_hwc(img)
+        th, tw = self.size
+        h, w = a.shape[:2]
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return a[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        a = _to_hwc(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, int) else self.padding[0]
+            a = np.pad(a, ((p, p), (p, p), (0, 0)))
+        th, tw = self.size
+        h, w = a.shape[:2]
+        i = random.randint(0, max(h - th, 0))
+        j = random.randint(0, max(w - tw, 0))
+        return a[i:i + th, j:j + tw]
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        a = _to_hwc(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * random.uniform(*self.scale)
+            ar = random.uniform(*self.ratio)
+            tw = int(round(np.sqrt(target_area * ar)))
+            th = int(round(np.sqrt(target_area / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = random.randint(0, h - th)
+                j = random.randint(0, w - tw)
+                a = a[i:i + th, j:j + tw]
+                break
+        return Resize(self.size)._apply_image(a)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        a = _to_hwc(img)
+        if random.random() < self.prob:
+            return a[:, ::-1].copy()
+        return a
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        a = _to_hwc(img)
+        if random.random() < self.prob:
+            return a[::-1].copy()
+        return a
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding if not isinstance(padding, int) else (padding,) * 4
+        self.fill = fill
+
+    def _apply_image(self, img):
+        a = _to_hwc(img)
+        l, t, r, b = (self.padding * 2)[:4] if len(self.padding) == 2 else self.padding
+        return np.pad(a, ((t, b), (l, r), (0, 0)), constant_values=self.fill)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        a = _to_hwc(img).astype(np.float32)
+        factor = 1 + random.uniform(-self.value, self.value)
+        return np.clip(a * factor, 0, 255).astype(np.uint8)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        a = _to_hwc(img).astype(np.float32)
+        g = a.mean(axis=2, keepdims=True)
+        return np.repeat(g, self.n, axis=2).astype(np.uint8)

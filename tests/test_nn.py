@@ -1,0 +1,150 @@
+"""nn.Layer system + layer zoo tests (reference test style:
+unittests/test_layers.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear_shapes_and_params():
+    l = nn.Linear(4, 8)
+    x = paddle.randn([2, 4])
+    y = l(x)
+    assert y.shape == [2, 8]
+    names = dict(l.named_parameters())
+    assert set(names) == {"weight", "bias"}
+    assert names["weight"].shape == [4, 8]
+
+
+def test_sequential_and_state_dict():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = net.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net2.set_state_dict(sd)
+    x = paddle.randn([3, 4])
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_conv_bn_pool_stack():
+    net = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1),
+        nn.BatchNorm2D(8),
+        nn.ReLU(),
+        nn.MaxPool2D(2, 2),
+    )
+    x = paddle.randn([2, 3, 16, 16])
+    y = net(x)
+    assert y.shape == [2, 8, 8, 8]
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm2D(4, momentum=0.9)
+    x = paddle.randn([8, 4, 5, 5]) * 3 + 1
+    bn.train()
+    bn(x)
+    assert abs(float(bn._mean.numpy().mean()) - 0.1) < 0.5  # moved off 0
+    bn.eval()
+    y = bn(x)
+    assert y.shape == [8, 4, 5, 5]
+
+
+def test_layernorm_matches_numpy():
+    ln = nn.LayerNorm(6)
+    x = np.random.randn(3, 6).astype(np.float32)
+    y = ln(paddle.to_tensor(x)).numpy()
+    mu = x.mean(-1, keepdims=True)
+    sd = x.std(-1, keepdims=True)
+    np.testing.assert_allclose(y, (x - mu) / np.sqrt(sd**2 + 1e-5), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    y = d(x)
+    assert 0.3 < float((y.numpy() == 0).mean()) < 0.7
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+def test_train_eval_propagates():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    net.train()
+    assert net[1].training
+
+
+def test_layer_list_and_dict():
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll.parameters())) == 6
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld
+
+
+def test_parameter_grad_flow_through_layer():
+    l = nn.Linear(3, 1)
+    x = paddle.randn([4, 3])
+    loss = paddle.mean(l(x))
+    loss.backward()
+    assert l.weight.grad is not None
+    assert l.weight.grad.shape == [3, 1]
+
+
+def test_forward_hooks():
+    l = nn.Linear(2, 2)
+    calls = []
+    h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    l(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    l(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_loss_layers():
+    logits = paddle.randn([4, 3])
+    labels = paddle.to_tensor(np.array([0, 1, 2, 1]))
+    ce = nn.CrossEntropyLoss()
+    loss = ce(logits, labels)
+    assert loss.shape == []
+    mse = nn.MSELoss()
+    a, b = paddle.randn([3]), paddle.randn([3])
+    np.testing.assert_allclose(mse(a, b).numpy(),
+                               ((a.numpy() - b.numpy())**2).mean(), rtol=1e-5)
+
+
+def test_activations_shapes():
+    x = paddle.randn([2, 3])
+    for layer in [nn.ReLU(), nn.GELU(), nn.Sigmoid(), nn.Tanh(),
+                  nn.LeakyReLU(), nn.Softmax(), nn.Hardswish(), nn.Silu()]:
+        assert layer(x).shape == [2, 3]
+
+
+def test_conv_transpose():
+    ct = nn.Conv2DTranspose(4, 8, 3, stride=2, padding=1, output_padding=1)
+    x = paddle.randn([1, 4, 8, 8])
+    y = ct(x)
+    assert y.shape == [1, 8, 16, 16]
+
+
+def test_adaptive_pool():
+    p = nn.AdaptiveAvgPool2D(1)
+    x = paddle.randn([2, 3, 7, 9])
+    assert p(x).shape == [2, 3, 1, 1]
+
+
+def test_group_instance_norm():
+    x = paddle.randn([2, 8, 4, 4])
+    assert nn.GroupNorm(4, 8)(x).shape == [2, 8, 4, 4]
+    assert nn.InstanceNorm2D(8)(x).shape == [2, 8, 4, 4]

@@ -1,0 +1,122 @@
+"""Optimizer + LR scheduler tests (reference:
+unittests/test_adam_op.py / test_momentum_op.py / test_lr_scheduler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _quadratic_converges(opt_cls, lr=0.1, steps=60, tol=0.15, **kw):
+    paddle.seed(0)
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    w = paddle.framework.Parameter(np.zeros(3, np.float32))
+    opt = opt_cls(learning_rate=lr, parameters=[w], **kw)
+    for _ in range(steps):
+        loss = paddle.sum((w - paddle.to_tensor(target)) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.abs(w.numpy() - target).max() < tol, w.numpy()
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (optimizer.SGD, {}),
+    (optimizer.Momentum, {}),
+    (optimizer.Adam, {"steps": 120}),
+    (optimizer.AdamW, {"steps": 120}),
+    (optimizer.RMSProp, {}),
+    (optimizer.Adagrad, {"lr": 0.9}),
+    (optimizer.Adamax, {"lr": 0.3}),
+    (optimizer.Lamb, {"lr": 0.1, "lamb_weight_decay": 0.0, "steps": 300,
+                      "tol": 0.1}),
+    (optimizer.Adadelta, {"lr": 8.0, "steps": 300, "tol": 0.5}),
+])
+def test_optimizer_converges(cls, kw):
+    kw = dict(kw)
+    lr = kw.pop("lr", 0.1)
+    steps = kw.pop("steps", 60)
+    tol = kw.pop("tol", 0.15)
+    _quadratic_converges(cls, lr=lr, steps=steps, tol=tol, **kw)
+
+
+def test_adam_matches_reference_formula():
+    """One Adam step vs hand-computed update (reference adam_op kernel)."""
+    w0 = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.1, -0.2], np.float32)
+    w = paddle.framework.Parameter(w0.copy())
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[w])
+    w._grad = paddle.to_tensor(g)
+    opt.step()
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    expect = w0 - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), expect, rtol=1e-5)
+
+
+def test_weight_decay_l2():
+    w = paddle.framework.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w], weight_decay=0.5)
+    w._grad = paddle.to_tensor(np.array([0.0], np.float32))
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-6)
+
+
+def test_grad_clip_global_norm():
+    w1 = paddle.framework.Parameter(np.zeros(2, np.float32))
+    w2 = paddle.framework.Parameter(np.zeros(2, np.float32))
+    clip = optimizer.ClipGradByGlobalNorm(1.0)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w1, w2],
+                        grad_clip=clip)
+    w1._grad = paddle.to_tensor(np.array([3.0, 0.0], np.float32))
+    w2._grad = paddle.to_tensor(np.array([0.0, 4.0], np.float32))
+    opt.step()
+    # global norm 5 → scaled by 1/5
+    np.testing.assert_allclose(w1.numpy(), [-0.6, 0.0], rtol=1e-5)
+    np.testing.assert_allclose(w2.numpy(), [0.0, -0.8], rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.framework.Parameter(np.ones(3, np.float32))
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[w])
+    w._grad = paddle.to_tensor(np.ones(3, np.float32))
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=[w])
+    opt2.set_state_dict(sd)
+    k = f"{w.name}_moment1"
+    np.testing.assert_allclose(np.asarray(opt2._get_accumulators(w)["moment1"]),
+                               np.asarray(opt._get_accumulators(w)["moment1"]))
+
+
+def test_lr_schedulers():
+    lr = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(round(lr(), 6))
+        lr.step()
+    assert vals == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    cos = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(cos() - 1.0) < 1e-6
+    for _ in range(10):
+        cos.step()
+    assert cos() < 0.01
+
+    warm = optimizer.lr.LinearWarmup(0.5, warmup_steps=5, start_lr=0.0,
+                                     end_lr=0.5)
+    assert warm() == 0.0
+    for _ in range(5):
+        warm.step()
+    assert abs(warm() - 0.5) < 1e-9
+
+
+def test_scheduler_drives_optimizer():
+    sched = optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+    w = paddle.framework.Parameter(np.ones(1, np.float32))
+    opt = optimizer.SGD(learning_rate=sched, parameters=[w])
+    assert opt.get_lr() == 0.1
+    sched.step()
+    assert abs(opt.get_lr() - 0.01) < 1e-9

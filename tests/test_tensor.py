@@ -1,0 +1,164 @@
+"""Tensor surface tests (modeled on the reference's API unit tests,
+python/paddle/fluid/tests/unittests/test_*op*.py style: numpy parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    a = np.random.randn(3, 4).astype(np.float32)
+    t = paddle.to_tensor(a)
+    assert t.shape == [3, 4]
+    assert t.dtype == "float32"
+    np.testing.assert_array_equal(t.numpy(), a)
+
+
+def test_default_float64_downcast():
+    t = paddle.to_tensor([1.0, 2.0])
+    assert t.dtype == paddle.float32
+
+
+def test_arithmetic_matches_numpy():
+    a = np.random.randn(4, 5).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32) + 0.5
+    x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+    np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose((x - y).numpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose((x / y).numpy(), a / b, rtol=1e-5)
+    np.testing.assert_allclose((x ** 2).numpy(), a ** 2, rtol=1e-6)
+    np.testing.assert_allclose((-x).numpy(), -a)
+    np.testing.assert_allclose((x @ y.T).numpy(), a @ b.T, rtol=1e-4, atol=1e-5)
+
+
+def test_scalar_broadcast():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    np.testing.assert_allclose((x + 1).numpy(), [2, 3, 4])
+    np.testing.assert_allclose((2 * x).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((1 - x).numpy(), [0, -1, -2])
+
+
+def test_comparisons():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+    np.testing.assert_array_equal((x == y).numpy(), [False, True, False])
+
+
+def test_reductions():
+    a = np.random.randn(3, 4, 5).astype(np.float32)
+    x = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.sum(x).numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(x, axis=1).numpy(), a.mean(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(x, axis=[0, 2]).numpy(),
+                               a.max((0, 2)))
+    np.testing.assert_allclose(
+        paddle.sum(x, axis=1, keepdim=True).numpy(), a.sum(1, keepdims=True),
+        rtol=1e-5)
+
+
+def test_manipulation():
+    a = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    x = paddle.to_tensor(a)
+    assert paddle.reshape(x, [6, 4]).shape == [6, 4]
+    assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(x, 1).shape == [2, 12]
+    assert paddle.unsqueeze(x, 0).shape == [1, 2, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(x, 0), 0).shape == [2, 3, 4]
+    parts = paddle.split(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    c = paddle.concat(parts, axis=1)
+    np.testing.assert_array_equal(c.numpy(), a)
+    s = paddle.stack([x, x], axis=0)
+    assert s.shape == [2, 2, 3, 4]
+
+
+def test_indexing():
+    a = np.arange(20).reshape(4, 5).astype(np.float32)
+    x = paddle.to_tensor(a)
+    np.testing.assert_array_equal(x[1].numpy(), a[1])
+    np.testing.assert_array_equal(x[1:3, 2:].numpy(), a[1:3, 2:])
+    np.testing.assert_array_equal(x[:, -1].numpy(), a[:, -1])
+    idx = paddle.to_tensor(np.array([0, 2]))
+    np.testing.assert_array_equal(x[idx].numpy(), a[[0, 2]])
+    mask = x > 10
+    np.testing.assert_array_equal(x[mask].numpy(), a[a > 10])
+
+
+def test_setitem():
+    a = np.zeros((3, 3), np.float32)
+    x = paddle.to_tensor(a)
+    x[1] = 5.0
+    assert x.numpy()[1].tolist() == [5, 5, 5]
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    assert paddle.arange(5).dtype == "int64"
+    assert paddle.full([2], 7, "int32").numpy().tolist() == [7, 7]
+    e = paddle.eye(3)
+    np.testing.assert_array_equal(e.numpy(), np.eye(3, dtype=np.float32))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5, dtype=np.float32))
+
+
+def test_cast():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == "int32"
+    assert y.numpy().tolist() == [1, 2]
+
+
+def test_where_and_search():
+    a = np.random.randn(3, 4).astype(np.float32)
+    x = paddle.to_tensor(a)
+    np.testing.assert_array_equal(paddle.argmax(x, axis=1).numpy(),
+                                  a.argmax(1))
+    v, i = paddle.topk(x, k=2, axis=1)
+    np.testing.assert_allclose(v.numpy(), np.sort(a, 1)[:, ::-1][:, :2])
+    w = paddle.where(x > 0, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), np.where(a > 0, a, 0))
+
+
+def test_gather_scatter():
+    a = np.arange(12).reshape(4, 3).astype(np.float32)
+    x = paddle.to_tensor(a)
+    idx = paddle.to_tensor(np.array([0, 2]))
+    np.testing.assert_array_equal(paddle.gather(x, idx).numpy(), a[[0, 2]])
+    upd = paddle.to_tensor(np.ones((2, 3), np.float32))
+    out = paddle.scatter(x, idx, upd)
+    expect = a.copy()
+    expect[[0, 2]] = 1
+    np.testing.assert_array_equal(out.numpy(), expect)
+
+
+def test_linalg():
+    a = np.random.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    x = paddle.to_tensor(spd)
+    np.testing.assert_allclose(paddle.inverse(x).numpy(), np.linalg.inv(spd),
+                               rtol=1e-3, atol=1e-4)
+    L = paddle.cholesky(x)
+    np.testing.assert_allclose((L @ L.T).numpy(), spd, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.norm(x).numpy(),
+                               np.linalg.norm(spd), rtol=1e-5)
+
+
+def test_einsum():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_random_reproducible():
+    paddle.seed(123)
+    a = paddle.randn([4, 4]).numpy()
+    paddle.seed(123)
+    b = paddle.randn([4, 4]).numpy()
+    np.testing.assert_array_equal(a, b)
+    assert abs(paddle.rand([1000]).numpy().mean() - 0.5) < 0.05
