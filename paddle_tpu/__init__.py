@@ -47,6 +47,8 @@ from .hapi.model import Model  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from . import distributed  # noqa: F401
 from . import device  # noqa: F401
+from . import static  # noqa: F401
+from . import amp  # noqa: F401
 
 __version__ = "0.1.0"
 

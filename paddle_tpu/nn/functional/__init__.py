@@ -322,12 +322,28 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         return _nn.batch_norm_infer(x, weight, bias, running_mean, running_var,
                                     epsilon=float(epsilon),
                                     channel_last=channel_last)
+    from ...static.program import Variable as _StaticVar
+    if isinstance(x, _StaticVar) and running_mean is not None:
+        # static graph: stage the stats-emitting form; the executor writes
+        # the updated running stats back into the buffers after each run
+        y, nrm, nrv = _nn.batch_norm_train_stats(
+            x, weight, bias, running_mean, running_var,
+            momentum=float(momentum), epsilon=float(epsilon),
+            channel_last=channel_last)
+        prog = x.program
+        prog.buffer_updates.append((running_mean, nrm.name))
+        prog.buffer_updates.append((running_var, nrv.name))
+        return y
     y, bmean, bvar = _nn.batch_norm_train(x, weight, bias,
                                           epsilon=float(epsilon),
                                           channel_last=channel_last)
     # functional running-stat update (reference mutates in-kernel); under a
-    # trace this assigns tracers which the jit engine captures as outputs
-    if running_mean is not None:
+    # trace this assigns tracers which the jit engine captures as outputs.
+    # In static mode the batch stats are symbolic Variables — stat updates
+    # would need buffer outputs in the Program; skipped (the reference's
+    # static BN updates them via the op's MeanOut/VarianceOut).
+    from ...static.program import Variable as _StaticVar
+    if running_mean is not None and not isinstance(bmean, _StaticVar):
         import jax
         m = float(momentum)
         bm, bv = jax.lax.stop_gradient(bmean._data), jax.lax.stop_gradient(bvar._data)

@@ -121,6 +121,8 @@ class Layer:
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)
+        elif tensor is not None:
+            tensor.persistable = True
         return tensor
 
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,

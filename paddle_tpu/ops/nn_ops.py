@@ -380,6 +380,29 @@ def batch_norm_train(x, weight, bias, *, epsilon=1e-5, channel_last=False):
     return y, mean, var
 
 
+@primitive("batch_norm_train_stats")
+def batch_norm_train_stats(x, weight, bias, run_mean, run_var, *,
+                           momentum=0.9, epsilon=1e-5, channel_last=False):
+    """Training BN that also emits updated running stats — the static-graph
+    form (reference: batch_norm op's MeanOut/VarianceOut outputs)."""
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (x.ndim - 1 if channel_last else 1))
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+    shape = ((1,) * (x.ndim - 1) + (-1,)) if channel_last \
+        else ((1, -1) + (1,) * (x.ndim - 2))
+    inv = lax.rsqrt(var.reshape(shape) + epsilon)
+    y = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    m = momentum
+    new_rm = m * run_mean + (1 - m) * lax.stop_gradient(mean)
+    new_rv = m * run_var + (1 - m) * lax.stop_gradient(var)
+    return y, new_rm, new_rv
+
+
 @primitive("instance_norm_op")
 def instance_norm(x, weight, bias, *, epsilon=1e-5):
     axes = tuple(range(2, x.ndim))

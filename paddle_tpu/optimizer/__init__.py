@@ -196,7 +196,16 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        """Dygraph: backward + step (reference: optimizer.minimize)."""
+        """Dygraph: backward + step. Static: record the optimize directive
+        on the loss's Program; the Executor traces backward + update into
+        the compiled module (reference: optimizer.minimize appending
+        backward + optimizer ops into the ProgramDesc)."""
+        from ..static.program import Variable
+        if isinstance(loss, Variable):
+            loss.program.optimize_directive = (self, loss)
+            if self._parameter_list is None:
+                self._parameter_list = loss.program.all_parameters()
+            return None, None
         loss.backward()
         self.step()
         return None, None

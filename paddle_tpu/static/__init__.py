@@ -1,0 +1,72 @@
+"""paddle.static namespace (reference: python/paddle/static/)."""
+from __future__ import annotations
+
+from .program import (InputSpec, Program, Variable, data,
+                      default_main_program, default_startup_program,
+                      program_guard, reset_default_programs)
+from .executor import Executor, Scope, global_scope
+from . import io  # noqa: F401
+from .io import save_inference_model, load_inference_model, save, load  # noqa: F401
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """reference: fluid/backward.py:1406. In this design gradients are
+    produced by jax.value_and_grad over the compiled program, so
+    append_backward only marks the loss; Executor builds the actual
+    backward when an optimize directive (or grad fetch) is present."""
+    program = loss.program
+    program.backward_loss = loss
+    params = parameter_list or program.all_parameters()
+    return [(p, None) for p in params]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    raise NotImplementedError(
+        "static.gradients: fetch grads via optimizer directive in v1")
+
+
+class CompiledProgram:
+    """reference: fluid/compiler.py:88 CompiledProgram/with_data_parallel.
+    Programs always compile whole-module via XLA here, so this wrapper
+    exists for API parity and ignores build strategies."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        return self
+
+
+class BuildStrategy:
+    def __init__(self):
+        pass
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+
+
+def cpu_places(device_count=None):
+    from ..framework.place import CPUPlace
+    return [CPUPlace(0)]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.place import TPUPlace
+    import jax
+    n = len(jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+def device_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def name_scope(prefix=None):
+    import contextlib
+    return contextlib.nullcontext()

@@ -1,0 +1,117 @@
+"""Static-graph model persistence (reference:
+python/paddle/static/io.py:433 save_inference_model / :681
+load_inference_model). The saved artifact is the program's op list +
+captured parameter values (pickled); deployment inference reloads it into a
+compiled callable — the analogue of the reference's __model__ + params
+files consumed by AnalysisPredictor."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+def _program_payload(program, feed_vars, fetch_vars):
+    from .program import prune_ops
+    kept, needed = prune_ops(program.ops,
+                             {v.name for v in fetch_vars})
+    ops = [{"op_type": op.op_type, "fn_name": op.op_type,
+            "attrs": op.attrs, "in_refs": op.in_refs,
+            "out_names": op.out_names} for op in kept]
+    caps = {program.capture_names[i]: np.asarray(t._data)
+            for i, t in program.captured.items()
+            if program.capture_names[i] in needed}
+    return {
+        "ops": ops,
+        "captures": caps,
+        "feed_names": [v.name for v in feed_vars],
+        "fetch_names": [v.name for v in fetch_vars],
+    }
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    from .program import default_main_program
+    program = program or default_main_program()
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = _program_payload(program, feed_vars, fetch_vars)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({k: payload[k] for k in ("ops", "feed_names",
+                                             "fetch_names")}, f)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(payload["captures"], f)
+    return program
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_names, fetch_names) like the reference."""
+    from ..framework.dispatch import OPS
+    from .program import Program, Variable
+    import jax
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        caps = pickle.load(f)
+
+    program = Program()
+    cap_tensors = {}
+    for name, arr in caps.items():
+        t = Tensor(arr)
+        t.name = name
+        t.persistable = True
+        cap_tensors[name] = t
+        program.captured[id(t)] = t
+        program.capture_names[id(t)] = name
+    from .program import OpRecord
+    for rec in meta["ops"]:
+        prim = OPS[rec["op_type"]]
+        program.ops.append(OpRecord(rec["op_type"], prim.fn, rec["attrs"],
+                                    rec["in_refs"], rec["out_names"]))
+        program.version += 1
+    # reconstruct fetch/feed Variables with avals via a shape pass
+    env = {}
+    for name, t in cap_tensors.items():
+        env[name] = jax.ShapeDtypeStruct(tuple(t._data.shape), t._data.dtype)
+    feed_vars = []
+    # feed avals unknown until run; mark with placeholder scalar aval
+    for n in meta["feed_names"]:
+        v = Variable(program, n, jax.ShapeDtypeStruct((), np.float32),
+                     is_data=True)
+        program.vars[n] = v
+        program._feed_order.append(n)
+        feed_vars.append(v)
+    for op in program.ops:
+        for n in op.out_names:
+            program.vars.setdefault(
+                n, Variable(program, n, jax.ShapeDtypeStruct((), np.float32)))
+    return program, meta["feed_names"], meta["fetch_names"]
+
+
+def save(program, model_path, protocol=4):
+    # all persistables: trainables AND buffers (BN running stats etc. —
+    # the reference's save_persistables keeps both)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump({program.capture_names[i]: np.asarray(t._data)
+                     for i, t in program.captured.items()
+                     if not t.stop_gradient or t.persistable}, f,
+                    protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        values = pickle.load(f)
+    by_name = {program.capture_names[i]: t
+               for i, t in program.captured.items()}
+    for name, arr in values.items():
+        if name in by_name:
+            by_name[name].set_value(arr)

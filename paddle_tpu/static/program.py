@@ -1,10 +1,296 @@
-"""Static-graph Program IR — staging stub for phase 3 (SURVEY §7 step 3).
+"""Static-graph Program IR.
 
-`stage_op` is the hook dispatch calls in static mode; until the Program IR
-lands it returns NotImplemented so ops execute eagerly even under
-enable_static (correct semantics, no graph capture yet)."""
+TPU-native equivalent of the reference's ProgramDesc/BlockDesc/OpDesc
+(/root/reference/paddle/fluid/framework/framework.proto:234,210,189 and the
+python mirror fluid/framework.py:915-4392). Design difference (SURVEY §7):
+the reference interprets OpDescs one-by-one through a C++ executor; here the
+Program is a staged op list whose execution compiles the WHOLE program into
+one XLA module (the reference's closest analogue is the CINN bridge,
+paddle2cinn/cinn_compiler.h — here it's the only path, not an option).
+
+Staging: in static mode (paddle.enable_static()), every primitive call is
+intercepted (dispatch → stage_op) and recorded; output Variables carry
+avals inferred with jax.eval_shape — full shape inference for free, where
+the reference hand-writes per-op InferShape functions."""
 from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..framework import state
+from ..framework.dtype import convert_dtype, to_np
+from ..framework.tensor import Tensor
+
+_var_counter = [0]
+
+
+def _new_var_name(stem="var"):
+    _var_counter[0] += 1
+    return f"{stem}_{_var_counter[0]}"
+
+
+class Variable(Tensor):
+    """Symbolic tensor inside a Program (reference: fluid/framework.py
+    Variable:2201). `_data` holds a ShapeDtypeStruct, never a value."""
+
+    def __init__(self, program, name, aval, stop_gradient=True,
+                 is_data=False, dyn_axes=()):
+        super().__init__(aval, stop_gradient=stop_gradient, name=name,
+                         _internal=True)
+        self.program = program
+        self.is_data = is_data
+        self.dyn_axes = tuple(dyn_axes)
+        self.persistable = False
+
+    @property
+    def shape(self):
+        s = list(self._data.shape)
+        for a in self.dyn_axes:
+            s[a] = -1
+        return s
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable {self.name} has no value in static mode; run it "
+            "through Executor.run(fetch_list=[...])")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype.name})")
+
+
+class OpRecord:
+    """One staged op (reference: OpDesc, framework.proto:189)."""
+
+    __slots__ = ("fn", "attrs", "in_refs", "out_names", "op_type")
+
+    def __init__(self, op_type, fn, attrs, in_refs, out_names):
+        self.op_type = op_type
+        self.fn = fn
+        self.attrs = attrs
+        self.in_refs = in_refs      # list of ("var", name) | ("const", value)
+        self.out_names = out_names
+
+
+def prune_ops(ops, targets):
+    """Backward slice: keep only ops needed for `targets` (reference:
+    Executor prune, framework/executor.cc:372 / prune.cc)."""
+    needed = set(targets)
+    kept = []
+    for op in reversed(ops):
+        if any(n in needed for n in op.out_names):
+            kept.append(op)
+            for kind, ref in op.in_refs:
+                if kind in ("var", "cap"):
+                    needed.add(ref)
+    return list(reversed(kept)), needed
+
+
+class Program:
+    """reference: fluid/framework.py Program:4392. Single implicit block —
+    control flow uses lax.cond/scan expressions staged as ops, not
+    sub-blocks."""
+
+    def __init__(self):
+        self.ops: List[OpRecord] = []
+        self.vars: Dict[str, Variable] = {}
+        self.captured: Dict[int, Tensor] = {}   # id -> concrete Tensor (params)
+        self.capture_names: Dict[int, str] = {}
+        self.version = 0
+        self.optimize_directive = None  # (optimizer, loss_var)
+        self.rng_inputs: List[str] = []  # var names fed fresh PRNG keys/run
+        self.buffer_updates: List[Tuple[Tensor, str]] = []  # (buffer, var)
+        self._feed_order: List[str] = []
+
+    # -- reference-API surface ----------------------------------------------
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return [t for t in self.captured.values()
+                if getattr(t, "trainable", False) and not t.stop_gradient]
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def var(self, name):
+        return self.vars[name]
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.vars = dict(self.vars)
+        p.captured = dict(self.captured)
+        p.capture_names = dict(self.capture_names)
+        p.version = self.version
+        p._feed_order = list(self._feed_order)
+        p.rng_inputs = list(self.rng_inputs)
+        if not for_test:
+            p.ops = list(self.ops)
+            p.buffer_updates = list(self.buffer_updates)
+            return p
+        # for_test: strip train-only behavior (reference: clone(for_test)
+        # flips is_test on ops, fluid/framework.py Program.clone)
+        from ..ops.math import _identity
+        from ..ops.nn_ops import batch_norm_infer
+        for op in self.ops:
+            if op.op_type in ("dropout_op", "alpha_dropout_op"):
+                p.ops.append(OpRecord("identity", _identity.fn, {},
+                                      [op.in_refs[0]], [op.out_names[0]]))
+            elif op.op_type == "batch_norm_train_stats":
+                # same leading inputs (x, w, b, rm, rv); keep y only
+                attrs = {k: v for k, v in op.attrs.items()
+                         if k in ("epsilon", "channel_last")}
+                p.ops.append(OpRecord("batch_norm_infer", batch_norm_infer.fn,
+                                      attrs, list(op.in_refs[:5]),
+                                      [op.out_names[0]]))
+            else:
+                p.ops.append(op)
+        p.version += 1
+        return p
+
+    def __repr__(self):
+        lines = [f"Program({len(self.ops)} ops)"]
+        for op in self.ops:
+            ins = ", ".join(r[1] if r[0] == "var" else repr(r[1])[:20]
+                            for r in op.in_refs)
+            lines.append(f"  {', '.join(op.out_names)} = {op.op_type}({ins})")
+        return "\n".join(lines)
+
+    # -- staging -------------------------------------------------------------
+    def _capture(self, t: Tensor) -> str:
+        if id(t) not in self.captured:
+            name = t.name or _new_var_name("capture")
+            self.captured[id(t)] = t
+            self.capture_names[id(t)] = name
+        return self.capture_names[id(t)]
+
+    @staticmethod
+    def _is_prng_key(a) -> bool:
+        return (isinstance(a, jax.Array) and a.ndim == 1 and a.shape[0] == 2
+                and str(a.dtype) == "uint32")
+
+    def add_op(self, op_type, fn, args, attrs):
+        in_refs = []
+        in_avals = []
+        dyn_batch = False
+        for a in args:
+            if isinstance(a, Variable):
+                in_refs.append(("var", a.name))
+                in_avals.append(a._data)
+                if 0 in a.dyn_axes:
+                    dyn_batch = True
+            elif isinstance(a, Tensor):
+                name = self._capture(a)
+                in_refs.append(("cap", name))
+                in_avals.append(jax.ShapeDtypeStruct(tuple(a._data.shape),
+                                                     a._data.dtype))
+            elif self._is_prng_key(a):
+                # fresh randomness per run: PRNG keys become executor-fed
+                # inputs, not baked constants (reference: static random ops
+                # draw from the per-device generator each run)
+                name = _new_var_name("rng_key")
+                self.rng_inputs.append(name)
+                in_refs.append(("var", name))
+                in_avals.append(jax.ShapeDtypeStruct((2,), a.dtype))
+            else:
+                in_refs.append(("const", a))
+                in_avals.append(a)
+        out_avals = jax.eval_shape(lambda *xs: fn(*xs, **attrs), *in_avals)
+        single = not isinstance(out_avals, tuple)
+        outs_t = (out_avals,) if single else out_avals
+        out_names = [_new_var_name(op_type) for _ in outs_t]
+        rec = OpRecord(op_type, fn, attrs, in_refs, out_names)
+        self.ops.append(rec)
+        self.version += 1
+        stop = all(not isinstance(a, Variable) or a.stop_gradient
+                   for a in args) and not any(
+            isinstance(a, Tensor) and not isinstance(a, Variable)
+            and not a.stop_gradient for a in args)
+        out_vars = []
+        for n, av in zip(out_names, outs_t):
+            dyn = (0,) if (dyn_batch and len(av.shape) >= 1
+                           and av.shape[0] == 1) else ()
+            v = Variable(self, n, av, stop_gradient=stop, dyn_axes=dyn)
+            self.vars[n] = v
+            out_vars.append(v)
+        return out_vars[0] if single else tuple(out_vars)
+
+
+# -- global program state (reference: fluid/framework.py program stack) -----
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def reset_default_programs():
+    global _main_program, _startup_program
+    _main_program = Program()
+    _startup_program = Program()
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev_m, prev_s = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev_m, prev_s
 
 
 def stage_op(prim, args, attrs):
-    return NotImplemented
+    """Hook called from dispatch in static mode. Returns NotImplemented to
+    fall back to eager execution when no symbolic input is involved and the
+    op is a pure creation op (constants fold at build time)."""
+    program = _main_program
+    has_var = any(isinstance(a, Variable) for a in args)
+    # ops touching trainable parameters must stage too — folding them
+    # eagerly would detach a derived copy from the real parameter and
+    # gradients would update the copy
+    touches_param = any(isinstance(a, Tensor) and not isinstance(a, Variable)
+                        and not a.stop_gradient for a in args)
+    if not has_var and not touches_param:
+        # creation/init ops on concrete values: run eagerly (constant fold);
+        # they enter the program as captures when later consumed.
+        return NotImplemented
+    if prim.dynamic:
+        raise RuntimeError(
+            f"op {prim.name} has data-dependent output shape and cannot be "
+            "staged into a static Program (reference analogue: ops without "
+            "static InferShape). Compute it eagerly or use masks.")
+    return program.add_op(prim.name, prim.fn, args, attrs)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """reference: paddle.static.data (static/input.py). -1 dims are dynamic:
+    shape inference uses 1, run-time compilation uses the fed shape."""
+    program = _main_program
+    shape = list(shape)
+    dyn_axes = [i for i, s in enumerate(shape) if s in (-1, None)]
+    concrete = tuple(1 if s in (-1, None) else int(s) for s in shape)
+    aval = jax.ShapeDtypeStruct(concrete, to_np(dtype))
+    v = Variable(program, name, aval, stop_gradient=True, is_data=True,
+                 dyn_axes=dyn_axes)
+    program.vars[name] = v
+    program._feed_order.append(name)
+    return v
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name

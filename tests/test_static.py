@@ -1,0 +1,216 @@
+"""Static-graph Program/Executor tests (reference test style:
+unittests/test_executor_and_mul.py, book/test_fit_a_line.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    static.reset_default_programs()
+    yield
+    paddle.disable_static()
+    static.reset_default_programs()
+
+
+def test_feed_fetch_roundtrip():
+    x = static.data("x", [2, 3], "float32")
+    y = x * 2.0 + 1.0
+    exe = static.Executor()
+    a = np.random.randn(2, 3).astype(np.float32)
+    (out,) = exe.run(feed={"x": a}, fetch_list=[y])
+    np.testing.assert_allclose(out, a * 2 + 1, rtol=1e-6)
+
+
+def test_program_repr_and_vars():
+    x = static.data("x", [4], "float32")
+    y = paddle.exp(x)
+    prog = static.default_main_program()
+    assert len(prog.ops) == 1
+    assert y.name in prog.vars
+    assert "exp" in repr(prog)
+
+
+def test_static_layer_forward():
+    x = static.data("x", [5, 4], "float32")
+    lin = nn.Linear(4, 3)
+    y = lin(x)
+    assert y.shape == [5, 3]
+    exe = static.Executor()
+    a = np.random.randn(5, 4).astype(np.float32)
+    (out,) = exe.run(feed={"x": a}, fetch_list=[y])
+    expect = a @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_batch_dim():
+    x = static.data("x", [-1, 4], "float32")
+    assert x.shape == [-1, 4]
+    lin = nn.Linear(4, 2)
+    y = lin(x)
+    exe = static.Executor()
+    for bs in (3, 7):
+        a = np.random.randn(bs, 4).astype(np.float32)
+        (out,) = exe.run(feed={"x": a}, fetch_list=[y])
+        assert out.shape == (bs, 2)
+
+
+def test_static_training_minimize():
+    paddle.seed(0)
+    x = static.data("x", [-1, 3], "float32")
+    y = static.data("y", [-1, 1], "float32")
+    lin = nn.Linear(3, 1)
+    pred = lin(x)
+    loss = paddle.mean((pred - y) ** 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    rng = np.random.RandomState(0)
+    a = rng.randn(32, 3).astype(np.float32)
+    w_true = rng.randn(3, 1).astype(np.float32)
+    b = (a @ w_true).astype(np.float32)
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(feed={"x": a, "y": b}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.05
+    np.testing.assert_allclose(lin.weight.numpy(), w_true, atol=0.2)
+
+
+def test_static_matches_dygraph_loss():
+    """Same init, same data → same first-step loss in both modes."""
+    a = np.random.randn(8, 4).astype(np.float32)
+    b = np.random.randn(8, 1).astype(np.float32)
+
+    paddle.disable_static()
+    paddle.seed(7)
+    lin_d = nn.Linear(4, 1)
+    loss_d = float(paddle.mean((lin_d(paddle.to_tensor(a)) -
+                                paddle.to_tensor(b)) ** 2).numpy())
+
+    paddle.enable_static()
+    static.reset_default_programs()
+    paddle.seed(7)
+    x = static.data("x", [8, 4], "float32")
+    y = static.data("y", [8, 1], "float32")
+    lin_s = nn.Linear(4, 1)
+    loss = paddle.mean((lin_s(x) - y) ** 2)
+    exe = static.Executor()
+    (loss_s,) = exe.run(feed={"x": a, "y": b}, fetch_list=[loss])
+    np.testing.assert_allclose(loss_d, float(loss_s), rtol=1e-5)
+
+
+def test_program_guard_isolated():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x + 1.0
+    assert len(main.ops) == 1
+    assert len(static.default_main_program().ops) == 0
+    exe = static.Executor()
+    (out,) = exe.run(main, feed={"x": np.zeros(2, np.float32)},
+                     fetch_list=[y])
+    np.testing.assert_allclose(out, [1, 1])
+
+
+def test_save_load_inference_model(tmp_path):
+    x = static.data("x", [4, 3], "float32")
+    lin = nn.Linear(3, 2)
+    y = nn.functional.softmax(lin(x))
+    exe = static.Executor()
+    prefix = str(tmp_path / "infer")
+    static.save_inference_model(prefix, [x], [y], exe)
+
+    static.reset_default_programs()
+    prog, feeds, fetches = static.load_inference_model(prefix, exe)
+    a = np.random.randn(4, 3).astype(np.float32)
+    (out,) = exe.run(prog, feed={feeds[0]: a}, fetch_list=fetches)
+    assert out.shape == (4, 2)
+    logits = a @ lin.weight.numpy() + lin.bias.numpy()
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    expect = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_static_conv_model():
+    x = static.data("img", [2, 1, 8, 8], "float32")
+    conv = nn.Conv2D(1, 4, 3, padding=1)
+    pool = nn.MaxPool2D(2)
+    out = pool(nn.functional.relu(conv(x)))
+    assert out.shape == [2, 4, 4, 4]
+    exe = static.Executor()
+    (r,) = exe.run(feed={"img": np.random.randn(2, 1, 8, 8).astype(np.float32)},
+                   fetch_list=[out])
+    assert r.shape == (2, 4, 4, 4)
+
+
+def test_static_dropout_fresh_randomness():
+    paddle.seed(5)
+    x = static.data("x", [1000], "float32")
+    y = nn.functional.dropout(x, 0.5, training=True)
+    exe = static.Executor()
+    a = np.ones(1000, np.float32)
+    (o1,) = exe.run(feed={"x": a}, fetch_list=[y])
+    (o2,) = exe.run(feed={"x": a}, fetch_list=[y])
+    assert (o1 == 0).any() and (o2 == 0).any()
+    assert not np.array_equal(o1, o2), "dropout mask must differ per run"
+
+
+def test_clone_for_test_strips_dropout():
+    x = static.data("x", [8], "float32")
+    y = nn.functional.dropout(x, 0.9, training=True)
+    test_prog = static.default_main_program().clone(for_test=True)
+    exe = static.Executor()
+    a = np.ones(8, np.float32)
+    (out,) = exe.run(test_prog, feed={"x": a}, fetch_list=[y])
+    np.testing.assert_array_equal(out, a)
+
+
+def test_static_bn_running_stats_update():
+    x = static.data("x", [16, 4], "float32")
+    bn = nn.BatchNorm1D(4, momentum=0.5)
+    y = bn(x)
+    loss = paddle.mean(y)
+    exe = static.Executor()
+    a = (np.random.randn(16, 4) * 2 + 3).astype(np.float32)
+    exe.run(feed={"x": a}, fetch_list=[loss])
+    assert abs(float(bn._mean.numpy().mean())) > 0.5, \
+        "running mean should move toward batch mean"
+
+
+def test_static_optimizer_respects_param_subset():
+    x = static.data("x", [4, 3], "float32")
+    frozen = nn.Linear(3, 3)
+    head = nn.Linear(3, 1)
+    loss = paddle.mean(head(frozen(x)) ** 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=head.parameters())
+    opt.minimize(loss)
+    w_frozen = frozen.weight.numpy().copy()
+    w_head = head.weight.numpy().copy()
+    exe = static.Executor()
+    exe.run(feed={"x": np.random.randn(4, 3).astype(np.float32)},
+            fetch_list=[loss])
+    np.testing.assert_array_equal(frozen.weight.numpy(), w_frozen)
+    assert not np.array_equal(head.weight.numpy(), w_head)
+
+
+def test_static_param_expression_trains_source_param():
+    """w * mask staged (not folded) so grads reach the real parameter."""
+    x = static.data("x", [4, 2], "float32")
+    w = paddle.framework.Parameter(np.ones((2, 1), np.float32))
+    mask = paddle.to_tensor(np.array([[1.0], [0.0]], np.float32))
+    pred = paddle.matmul(x, w * mask)
+    loss = paddle.mean(pred ** 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    opt.minimize(loss)
+    exe = static.Executor()
+    w0 = w.numpy().copy()
+    exe.run(feed={"x": np.random.randn(4, 2).astype(np.float32)},
+            fetch_list=[loss])
+    assert not np.array_equal(w.numpy(), w0), "source parameter must update"
