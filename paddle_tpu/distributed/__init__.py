@@ -1,13 +1,29 @@
-"""Distributed package — phase-5 per SURVEY §7. This module grows into the
-Fleet-equivalent; for now it provides env/rank facts used by samplers."""
+"""paddle.distributed parity surface.
+
+TPU-native distributed stack (SURVEY §2.4): collectives are XLA collectives
+over mesh axes (collective.py), topology is one hybrid jax Mesh
+(fleet/topology.py), bootstrap is jax.distributed (env.py), and the fleet
+facade mirrors the reference's (fleet/__init__.py).
+reference: /root/reference/python/paddle/distributed/__init__.py
+"""
 from __future__ import annotations
 
-import os
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
+                  local_device_count)
+from .collective import (ReduceOp, Group, all_gather, all_reduce, alltoall,
+                         barrier, broadcast, destroy_process_group,
+                         get_group, is_initialized, new_group, recv, reduce,
+                         reduce_scatter, scatter, send, wait)
+from .parallel import DataParallel, sync_params_buffers
+from .utils import global_gather, global_scatter
+from . import fleet
+from .spawn import spawn
 
-
-def get_rank(group=None):
-    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-
-
-def get_world_size(group=None):
-    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+__all__ = [
+    "ParallelEnv", "get_rank", "get_world_size", "init_parallel_env",
+    "local_device_count", "ReduceOp", "Group", "all_gather", "all_reduce",
+    "alltoall", "barrier", "broadcast", "destroy_process_group", "get_group",
+    "is_initialized", "new_group", "recv", "reduce", "reduce_scatter",
+    "scatter", "send", "wait", "DataParallel", "sync_params_buffers",
+    "global_gather", "global_scatter", "fleet", "spawn",
+]

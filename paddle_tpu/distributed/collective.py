@@ -1,0 +1,486 @@
+"""Collective communication API over mesh axes.
+
+TPU-native equivalent of the reference's collective surface
+(/root/reference/python/paddle/distributed/collective.py:167-1525) and the
+132-file c_* operator family
+(/root/reference/paddle/fluid/operators/collective/ — c_allreduce_op.h:356,
+c_broadcast, c_allgather, c_reducescatter, alltoall, send_v2/recv_v2,
+barrier, global_scatter/gather). The reference keys NCCL communicators by
+ring_id (platform/collective_helper.h:68); here a **Group is a named axis of
+a jax.sharding.Mesh** and every collective compiles to the matching XLA
+collective (psum / all_gather / ppermute / all_to_all) riding ICI.
+
+Two execution contexts, one API:
+
+* **traced** (Tensor wraps a jax Tracer, i.e. we are inside a shard_map
+  region spanning the group's axis — how compiled hybrid-parallel programs
+  run): collectives lower directly to jax.lax primitives.
+* **eager** (concrete arrays): single-controller SPMD has no per-rank
+  processes, so a "per-rank tensor" is a global array whose leading dim is
+  the rank dim, sharded over the group's devices. Collectives run a tiny
+  jitted shard_map over the group mesh. A tensor *without* the rank dim is
+  treated as replicated input — every rank holding the same value — which
+  reproduces the reference's numerics (all_reduce of equal values = value *
+  nranks).
+
+Stream-ordering ops of the reference (c_sync_calc_stream, c_wait_compute …)
+intentionally have no equivalent: XLA schedules compute/collective overlap.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.tensor import Tensor
+
+
+class ReduceOp:
+    """reference: collective.py ReduceOp enum."""
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_REDUCERS = {
+    ReduceOp.SUM: (jax.lax.psum, jnp.sum),
+    ReduceOp.MAX: (jax.lax.pmax, jnp.max),
+    ReduceOp.MIN: (jax.lax.pmin, jnp.min),
+}
+
+
+class Group:
+    """A communicator: an ordered list of devices + a mesh axis name.
+
+    reference: collective.py Group (ring_id → NCCLComm); here ranks index
+    into `devices` and `axis_name` is what collectives reduce over."""
+
+    _next_id = [0]
+
+    def __init__(self, devices: Sequence, axis_name: str = None,
+                 rank: int = 0, pg_id: int = None, ranks: List[int] = None):
+        self.devices = list(devices)
+        self.ranks = list(ranks) if ranks is not None \
+            else list(range(len(self.devices)))
+        self.id = pg_id if pg_id is not None else Group._next_id[0]
+        Group._next_id[0] += 1
+        self.axis_name = axis_name or f"pg{self.id}"
+        self.rank = rank
+        self._mesh = None
+
+    @property
+    def nranks(self) -> int:
+        return len(self.devices)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self._mesh = Mesh(np.array(self.devices), (self.axis_name,))
+        return self._mesh
+
+    def get_group_rank(self, global_rank):
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return -1
+
+    def is_member(self):
+        return True
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, nranks={self.nranks})"
+
+
+_world_group: Optional[Group] = None
+_groups = {}
+
+
+def _ensure_world_group() -> Group:
+    global _world_group
+    if _world_group is None:
+        _world_group = Group(jax.devices(), axis_name="world", pg_id=0)
+        _groups[0] = _world_group
+    return _world_group
+
+
+def _get_group(group) -> Group:
+    if group is None:
+        return _ensure_world_group()
+    if isinstance(group, int):
+        return _groups[group]
+    return group
+
+
+def get_group(gid: int = 0) -> Group:
+    return _groups.get(gid) or _ensure_world_group()
+
+
+def new_group(ranks: List[int] = None, backend=None, axis_name=None) -> Group:
+    """reference: collective.py:new_group — NCCL subring from global ranks;
+    here a sub-list of global devices under a fresh axis name."""
+    world = _ensure_world_group()
+    if ranks is None:
+        ranks = list(range(world.nranks))
+    devs = [world.devices[r] for r in ranks]
+    g = Group(devs, axis_name=axis_name, ranks=ranks)
+    _groups[g.id] = g
+    return g
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _traced_axis(g: Group):
+    """Resolve the mesh-axis name a traced collective should reduce over.
+
+    Inside shard_map the bound axis names are authoritative: the group's
+    own axis if bound; for the default/world group, ALL bound axes (world
+    = every device participating in this mapped region)."""
+    try:
+        bound = list(jax.core.unsafe_get_axis_names_DO_NOT_USE())
+    except Exception:
+        bound = []
+    if g.axis_name in bound:
+        return g.axis_name
+    if g is _world_group and bound:
+        return tuple(bound)
+    return g.axis_name
+
+
+def _axis_size(ax):
+    """Static size of a (possibly tuple of) bound named axis."""
+    import numpy as _np
+    return int(_np.asarray(jax.lax.psum(1, ax)))
+
+
+def _rank_dim_sharded(arr, g: Group) -> bool:
+    """Eager array whose dim-0 is the group rank dim (one block per rank)."""
+    if not hasattr(arr, "sharding") or arr.ndim == 0:
+        return False
+    if arr.shape[0] != g.nranks or g.nranks == 1:
+        return False
+    s = arr.sharding
+    if isinstance(s, NamedSharding):
+        spec = s.spec
+        return len(spec) > 0 and spec[0] is not None
+    return False
+
+
+def _eager_shard_map(g: Group, fn, arr, out_rank_dim=True):
+    """Run fn per-rank-block over the group mesh. arr dim-0 = rank dim."""
+    mesh = g.mesh
+    ax = g.axis_name
+    out_spec = P(ax) if out_rank_dim else P()
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=P(ax),
+                           out_specs=out_spec, check_vma=False)
+    arr = jax.device_put(arr, NamedSharding(mesh, P(ax)))
+    return jax.jit(mapped)(arr)
+
+
+def _wrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _ret(t, arr):
+    """Mutate in place (reference collectives are in-place) + return."""
+    if isinstance(t, Tensor):
+        t._data = arr
+        return t
+    return Tensor(arr, _internal=True)
+
+
+# -- collectives -------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=True):
+    """reference: collective.py:all_reduce / c_allreduce_op.h:356."""
+    g = _get_group(group)
+    arr = _wrap(tensor)
+    if g.nranks == 1:
+        return _ret(tensor, arr)
+    if _is_traced(arr):
+        ax = _traced_axis(g)
+        if op == ReduceOp.AVG:
+            out = jax.lax.psum(arr, ax) / _axis_size(ax)
+        elif op == ReduceOp.PROD:
+            out = jnp.exp(jax.lax.psum(jnp.log(arr), ax))
+        else:
+            out = _REDUCERS.get(op, _REDUCERS[ReduceOp.SUM])[0](arr, ax)
+        return _ret(tensor, out)
+    if _rank_dim_sharded(arr, g):
+        def blk(x):  # x: (1, *S)
+            lax_fn = _REDUCERS.get(op, _REDUCERS[ReduceOp.SUM])[0]
+            if op == ReduceOp.AVG:
+                return jax.lax.psum(x, g.axis_name) / g.nranks
+            if op == ReduceOp.PROD:
+                return jnp.exp(jax.lax.psum(jnp.log(x), g.axis_name))
+            return lax_fn(x, g.axis_name)
+        return _ret(tensor, _eager_shard_map(g, blk, arr))
+    # replicated eager input: every rank holds `arr`
+    if op == ReduceOp.SUM:
+        out = arr * g.nranks
+    elif op == ReduceOp.PROD:
+        out = arr ** g.nranks
+    elif op == ReduceOp.AVG:
+        out = arr
+    else:
+        out = arr
+    return _ret(tensor, out)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=True):
+    """reference: collective.py:reduce (c_reduce_*). In SPMD the reduced
+    value lands replicated; dst is kept for API parity."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """reference: collective.py:all_gather (c_allgather). Appends nranks
+    Tensors to tensor_list; also returns the concatenated result."""
+    g = _get_group(group)
+    arr = _wrap(tensor)
+    if _is_traced(arr):
+        ax = _traced_axis(g)
+        out = jax.lax.all_gather(arr, ax, axis=0, tiled=False)
+        parts = [out[i] for i in range(_axis_size(ax))]
+    elif _rank_dim_sharded(arr, g):
+        def blk(x):
+            return jax.lax.all_gather(x, g.axis_name, axis=0, tiled=True)
+        gathered = _eager_shard_map(g, blk, arr)  # (nranks, *S) replic-per-blk
+        parts = [gathered[i] for i in range(g.nranks)]
+    else:
+        parts = [arr for _ in range(g.nranks)]
+    if tensor_list is not None:
+        tensor_list.extend(Tensor(p, _internal=True) for p in parts)
+    return Tensor(jnp.stack(parts), _internal=True)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
+    """reference: collective.py:broadcast (c_broadcast)."""
+    g = _get_group(group)
+    arr = _wrap(tensor)
+    if g.nranks == 1:
+        return _ret(tensor, arr)
+    if _is_traced(arr):
+        # all ranks adopt src's block: gather then index (XLA folds this)
+        out = jax.lax.all_gather(arr, _traced_axis(g), axis=0)[src]
+        return _ret(tensor, out)
+    if _rank_dim_sharded(arr, g):
+        def blk(x):
+            return jax.lax.all_gather(x, g.axis_name, axis=0,
+                                      tiled=True)[src:src + 1]
+        return _ret(tensor, _eager_shard_map(g, blk, arr))
+    return _ret(tensor, arr)  # replicated already
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """reference: c_reducescatter. Traced: psum_scatter over the axis."""
+    g = _get_group(group)
+    arr = _wrap(tensor if tensor_list is None else
+                Tensor(jnp.concatenate([_wrap(t) for t in tensor_list]),
+                       _internal=True))
+    if _is_traced(arr):
+        out = jax.lax.psum_scatter(arr, _traced_axis(g),
+                                   scatter_dimension=0, tiled=True)
+        return _ret(tensor, out)
+    if _rank_dim_sharded(arr, g):
+        def blk(x):
+            return jax.lax.psum_scatter(x[0], g.axis_name,
+                                        scatter_dimension=0, tiled=True)[None]
+        return _ret(tensor, _eager_shard_map(g, blk, arr))
+    # replicated input: rank i's result = (sum over ranks of chunk i)
+    # = chunk_i * nranks; returned in the rank-dim representation
+    n = g.nranks
+    chunks = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
+    return _ret(tensor, chunks * n)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """reference: collective.py:scatter (c_scatter)."""
+    g = _get_group(group)
+    if tensor_list is not None:
+        stacked = jnp.stack([_wrap(t) for t in tensor_list])
+    else:
+        stacked = _wrap(tensor)
+    if _is_traced(stacked):
+        idx = jax.lax.axis_index(_traced_axis(g))
+        return _ret(tensor, stacked[idx])
+    mesh = g.mesh
+    out = jax.device_put(stacked, NamedSharding(mesh, P(g.axis_name)))
+    return _ret(tensor, out)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """reference: collective.py:alltoall (alltoall op). Traced input: the
+    local (nranks, ...) send buffer; lowers to lax.all_to_all."""
+    g = _get_group(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        arr = jnp.stack([_wrap(t) for t in in_tensor_list])
+    else:
+        arr = _wrap(in_tensor_list)
+    if _is_traced(arr):
+        out = jax.lax.all_to_all(arr, _traced_axis(g), split_axis=0,
+                                 concat_axis=0, tiled=False)
+    elif g.nranks > 1 and _rank_dim_sharded(arr, g):
+        def blk(x):  # x: (1, nranks, *S) → received (nranks, 1, *S)
+            r = jax.lax.all_to_all(x, g.axis_name, split_axis=1,
+                                   concat_axis=0, tiled=False)
+            return jnp.moveaxis(r, 0, 1)
+        out = _eager_shard_map(g, blk, arr)
+    else:
+        out = arr  # single rank: identity
+    if out_tensor_list is not None:
+        out_tensor_list.extend(
+            Tensor(out[i], _internal=True) for i in range(out.shape[0]))
+    return Tensor(out, _internal=True)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """reference: send_v2 — p2p send. Traced context: expressed as a
+    ppermute with a single edge; pair with recv on the peer."""
+    g = _get_group(group)
+    arr = _wrap(tensor)
+    if _is_traced(arr):
+        src = g.rank
+        return Tensor(jax.lax.ppermute(arr, _traced_axis(g),
+                                       [(src, dst)]), _internal=True)
+    g._p2p_buf = arr
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    """reference: recv_v2. Eager single-controller: reads the staged send
+    buffer (host relay); compiled pipelines use ppermute directly."""
+    g = _get_group(group)
+    arr = _wrap(tensor)
+    if _is_traced(arr):
+        return _ret(tensor, arr)
+    buf = getattr(g, "_p2p_buf", None)
+    if buf is not None:
+        return _ret(tensor, jax.device_put(buf, g.devices[g.rank]))
+    return tensor
+
+
+def p2p_permute(tensor, group=None, perm=None):
+    """TPU-native pipeline p2p: ppermute over the group axis (traced)."""
+    g = _get_group(group)
+    arr = _wrap(tensor)
+    if perm is None:
+        perm = [(i, (i + 1) % g.nranks) for i in range(g.nranks)]
+    return Tensor(jax.lax.ppermute(arr, _traced_axis(g), perm),
+                  _internal=True)
+
+
+def barrier(group=None):
+    """reference: barrier op. Eager single-controller: block host on all
+    devices (the only ordering hazard that exists here)."""
+    for d in _get_group(group).devices:
+        pass
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    arr = _wrap(tensor)
+    if not _is_traced(arr):
+        jax.block_until_ready(arr)
+    return tensor
+
+
+# -- model-parallel helpers (reference collective.py:747-1233) ---------------
+
+def _c_identity(tensor, group=None):
+    """Forward identity / backward all-reduce (reference collective.py:747).
+    Traced: identity now, psum of cotangent via custom vjp."""
+    g = _get_group(group)
+    arr = _wrap(tensor)
+    if not _is_traced(arr) or g.nranks == 1:
+        return _ret(tensor, arr)
+
+    @jax.custom_vjp
+    def ident(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    ax = _traced_axis(g)
+
+    def bwd(_, ct):
+        return (jax.lax.psum(ct, ax),)
+
+    ident.defvjp(fwd, bwd)
+    return Tensor(ident(arr), _internal=True)
+
+
+def _mp_allreduce(tensor, group=None):
+    """Forward all-reduce / backward identity (reference c_allreduce with
+    use_model_parallel=True)."""
+    g = _get_group(group)
+    arr = _wrap(tensor)
+    if not _is_traced(arr) or g.nranks == 1:
+        return _ret(tensor, arr)
+
+    ax = _traced_axis(g)
+
+    @jax.custom_vjp
+    def ar(x):
+        return jax.lax.psum(x, ax)
+
+    def fwd(x):
+        return jax.lax.psum(x, ax), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    ar.defvjp(fwd, bwd)
+    return Tensor(ar(arr), _internal=True)
+
+
+def _c_concat(tensor, group=None):
+    """All-gather along last dim (reference collective.py:1233 c_concat)."""
+    g = _get_group(group)
+    arr = _wrap(tensor)
+    if not _is_traced(arr) or g.nranks == 1:
+        return _ret(tensor, arr)
+    out = jax.lax.all_gather(arr, _traced_axis(g), axis=arr.ndim - 1,
+                             tiled=True)
+    return Tensor(out, _internal=True)
+
+
+def _c_split(tensor, group=None):
+    """Keep this rank's slice of the last dim (reference c_split)."""
+    g = _get_group(group)
+    arr = _wrap(tensor)
+    if not _is_traced(arr) or g.nranks == 1:
+        return _ret(tensor, arr)
+    ax = _traced_axis(g)
+    n = _axis_size(ax)
+    idx = jax.lax.axis_index(ax)
+    size = arr.shape[-1] // n
+    out = jax.lax.dynamic_slice_in_dim(arr, idx * size, size, arr.ndim - 1)
+    return Tensor(out, _internal=True)
+
+
+def is_initialized() -> bool:
+    return _world_group is not None
+
+
+def destroy_process_group(group=None):
+    global _world_group
+    if group is None:
+        _groups.clear()
+        _world_group = None
+    else:
+        _groups.pop(_get_group(group).id, None)

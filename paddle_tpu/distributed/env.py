@@ -1,0 +1,134 @@
+"""Parallel environment: device mesh bookkeeping + multi-host bootstrap.
+
+TPU-native replacement for the reference's rank/endpoint env plumbing
+(/root/reference/python/paddle/fluid/dygraph/parallel.py ParallelEnv,
+/root/reference/python/paddle/distributed/parallel.py:69 init_parallel_env)
+and the TCP unique-id bootstrap
+(/root/reference/paddle/fluid/platform/gen_comm_id_helper.h:28-43).
+
+Model: single-controller SPMD. One python process per host drives all local
+devices; `jax.distributed.initialize` (coordinator over DCN) replaces the
+reference's gen_comm_id TCP handshake; NCCL rings are replaced by mesh axes
+over which XLA compiles ICI collectives. "rank" therefore means *device
+index in the global mesh*, which keeps the reference's `get_rank()/
+get_world_size()` API meaningful for sharded SPMD programs.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+
+class ParallelEnv:
+    """reference: fluid/dygraph/parallel.py ParallelEnv (env-var facts)."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self._device_id = int(os.environ.get("FLAGS_selected_tpus",
+                                             os.environ.get("FLAGS_selected_gpus", "0")).split(",")[0] or 0)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+_global_env: Optional[ParallelEnv] = None
+_initialized = False
+
+
+def _env() -> ParallelEnv:
+    global _global_env
+    if _global_env is None:
+        _global_env = ParallelEnv()
+    return _global_env
+
+
+def _multi_host_env_present() -> bool:
+    return bool(os.environ.get("PADDLE_COORDINATOR_ADDRESS")
+                or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+
+
+def init_parallel_env():
+    """reference: distributed/parallel.py:69.
+
+    Multi-host (launcher-set coordinator env): jax.distributed.initialize —
+    the DCN analogue of the reference's c_gen_nccl_id + c_comm_init program.
+    Single-host: nothing to bootstrap; the world group is simply every
+    local device. Idempotent like the reference.
+    """
+    global _initialized
+    if _initialized:
+        return _env()
+    import jax
+    if _multi_host_env_present():
+        addr = (os.environ.get("PADDLE_COORDINATOR_ADDRESS")
+                or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _initialized = True
+    from . import collective
+    collective._ensure_world_group()
+    return _env()
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    import jax
+    if _multi_host_env_present() and _initialized:
+        return jax.process_index()
+    return _env().rank
+
+
+def get_world_size(group=None) -> int:
+    from . import collective
+    if group is not None:
+        return group.nranks
+    if collective._world_group is not None:
+        return collective._world_group.nranks
+    ws = _env().world_size
+    if ws > 1:
+        return ws
+    import jax
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    import jax
+    return jax.local_device_count()

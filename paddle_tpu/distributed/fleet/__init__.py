@@ -1,0 +1,190 @@
+"""Fleet — the distributed-training facade.
+
+TPU-native equivalent of the reference's fleet package
+(/root/reference/python/paddle/distributed/fleet/base/fleet_base.py:103,
+170,830,883,1343 — init / distributed_optimizer / distributed_model /
+minimize) plus RoleMaker env discovery (base/role_maker.py).
+
+fleet.init builds the hybrid mesh (HybridCommunicateGroup) from
+strategy.hybrid_configs; distributed_model wraps by mode exactly like the
+reference (fleet_base.py:883 → PipelineParallel / TensorParallel /
+ShardingParallel / DataParallel); distributed_optimizer wraps with the
+hybrid optimizer. Static-graph meta-optimizer compilation
+(fleet_base.py:1432-1462 StrategyCompiler) is replaced by the compiled
+step's sharding propagation — the strategies that survive as real switches
+(amp / recompute / pipeline / sharding / tensor_parallel / gradient_merge)
+are honored by the engine, the rest are accepted for config parity.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..env import ParallelEnv, get_rank, get_world_size, init_parallel_env
+from .. import collective
+from .strategy import DistributedStrategy
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group, _set_hcg)
+from .dygraph_optimizer import (HybridParallelOptimizer,
+                                DygraphShardingOptimizer)
+from . import meta_parallel
+from .meta_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,
+                            TensorParallel, ShardingParallel,
+                            PipelineParallel)
+from .recompute import recompute
+from ..parallel import DataParallel
+
+__all__ = [
+    "init", "DistributedStrategy", "UserDefinedRoleMaker",
+    "PaddleCloudRoleMaker", "distributed_model", "distributed_optimizer",
+    "get_hybrid_communicate_group", "worker_num", "worker_index",
+    "is_first_worker", "worker_endpoints", "barrier_worker", "recompute",
+    "meta_parallel", "HybridParallelOptimizer", "DygraphShardingOptimizer",
+]
+
+
+class _RoleMakerBase:
+    """reference: fleet/base/role_maker.py — rank/endpoint discovery."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        env = ParallelEnv()
+        self._rank = env.rank
+        self._world_size = max(env.world_size, 1)
+        self._endpoints = env.trainer_endpoints
+
+    def worker_num(self):
+        return self._world_size
+
+    def worker_index(self):
+        return self._rank
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self._rank == 0
+
+    def get_trainer_endpoints(self):
+        return self._endpoints
+
+
+class PaddleCloudRoleMaker(_RoleMakerBase):
+    pass
+
+
+class UserDefinedRoleMaker(_RoleMakerBase):
+    def __init__(self, is_collective=True, current_id=0, role=None,
+                 worker_num=1, worker_endpoints=None, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._rank = current_id
+        self._world_size = worker_num
+        self._endpoints = worker_endpoints or []
+
+
+class _FleetState:
+    def __init__(self):
+        self.role_maker: Optional[_RoleMakerBase] = None
+        self.strategy: Optional[DistributedStrategy] = None
+        self.hcg: Optional[HybridCommunicateGroup] = None
+        self.initialized = False
+
+
+_state = _FleetState()
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    """reference: fleet_base.py:170."""
+    import jax
+    _state.role_maker = role_maker or PaddleCloudRoleMaker(
+        is_collective=is_collective)
+    _state.strategy = strategy or DistributedStrategy()
+    init_parallel_env()
+
+    hybrid = dict(_state.strategy.hybrid_configs)
+    n_dev = jax.device_count()
+    mp = int(hybrid.get("mp_degree", 1))
+    pp = int(hybrid.get("pp_degree", 1))
+    sd = int(hybrid.get("sharding_degree", 1))
+    sep = int(hybrid.get("sep_degree", 1))
+    dp = int(hybrid.get("dp_degree", -1))
+    if dp == -1:
+        denom = mp * pp * sd * sep
+        dp = max(1, n_dev // denom)
+    topo = CommunicateTopology(("data", "pipe", "sharding", "model", "sep"),
+                               (dp, pp, sd, mp, sep))
+    _state.hcg = HybridCommunicateGroup(topo)
+    _set_hcg(_state.hcg)
+    _state.initialized = True
+    return _state
+
+
+def _require_init():
+    if not _state.initialized:
+        init()
+
+
+def distributed_model(model):
+    """reference: fleet_base.py:883 — wrap by parallel mode."""
+    _require_init()
+    hcg = _state.hcg
+    strategy = _state.strategy
+    if hcg.get_pipe_parallel_world_size() > 1:
+        if not isinstance(model, PipelineLayer):
+            raise TypeError(
+                "pipeline parallel requires the model be a PipelineLayer")
+        return PipelineParallel(model, hcg=hcg, strategy=strategy)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return ShardingParallel(model, hcg=hcg, strategy=strategy)
+    if hcg.get_model_parallel_world_size() > 1 \
+            or hcg.get_sep_parallel_world_size() > 1:
+        return TensorParallel(model, hcg=hcg, strategy=strategy)
+    return DataParallel(model, mesh=hcg.global_mesh)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference: fleet_base.py:830."""
+    if strategy is not None:
+        _state.strategy = strategy
+    _require_init()
+    hcg = _state.hcg
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return HybridParallelOptimizer(
+            DygraphShardingOptimizer(optimizer=optimizer, hcg=hcg),
+            hcg=hcg, strategy=_state.strategy)
+    return HybridParallelOptimizer(optimizer, hcg=hcg,
+                                   strategy=_state.strategy)
+
+
+def worker_num():
+    _require_init()
+    return max(_state.role_maker.worker_num(), 1)
+
+
+def worker_index():
+    _require_init()
+    return _state.role_maker.worker_index()
+
+
+def is_first_worker():
+    _require_init()
+    return _state.role_maker.is_first_worker()
+
+
+def worker_endpoints(to_string=False):
+    _require_init()
+    eps = _state.role_maker.get_trainer_endpoints()
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    collective.barrier()
+
+
+def get_strategy():
+    return _state.strategy

@@ -1,0 +1,13 @@
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
+                        RowParallelLinear, ParallelCrossEntropy)
+from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
+from .pipeline_parallel import PipelineParallel
+from .parallel_layers import TensorParallel, ShardingParallel
+
+__all__ = [
+    "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+    "ParallelCrossEntropy", "RNGStatesTracker", "get_rng_state_tracker",
+    "model_parallel_random_seed", "LayerDesc", "SharedLayerDesc",
+    "PipelineLayer", "PipelineParallel", "TensorParallel", "ShardingParallel",
+]

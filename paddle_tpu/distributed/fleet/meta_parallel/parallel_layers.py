@@ -1,0 +1,44 @@
+"""Model wrappers for the non-pipeline hybrid modes.
+
+TPU-native equivalent of the reference's TensorParallel / ShardingParallel
+wrappers (/root/reference/python/paddle/distributed/fleet/meta_parallel/
+tensor_parallel.py, sharding_parallel.py): broadcast initial parameters
+over the relevant groups, then let the compiled step do the rest.
+
+Here "wrapping" attaches the hybrid mesh to the model so the compiled-step
+engine (jit/engine.py) shards parameters by their `sharding_spec` and the
+batch over dp/sharding — XLA then inserts every collective the reference's
+wrappers orchestrate by hand."""
+from __future__ import annotations
+
+from ....nn.layer_base import Layer
+from .. import topology as _topo
+
+
+class _MeshWrapper(Layer):
+    def __init__(self, layers: Layer, hcg=None, strategy=None, **kw):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg or _topo.get_hybrid_communicate_group()
+        if self._hcg is not None:
+            layers._pt_mesh = self._hcg.global_mesh
+            self._pt_mesh = self._hcg.global_mesh
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+class TensorParallel(_MeshWrapper):
+    """reference: meta_parallel/tensor_parallel.py."""
+
+
+class ShardingParallel(_MeshWrapper):
+    """reference: meta_parallel/sharding_parallel.py (ZeRO stage-1 model
+    wrapper; the optimizer-state sharding itself lives in
+    DygraphShardingOptimizer / the compiled step's accumulator shardings)."""

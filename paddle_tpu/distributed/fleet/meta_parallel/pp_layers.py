@@ -1,0 +1,181 @@
+"""Pipeline stage partitioning.
+
+TPU-native equivalent of the reference's PipelineLayer
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py:63,132): declare the model as an ordered list
+of layers (or LayerDesc for lazy construction), partition into stages by
+uniform or parameter-weighted segmenting, support shared layers (tied
+embeddings) across stages.
+
+Single-controller difference: ALL stages are materialized in this process
+(the driver owns every device); each stage's parameters are placed on that
+stage's sub-mesh of the "pp" axis by PipelineParallel. A shared layer is
+literally the same Layer object in both stages, so the reference's
+shared-weight gradient all-reduce (pp_layers.py:49) degenerates to grad
+accumulation on one Parameter.
+"""
+from __future__ import annotations
+
+import re
+from functools import partial
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ....nn.layer_base import Layer
+
+
+class LayerDesc:
+    """reference: pp_layers.py LayerDesc — lazy layer constructor."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects an nn.Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """reference: pp_layers.py SharedLayerDesc — one logical layer used by
+    several stages (tied input/output embeddings)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference: pp_layers.py SegmentLayers — uniform or regex-weighted
+    partition of N layers into num_parts stages."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+        if len(layers_desc) < num_parts:
+            raise ValueError("too few layers for the pipeline degree")
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(len(self.layers_desc), self.num_parts)
+        if self.method.startswith("layer:"):
+            name = self.method.split(":", 1)[1]
+            weights = [0] * len(self.layers_desc)
+            for i, d in enumerate(self.layers_desc):
+                cls = d.layer_func if isinstance(d, LayerDesc) else type(d)
+                if getattr(cls, "__name__", "") == name \
+                        or re.search(name, getattr(cls, "__name__", "")):
+                    weights[i] = 1
+            if sum(weights) == 0:
+                raise ValueError(f"no layer matches {name!r}")
+            return self._segment_by_weight(weights)
+        raise ValueError(f"unknown seg_method {self.method!r}")
+
+    @staticmethod
+    def uniform(num_items, num_parts) -> List[int]:
+        result = [0] * (num_parts + 1)
+        part_size = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+    def _segment_by_weight(self, weights) -> List[int]:
+        total = sum(weights)
+        per_part = total / self.num_parts
+        result = [0] * (self.num_parts + 1)
+        acc, part = 0, 1
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= per_part * part and part < self.num_parts:
+                result[part] = i + 1
+                part += 1
+        result[self.num_parts] = len(weights)
+        return result
+
+
+class PipelineLayer(Layer):
+    """reference: pp_layers.py:132.
+
+    Holds the full layer list plus the stage partition. `forward` runs the
+    whole model (useful single-stage / for parity checks); PipelineParallel
+    executes stage ranges via `forward_segment`."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        if num_stages is None and topology is None:
+            from .. import topology as _topo
+            hcg = _topo.get_hybrid_communicate_group()
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        if num_stages is None:
+            num_stages = topology.get_dim("pipe")
+        self._loss_fn = loss_fn
+        self._num_stages = int(num_stages)
+        self._recompute_interval = recompute_interval
+        self._layers_desc = list(layers)
+        self.segment_parts = SegmentLayers(
+            self._layers_desc, self._num_stages, seg_method).do_segment()
+
+        # build every layer (single controller materializes all stages);
+        # shared descs build once per key and are re-used.
+        self._shared: dict = {}
+        self.run_function: List = []
+        self._shared_fwd: dict = {}
+        for i, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                layer = self._shared[d.layer_name]
+                fwd = d.forward_func
+                self.add_sublayer(str(i), layer)
+                if fwd is not None:
+                    self.run_function.append(partial(fwd, layer))
+                else:
+                    self.run_function.append(layer)
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+                self.add_sublayer(str(i), layer)
+                self.run_function.append(layer)
+            elif isinstance(d, Layer):
+                self.add_sublayer(str(i), d)
+                self.run_function.append(d)
+            elif callable(d):
+                self.run_function.append(d)
+            else:
+                raise TypeError(f"cannot build pipeline item {d!r}")
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_stage_range(self, stage_id) -> range:
+        return range(self.segment_parts[stage_id],
+                     self.segment_parts[stage_id + 1])
+
+    def stage_layers(self, stage_id):
+        return [self.run_function[i] for i in self.get_stage_range(stage_id)]
+
+    def forward_segment(self, x, start, end):
+        for fn in self.run_function[start:end]:
+            x = fn(x) if not isinstance(x, tuple) else fn(*x)
+        return x
+
+    def forward(self, x):
+        return self.forward_segment(x, 0, len(self.run_function))
+
+    def loss_fn(self, output, label):
+        if self._loss_fn is None:
+            raise ValueError("PipelineLayer built without loss_fn")
+        return self._loss_fn(output, label)

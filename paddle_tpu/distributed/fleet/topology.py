@@ -1,0 +1,246 @@
+"""Hybrid-parallel topology: the device mesh.
+
+TPU-native equivalent of the reference's CommunicateTopology /
+HybridCommunicateGroup (/root/reference/python/paddle/distributed/fleet/
+base/topology.py:36,117), which builds one NCCL group per parallelism axis
+plus p2p pairs per pipeline edge (topology.py:193-258).
+
+Here the whole topology IS one `jax.sharding.Mesh` whose named axes are the
+parallelism dimensions — ["dp", "pp", "sharding", "mp"] in the reference's
+hybrid_configs order, plus the NEW "sep" (sequence/context parallel) axis
+the reference lacks (SURVEY §5 "Long-context"). Per-axis "groups" are views
+of that mesh; collectives inside compiled programs name the axis and XLA
+lays the traffic onto ICI. No p2p bootstrap is needed — pipeline edges are
+`ppermute` over the "pp" axis.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..collective import Group, _groups
+
+
+class MeshAxisGroup(Group):
+    """A communicator that is one named axis of a (possibly hybrid) mesh."""
+
+    def __init__(self, mesh: Mesh, axis: str, rank: int = 0):
+        devs = list(mesh.devices.reshape(-1))
+        super().__init__(devs, axis_name=axis, rank=rank)
+        self._mesh = mesh
+        self._axis = axis
+
+    @property
+    def nranks(self) -> int:
+        return self._mesh.shape[self._axis]
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+
+class CommunicateTopology:
+    """reference: fleet/base/topology.py:36 — maps axis names to dims and
+    ranks to coordinates."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world_size = int(np.prod(self._dims))
+        self._coords = list(itertools.product(*[range(d) for d in self._dims]))
+        self._coord2rank = {c: r for r, c in enumerate(self._coords)}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._coords[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return [r for r, c in enumerate(self._coords) if c[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        other = [self._parallel_names[i] for i in
+                 range(len(self._parallel_names)) if i != axis]
+        groups = []
+        for coord in itertools.product(
+                *[range(self.get_dim(n)) for n in other]):
+            ranks = []
+            for i in range(self._dims[axis]):
+                kw = dict(zip(other, coord))
+                kw[axis_name] = i
+                ranks.append(self.get_rank(**kw))
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = list(self.get_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+# axis-name mapping: reference hybrid_configs keys → mesh axis names
+_AXES = ("dp", "pp", "sharding", "mp", "sep")
+
+
+class HybridCommunicateGroup:
+    """reference: fleet/base/topology.py:117.
+
+    Builds the global hybrid Mesh. Device order follows the reference's
+    rank-assignment convention: the LAST topology axis varies fastest
+    (reference order [data, pipe, sharding, model] — adjacent ranks are mp
+    neighbors, which on TPU maps mp onto the innermost/fastest ICI axis).
+    """
+
+    def __init__(self, topology: CommunicateTopology = None,
+                 dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
+                 sep_degree=1, devices: Optional[Sequence] = None):
+        if topology is not None:
+            dims = dict(zip(topology.get_hybrid_group_names(),
+                            topology._dims))
+            dp_degree = dims.get("data", 1)
+            pp_degree = dims.get("pipe", 1)
+            sharding_degree = dims.get("sharding", 1)
+            mp_degree = dims.get("model", 1)
+            sep_degree = dims.get("sep", 1)
+        self._topo = topology or CommunicateTopology(
+            ("data", "pipe", "sharding", "model"),
+            (dp_degree, pp_degree, sharding_degree, mp_degree))
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sep_degree = sep_degree
+        n = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        devs = list(devices) if devices is not None else jax.devices()
+        if len(devs) < n:
+            raise ValueError(
+                f"hybrid topology needs {n} devices, have {len(devs)}")
+        arr = np.array(devs[:n]).reshape(
+            dp_degree, pp_degree, sharding_degree, mp_degree, sep_degree)
+        self.global_mesh = Mesh(arr, _AXES)
+        self.nranks = n
+        self.global_rank = 0
+
+        self._groups: Dict[str, MeshAxisGroup] = {}
+        for ax in _AXES:
+            g = MeshAxisGroup(self.global_mesh, ax)
+            _groups[g.id] = g
+            self._groups[ax] = g
+
+    # reference API surface ------------------------------------------------
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        # reference returns ParallelMode enum; mirrored as strings
+        if self._pp_degree > 1:
+            return "pipeline_parallel"
+        if self._sharding_degree > 1:
+            return "sharding_parallel"
+        if self._mp_degree > 1:
+            return "tensor_parallel"
+        return "data_parallel"
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_group(self):
+        return self._groups["dp"]
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    # model (tensor) parallel
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        return self._groups["mp"]
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pp"]
+
+    # sharding
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sharding_parallel_group_src_rank(self):
+        return 0
+
+    # sequence parallel (NEW capability; absent in reference — SURVEY §5)
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self):
+        return self._groups["mp"]
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank,
+                                              pipe=stage_id, **kwargs)
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def _set_hcg(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -1,0 +1,127 @@
+"""Distributed launcher CLI — `python -m paddle_tpu.distributed.launch`.
+
+TPU-native equivalent of the reference's fleetrun / launch_collective
+(/root/reference/python/paddle/distributed/fleet/launch.py:276-347,451):
+build per-rank env (PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS /
+FLAGS_selected_gpus), spawn local workers, watch, tear down on failure.
+
+On TPU pods the launcher starts ONE controller process per HOST (not per
+chip); rank 0's address doubles as the jax.distributed coordinator — the
+DCN replacement for the reference's gen_nccl_id TCP handshake. Single-host
+multi-"rank" launches (the reference's per-GPU mode, used by our localhost
+dist tests) force JAX_PLATFORMS=cpu workers so each process owns a virtual
+device set.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes on this host (hosts, not chips: "
+                        "one SPMD controller drives all local chips)")
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (defaults to a local port)")
+    p.add_argument("--ips", default=None, help="comma list of host ips")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--devices", "--gpus", "--xpus", dest="devices",
+                   default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch_collective(args) -> int:
+    nprocs = args.nproc_per_node
+    world = args.nnodes * nprocs
+    master = args.master or f"127.0.0.1:{_free_port()}"
+    endpoints = ",".join(
+        f"127.0.0.1:{_free_port()}" for _ in range(world))
+    procs = []
+    log_dir = args.log_dir
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for local_rank in range(nprocs):
+        rank = args.node_rank * nprocs + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank],
+            "PADDLE_RANK_IN_NODE": str(local_rank),
+        })
+        if world > 1:
+            env["PADDLE_COORDINATOR_ADDRESS"] = master
+        if nprocs > 1:
+            # several controllers on one host: give each a CPU device set
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        out = (open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+               if log_dir else None)
+        procs.append((subprocess.Popen(cmd, env=env, stdout=out,
+                                       stderr=subprocess.STDOUT
+                                       if out else None), out))
+
+    # watch loop (reference: fleet/launch.py:276-347)
+    rc = 0
+    try:
+        alive = True
+        while alive:
+            alive = False
+            for p, _ in procs:
+                code = p.poll()
+                if code is None:
+                    alive = True
+                elif code != 0:
+                    rc = code
+                    raise RuntimeError(
+                        f"worker pid {p.pid} exited with code {code}")
+            time.sleep(0.5)
+    except (RuntimeError, KeyboardInterrupt) as e:
+        for p, _ in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p, _ in procs:
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if isinstance(e, RuntimeError):
+            print(f"launch: {e}", file=sys.stderr)
+            rc = rc or 1
+    finally:
+        for _, out in procs:
+            if out:
+                out.close()
+    return rc
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    return launch_collective(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

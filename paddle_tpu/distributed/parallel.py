@@ -1,0 +1,102 @@
+"""Data-parallel training API.
+
+TPU-native equivalent of the reference's dygraph DP stack
+(/root/reference/python/paddle/fluid/dygraph/parallel.py:389 DataParallel,
+/root/reference/paddle/fluid/imperative/reducer.h:130 bucketed grad
+Reducer, nccl_context.h:44 ParallelContext).
+
+The reference overlaps bucketed NCCL all-reduces with backward; under XLA
+the same overlap falls out of compiling the whole train step over a mesh
+whose "dp" axis shards the batch: parameters are replicated, so XLA inserts
+(and schedules) the gradient all-reduce itself. DataParallel therefore
+carries *intent* (shard the batch over dp) rather than a reducer engine —
+the compiled-step engine (jit/engine.py) reads `model._pt_mesh`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from ..nn.layer_base import Layer
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
+from . import collective
+
+
+def _default_dp_mesh(n: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return Mesh(np.array(devs), ("dp",))
+
+
+class DataParallel(Layer):
+    """reference: fluid/dygraph/parallel.py:389.
+
+    Wraps a Layer for data-parallel training. comm_buffer_size /
+    last_comm_buffer_size mirror the reference's bucket knobs
+    (parallel.py:43 — 128 MB coalescing); XLA fuses collectives itself, so
+    they are accepted and ignored.
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, mesh: Optional[Mesh] = None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+        if mesh is None:
+            g = group or (collective._world_group
+                          if collective.is_initialized() else None)
+            if g is not None:
+                mesh = Mesh(np.array(g.devices), ("dp",))
+            else:
+                mesh = _default_dp_mesh()
+        self._pt_mesh = mesh
+        layers._pt_mesh = mesh  # compiled-step engine reads this
+        self._nranks = int(np.prod(list(mesh.shape.values())))
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # reference scales loss by 1/nranks before backward; the SPMD mean
+        # over the global batch already includes this factor.
+        return loss
+
+    def apply_collective_grads(self):
+        # grads from a global-batch backward are already the allreduced
+        # mean; nothing to do (reference: Reducer flush).
+        return
+
+    # passthroughs so the wrapper is transparent (reference parity)
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    @property
+    def parameters_(self):
+        return self._layers.parameters()
+
+
+def sync_params_buffers(model: Layer, comm_group=None, src_rank=0,
+                        is_model_parallel=False):
+    """reference: fluid/dygraph/parallel.py sync_params_buffers — broadcast
+    initial params from rank 0. Single-controller arrays are already one
+    copy; this re-commits them replicated over the comm group's devices."""
+    g = comm_group or collective._ensure_world_group()
+    if g.nranks <= 1:
+        return
+    sharding = NamedSharding(g.mesh, P())
+    for p in model.parameters():
+        if not isinstance(p._data, jax.core.Tracer):
+            p._data = jax.device_put(p._data, sharding)
+    for b in model.buffers():
+        if not isinstance(b._data, jax.core.Tracer):
+            b._data = jax.device_put(b._data, sharding)

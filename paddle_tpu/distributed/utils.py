@@ -1,0 +1,46 @@
+"""Expert-parallel (MoE) dispatch collectives.
+
+TPU-native equivalent of the reference's global_scatter / global_gather
+(/root/reference/python/paddle/distributed/utils.py:57,151 over CUDA ops
+operators/collective/global_scatter_op.cu.cc, global_gather_op.cu.cc):
+the all-to-all exchange that routes tokens to the experts' ranks and back.
+
+The reference uses variable-size ncclSend/ncclRecv loops driven by host
+count tensors. XLA wants static shapes, so the TPU realization is the
+standard capacity-based MoE exchange: tokens are packed into a fixed
+(n_expert * capacity) buffer per rank and exchanged with
+`jax.lax.all_to_all` over the expert-parallel axis (inside shard_map /
+compiled step). See paddle_tpu.incubate.moe for the layer that uses these.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .collective import _get_group, _is_traced, _wrap
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """reference: distributed/utils.py:57.
+
+    Traced form: x is the locally packed (world * n_local_expert *
+    capacity, d) buffer; rows are exchanged so that each rank receives the
+    tokens destined to its experts. local/global_count are kept for API
+    parity (the capacity packing already fixed the shapes)."""
+    g = _get_group(group)
+    arr = _wrap(x)
+    if not _is_traced(arr) or g.nranks == 1:
+        return Tensor(arr, _internal=True) if not isinstance(x, Tensor) else x
+    n = g.nranks
+    blocked = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
+    out = jax.lax.all_to_all(blocked, g.axis_name, split_axis=0,
+                             concat_axis=0, tiled=False)
+    return Tensor(out.reshape(arr.shape), _internal=True)
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """reference: distributed/utils.py:151 — the inverse exchange."""
+    return global_scatter(x, global_count, local_count, group=group)

@@ -19,6 +19,7 @@ class _State:
         self.amp_state = None         # set by paddle_tpu.amp.auto_cast
         self.static_program = None    # current default Program in static mode
         self.retain_grads = False
+        self.current_mesh = None      # jax Mesh active for the compiled step
 
 
 STATE = _State()
@@ -67,6 +68,20 @@ def trace_guard():
         yield
     finally:
         STATE.trace_depth -= 1
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh):
+    prev = STATE.current_mesh
+    STATE.current_mesh = mesh
+    try:
+        yield
+    finally:
+        STATE.current_mesh = prev
+
+
+def current_mesh():
+    return STATE.current_mesh
 
 
 class no_grad:
