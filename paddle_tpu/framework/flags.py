@@ -68,3 +68,6 @@ define_flag("allocator_strategy", "xla",
             "accepted for parity; XLA/PJRT owns device memory")
 define_flag("tpu_matmul_precision", "default",
             "jax matmul precision: default|high|highest")
+define_flag("use_flash_attention", True,
+            "route F.scaled_dot_product_attention to the Pallas flash "
+            "kernel when shapes/backend allow")

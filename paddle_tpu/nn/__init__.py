@@ -3,5 +3,10 @@ from . import functional
 from . import initializer
 from .layers import *  # noqa: F401,F403
 from .layers import __all__ as _layers_all
+from .rnn import *  # noqa: F401,F403
+from .rnn import __all__ as _rnn_all
+from .transformer import *  # noqa: F401,F403
+from .transformer import __all__ as _transformer_all
 
-__all__ = ["Layer", "ParamAttr", "functional", "initializer"] + list(_layers_all)
+__all__ = (["Layer", "ParamAttr", "functional", "initializer"]
+           + list(_layers_all) + list(_rnn_all) + list(_transformer_all))

@@ -1,0 +1,382 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py:134-1400).
+
+Cells are eager Tensor math; SimpleRNN/LSTM/GRU dispatch the fused `rnn`
+primitive (ops/rnn_ops.py) which compiles the whole recurrence into one XLA
+computation with lax.scan — the TPU-native analogue of the reference's cudnn
+rnn_op (paddle/fluid/operators/rnn_op.cu)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..framework.random import RNG
+from ..framework.tensor import Tensor
+from ..ops import rnn_ops
+from ..ops import creation as _cr
+from . import functional as F
+from . import initializer as I
+from .layer_base import Layer
+from .layers import LayerList
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+def _stack(tensors):
+    from ..ops import manipulation as _mp
+    return _mp.stack(tensors, axis=0)
+
+
+class RNNCellBase(Layer):
+    """reference: nn/layer/rnn.py:134 — get_initial_states helper."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        if shape is None:
+            shape = self.state_shape
+        batch = batch_ref.shape[batch_dim_idx]
+
+        def build(s):
+            if isinstance(s, (list, tuple)) and s and isinstance(
+                    s[0], (list, tuple)):
+                return type(s)(build(e) for e in s)
+            full = (batch,) + tuple(int(d) for d in s)
+            return _cr.full(full, init_value,
+                            dtype=dtype or batch_ref.dtype)
+
+        if isinstance(shape, (list, tuple)) and shape and isinstance(
+                shape[0], (list, tuple)):
+            return type(shape)(build(e) for e in shape)
+        return build(shape)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(x W_ih^T + b_ih + h W_hh^T + b_hh). ref: rnn.py:258."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation for SimpleRNNCell should be tanh "
+                             f"or relu, but got {activation}")
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            (hidden_size,), attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            (hidden_size,), attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        from ..ops import math as _m
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        i2h = _m.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            i2h = i2h + self.bias_ih
+        h2h = _m.matmul(states, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            h2h = h2h + self.bias_hh
+        pre = i2h + h2h
+        h = pre.tanh() if self.activation == "tanh" else F.relu(pre)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class LSTMCell(RNNCellBase):
+    """gates [i,f,g,o]; c' = f*c + i*g; h' = o*tanh(c'). ref: rnn.py:394."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (4 * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (4 * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            (4 * hidden_size,), attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            (4 * hidden_size,), attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    def forward(self, inputs, states=None):
+        from ..ops import math as _m
+        from ..ops import manipulation as _mp
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h, pre_c = states
+        gates = _m.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            gates = gates + self.bias_ih
+        gates = gates + _m.matmul(pre_h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            gates = gates + self.bias_hh
+        i, f, g, o = _mp.split(gates, num_or_sections=4, axis=-1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        c = f * pre_c + i * g.tanh()
+        h = o * c.tanh()
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class GRUCell(RNNCellBase):
+    """gates [r,z,c]; h' = (h - c)*z + c. ref: rnn.py:551."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (3 * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (3 * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            (3 * hidden_size,), attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            (3 * hidden_size,), attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    def forward(self, inputs, states=None):
+        from ..ops import math as _m
+        from ..ops import manipulation as _mp
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h = states
+        xg = _m.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            xg = xg + self.bias_ih
+        hg = _m.matmul(pre_h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            hg = hg + self.bias_hh
+        x_r, x_z, x_c = _mp.split(xg, num_or_sections=3, axis=-1)
+        h_r, h_z, h_c = _mp.split(hg, num_or_sections=3, axis=-1)
+        r = F.sigmoid(x_r + h_r)
+        z = F.sigmoid(x_z + h_z)
+        c = (x_c + r * h_c).tanh()
+        h = (pre_h - c) * z + c
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class RNN(Layer):
+    """Scan an arbitrary cell over time (eager loop; reference rnn.py:702).
+
+    For the fused/compiled classes use SimpleRNN/LSTM/GRU below."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ..ops import manipulation as _mp
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+        states = initial_states
+        t_axis = 0 if self.time_major else 1
+        T = inputs.shape[t_axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        seq_np = None
+        if sequence_length is not None:
+            seq_np = sequence_length.numpy() if isinstance(
+                sequence_length, Tensor) else np.asarray(sequence_length)
+        outs = [None] * T
+        for t in steps:
+            x_t = inputs[:, t] if t_axis == 1 else inputs[t]
+            out, new_states = self.cell(x_t, states, **kwargs)
+            if seq_np is not None:
+                mask = Tensor((t < seq_np).astype(np.float32)[:, None],
+                              _internal=True)
+                out = out * mask
+                new_states = _mask_states(new_states, states, mask)
+            outs[t] = out
+            states = new_states
+        y = _mp.stack(outs, axis=t_axis)
+        return y, states
+
+
+def _mask_states(new, old, mask):
+    if isinstance(new, (list, tuple)):
+        return type(new)(_mask_states(n, o, mask) for n, o in zip(new, old))
+    return new * mask + old * (1.0 - mask)
+
+
+class BiRNN(Layer):
+    """reference: rnn.py:777."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ..ops import manipulation as _mp
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        y_fw, s_fw = self.rnn_fw(inputs, states_fw, sequence_length, **kwargs)
+        y_bw, s_bw = self.rnn_bw(inputs, states_bw, sequence_length, **kwargs)
+        y = _mp.concat([y_fw, y_bw], axis=-1)
+        return y, (s_fw, s_bw)
+
+
+class RNNBase(LayerList):
+    """Fused multi-layer (bi)directional recurrence. ref: rnn.py:856."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        if direction in ("bidirectional", "bidirect"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"unknown direction {direction!r}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = float(dropout)
+        gate = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 \
+                    else hidden_size * self.num_directions
+                w_ih = self.create_parameter(
+                    (gate * hidden_size, in_sz), attr=weight_ih_attr,
+                    default_initializer=u)
+                w_hh = self.create_parameter(
+                    (gate * hidden_size, hidden_size), attr=weight_hh_attr,
+                    default_initializer=u)
+                b_ih = self.create_parameter(
+                    (gate * hidden_size,), attr=bias_ih_attr, is_bias=True,
+                    default_initializer=u)
+                b_hh = self.create_parameter(
+                    (gate * hidden_size,), attr=bias_hh_attr, is_bias=True,
+                    default_initializer=u)
+                sfx = f"{layer}" + ("_reverse" if d == 1 else "")
+                setattr(self, f"weight_ih_l{sfx}", w_ih)
+                setattr(self, f"weight_hh_l{sfx}", w_hh)
+                setattr(self, f"bias_ih_l{sfx}", b_ih)
+                setattr(self, f"bias_hh_l{sfx}", b_hh)
+                self._all_weights += [w_ih, w_hh, b_ih, b_hh]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch_idx = 1 if self.time_major else 0
+        B = inputs.shape[batch_idx]
+        LD = self.num_layers * self.num_directions
+        if initial_states is None:
+            h0 = _cr.zeros((LD, B, self.hidden_size), dtype=inputs.dtype)
+            c0 = _cr.zeros((LD, B, self.hidden_size), dtype=inputs.dtype) \
+                if self.mode == "LSTM" else None
+        elif self.mode == "LSTM":
+            h0, c0 = initial_states
+        else:
+            h0, c0 = initial_states, None
+        key = None
+        if self.dropout > 0.0 and self.training and self.num_layers > 1:
+            key = RNG.next_key()
+        outs = rnn_ops.rnn(
+            inputs, h0, c0, sequence_length, key, *self._all_weights,
+            mode=self.mode, num_layers=self.num_layers,
+            num_directions=self.num_directions, time_major=self.time_major,
+            dropout=self.dropout if self.training else 0.0, has_bias=True)
+        if self.mode == "LSTM":
+            y, h_n, c_n = outs
+            return y, (h_n, c_n)
+        y, h_n = outs
+        return y, h_n
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation for SimpleRNN should be tanh or "
+                             f"relu, but got {activation}")
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)

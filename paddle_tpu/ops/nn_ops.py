@@ -662,3 +662,32 @@ def zero_pad(x, *, padding, channel_last=False):
     if channel_last:
         return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
     return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+
+
+# ---------------------------------------------------------------------------
+# scaled dot-product attention (plain XLA path; the Pallas flash kernel in
+# ops/pallas_kernels.py takes over on TPU for long sequences — reference
+# analogue: operators/fused/fused_attention_op.cu / multihead_matmul_op.cu)
+
+
+@primitive("scaled_dot_product_attention")
+def sdpa(q, k, v, mask, key, *, dropout_p=0.0, causal=False,
+         return_weights=False):
+    """q/k/v: [B, H, T, D]; mask: additive float, broadcastable to
+    [B, H, Tq, Tk]."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (1.0 / np.sqrt(d))
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        s = jnp.where(cm, s, jnp.asarray(-1e9, s.dtype))
+    if mask is not None:
+        s = s + mask
+    w = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+    if return_weights:
+        return out, w
+    return out

@@ -1,0 +1,176 @@
+"""Pallas TPU kernels for the hot ops.
+
+Flash attention (online-softmax, O(T) memory) — the TPU-native counterpart of
+the reference's fused CUDA attention (operators/fused/fused_attention_op.cu,
+operators/fused/multihead_matmul_op.cu). Forward is a Pallas kernel tiled for
+the MXU (q blocks × k blocks, f32 accumulators, bf16-friendly); backward is a
+custom_vjp that recomputes attention with plain XLA ops (flash-style remat:
+no T×T tensor is ever materialised in the forward, and XLA fuses the
+recomputation into the backward matmuls).
+
+On CPU (tests) the kernel runs in interpret mode on tiny shapes; dispatch is
+gated by `flash_attention_or_none` which returns None when the plain XLA path
+should be used instead.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import primitive, raw
+from ..framework.flags import flag
+
+try:  # pallas is part of jax, but guard import for exotic builds
+    from jax.experimental import pallas as pl
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_k,
+                      causal, q_block, shift):
+    """One (batch·head, q-block) program: stream K/V blocks, online softmax.
+
+    `shift` = Tk - Tq implements bottom-right-aligned causal masking (cached
+    decode: a query at row i attends keys [0, i + shift]), matching
+    _xla_attention's tril(k=Tk-Tq) exactly."""
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * sm_scale        # [bq, d]
+    bq, d = q.shape
+    kt = k_ref.shape[0]
+    nblk = kt // block_k
+
+    def body(j, carry):
+        acc, m_i, l_i = carry
+        k = k_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq,bk]
+        if causal:
+            q_pos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos + shift >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_i - m_new)
+        l_new = l_i * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc = jnp.zeros((bq, d), jnp.float32)
+    m_i = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l_i = jnp.zeros((bq,), jnp.float32)
+    if causal:
+        # only blocks up to (and including) the shifted diagonal contribute
+        upper = (qi + 1) * q_block + shift
+        nblk_eff = jax.lax.min(
+            jnp.int32(nblk), (upper + block_k - 1) // block_k)
+    else:
+        nblk_eff = nblk
+    acc, m_i, l_i = jax.lax.fori_loop(0, nblk_eff, body, (acc, m_i, l_i))
+    o_ref[...] = (acc / l_i[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False):
+    """q/k/v: [B, H, Tq|Tk, D] → out [B, H, Tq, D]."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    sm_scale = 1.0 / np.sqrt(D)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
+                               block_k=block_k, causal=causal,
+                               q_block=block_q, shift=Tk - Tq)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D)
+
+
+def _xla_attention(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (1.0 / np.sqrt(d))
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        s = jnp.where(cm, s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, interpret):
+    return _flash_fwd(q, k, v, causal, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, interpret):
+    return _flash_fwd(q, k, v, causal, interpret=interpret), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _shapes_ok(q, k, interpret):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    if interpret:  # CPU test path: keep interpret-mode cheap
+        return Tq * Tk <= 64 * 64 and D <= 128
+
+    # blocks are min(128, T): T < 128 gives a single block, else T must tile
+    # exactly — floor-division grids would silently drop trailing rows/keys
+    def tiles(T):
+        return T % 128 == 0 or (T < 128 and T % 8 == 0)
+
+    return D % 8 == 0 and D <= 256 and tiles(Tq) and tiles(Tk)
+
+
+@primitive("flash_attention")
+def _flash_op(q, k, v, *, causal=False, interpret=False):
+    return _flash(q, k, v, causal, interpret)
+
+
+def flash_attention_or_none(query, key, value, attn_mask, is_causal):
+    """Tensor-level gate: return flash-attention output, or None to signal
+    the caller to take the plain XLA sdpa path."""
+    if not _HAS_PALLAS or attn_mask is not None:
+        return None
+    if not flag("use_flash_attention"):
+        return None
+    q, k = raw(query), raw(key)
+    if q.ndim != 4 or k.ndim != 4:
+        return None
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    if not _shapes_ok(q, k, interpret):
+        return None
+    return _flash_op(query, key, value, causal=bool(is_causal),
+                     interpret=interpret)
