@@ -47,17 +47,34 @@ class _Stage:
     """One pipeline stage: params + compiled fwd / fwd-bwd executables."""
 
     def __init__(self, pipe: PipelineLayer, stage_id: int, mesh: Mesh,
-                 is_last: bool):
+                 is_last: bool, mirrored_ids=()):
         self.id = stage_id
         self.mesh = mesh
         self.is_last = is_last
+        # params owned by an EARLIER stage (tied embeddings): this stage
+        # keeps a resident copy on its own sub-mesh, refreshed after each
+        # optimizer step (reference pp_layers.py:49 shared-weight sync).
+        self._mirrored_ids = set(mirrored_ids)
+        self._mirror: Dict[int, Any] = {}
         self.fns = pipe.stage_layers(stage_id)
         self.loss_fn = pipe._loss_fn
-        # unique params/buffers of this stage, in traversal order
+        # unique params/buffers of this stage, in traversal order. A
+        # shared-layer RE-USE entry (tied embedding head) contributes only
+        # its declared shared weight, not the whole layer.
         seen = set()
         self.params: List[Tensor] = []
         self.buffers: List[Tensor] = []
-        for fn in self.fns:
+        shared_reuse = getattr(pipe, "shared_reuse", {})
+        for idx, fn in zip(pipe.get_stage_range(stage_id), self.fns):
+            if idx in shared_reuse:
+                layer, attr = shared_reuse[idx]
+                p = layer
+                for part in attr.split("."):
+                    p = getattr(p, part)
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    self.params.append(p)
+                continue
             if isinstance(fn, Layer) or hasattr(fn, "func") and \
                     isinstance(getattr(fn, "func", None), Layer):
                 layer = fn if isinstance(fn, Layer) else fn.func
@@ -91,10 +108,28 @@ class _Stage:
 
     def _place_state(self):
         """Commit this stage's params onto its sub-mesh (resident layout —
-        optimizer updates then run sharded in place)."""
+        optimizer updates then run sharded in place). Mirrored (shared)
+        params keep their canonical copy on the owner stage; this stage
+        holds a same-sharding replica on its own devices."""
         for t in self.params + self.buffers:
             sh = NamedSharding(self.mesh, self._spec_for(t))
-            t._data = jax.device_put(t._data, sh)
+            if id(t) in self._mirrored_ids:
+                self._mirror[id(t)] = jax.device_put(t._data, sh)
+            else:
+                t._data = jax.device_put(t._data, sh)
+
+    def param_arrs(self):
+        return [self._mirror.get(id(p), p._data) for p in self.params]
+
+    def buf_arrs(self):
+        return [self._mirror.get(id(b), b._data) for b in self.buffers]
+
+    def set_buf_arrs(self, new_bufs):
+        for b, a in zip(self.buffers, new_bufs):
+            if id(b) in self._mirrored_ids:
+                self._mirror[id(b)] = a
+            else:
+                b._data = a
 
     # ---- traced stage body ------------------------------------------------
     def _run(self, param_arrs, buf_arrs, key, x):
@@ -204,11 +239,14 @@ class PipelineParallel(Layer):
     def _prepare(self):
         if self._stages is not None:
             return
-        self._stages = [
-            _Stage(self._layers, s, self._stage_mesh(s),
-                   is_last=(s == self.num_stages - 1))
-            for s in range(self.num_stages)
-        ]
+        self._stages = []
+        seen_ids: set = set()
+        for s in range(self.num_stages):
+            st = _Stage(self._layers, s, self._stage_mesh(s),
+                        is_last=(s == self.num_stages - 1),
+                        mirrored_ids=seen_ids.copy())
+            seen_ids.update(id(t) for t in st.params + st.buffers)
+            self._stages.append(st)
 
     def forward(self, x):
         return self._layers(x)
@@ -237,9 +275,9 @@ class PipelineParallel(Layer):
         stages = self._stages
         scale = jnp.float32(1.0 / n)
 
-        accs = []  # per-stage grad accumulators
+        accs = []  # per-stage grad accumulators (on the stage's sub-mesh)
         for st in stages:
-            accs.append([jnp.zeros_like(p._data) for p in st.params])
+            accs.append([jnp.zeros_like(a) for a in st.param_arrs()])
 
         in0_sharding = None
         losses = []
@@ -258,11 +296,9 @@ class PipelineParallel(Layer):
             for si, st in enumerate(stages[:-1]):
                 stage_inputs.append(x)
                 key = stage_keys[si]
-                parrs = [p._data for p in st.params]
-                barrs = [b._data for b in st.buffers]
-                out, new_bufs, _ = st.fwd_exec()(parrs, barrs, key, x)
-                for b, a in zip(st.buffers, new_bufs):
-                    b._data = a
+                out, new_bufs, _ = st.fwd_exec()(
+                    st.param_arrs(), st.buf_arrs(), key, x)
+                st.set_buf_arrs(new_bufs)
                 x = jax.tree_util.tree_map(
                     lambda a, st_next=stages[si + 1]:
                     jax.device_put(a, NamedSharding(
@@ -274,12 +310,10 @@ class PipelineParallel(Layer):
                 NamedSharding(st.mesh, _batch_spec(
                     max(1, np.ndim(micros_y[m])))))
             key = stage_keys[-1]
-            parrs = [p._data for p in st.params]
-            barrs = [b._data for b in st.buffers]
             loss, accs[-1], gin, new_bufs, _ = st.last_exec()(
-                parrs, barrs, key, x, label, scale, accs[-1])
-            for b, a in zip(st.buffers, new_bufs):
-                b._data = a
+                st.param_arrs(), st.buf_arrs(), key, x, label, scale,
+                accs[-1])
+            st.set_buf_arrs(new_bufs)
             losses.append(loss)
             # backward chain through earlier stages
             gout = gin
@@ -289,10 +323,9 @@ class PipelineParallel(Layer):
                     lambda a: jax.device_put(a, NamedSharding(
                         st.mesh, _batch_spec(a.ndim))), gout)
                 key = stage_keys[si]
-                parrs = [p._data for p in st.params]
-                barrs = [b._data for b in st.buffers]
                 accs[si], gout = st.bwd_exec()(
-                    parrs, barrs, key, stage_inputs[si], gout, accs[si])
+                    st.param_arrs(), st.buf_arrs(), key, stage_inputs[si],
+                    gout, accs[si])
 
         # hand grads to the optimizer (shared params get both stages' sums)
         grad_by_id = {}
@@ -331,9 +364,8 @@ class PipelineParallel(Layer):
                               _batch_spec(micros_x[m].ndim)))
             for st in stages:
                 key = RNG.next_key()
-                parrs = [p._data for p in st.params]
-                barrs = [b._data for b in st.buffers]
-                out, new_bufs, _ = st.fwd_exec()(parrs, barrs, key, x)
+                out, new_bufs, _ = st.fwd_exec()(
+                    st.param_arrs(), st.buf_arrs(), key, x)
                 x = jax.tree_util.tree_map(lambda a: a, out)
                 if st is not stages[-1]:
                     nxt = stages[stages.index(st) + 1]

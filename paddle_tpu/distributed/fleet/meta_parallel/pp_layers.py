@@ -129,17 +129,24 @@ class PipelineLayer(Layer):
             self._layers_desc, self._num_stages, seg_method).do_segment()
 
         # build every layer (single controller materializes all stages);
-        # shared descs build once per key and are re-used.
+        # shared descs build once per key and are re-used. A RE-USE entry
+        # (2nd+ occurrence of a key) is recorded in shared_reuse so the
+        # pipeline engine only ties the declared shared weight to that
+        # stage, not the whole layer's parameters.
         self._shared: dict = {}
         self.run_function: List = []
         self._shared_fwd: dict = {}
+        self.shared_reuse: dict = {}
         for i, d in enumerate(self._layers_desc):
             if isinstance(d, SharedLayerDesc):
-                if d.layer_name not in self._shared:
+                first = d.layer_name not in self._shared
+                if first:
                     self._shared[d.layer_name] = d.build_layer()
                 layer = self._shared[d.layer_name]
                 fwd = d.forward_func
                 self.add_sublayer(str(i), layer)
+                if not first:
+                    self.shared_reuse[i] = (layer, d.shared_weight_attr)
                 if fwd is not None:
                     self.run_function.append(partial(fwd, layer))
                 else:

@@ -1,0 +1,16 @@
+"""Flagship model zoo (language models).
+
+The reference repo ships its LM zoo out-of-tree (PaddleNLP / fleetx); the
+in-tree capability surface it exercises is the hybrid-parallel layer stack
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/) plus the
+fused transformer ops (/root/reference/paddle/fluid/operators/fused/
+fused_attention_op.cu). BASELINE.md config 5 (GPT-3 1.3B dp+mp+pp with
+recompute) is the north-star; this package provides the GPT family those
+configs train."""
+from .gpt import (GPT_CONFIGS, GPTDecoderLayer, GPTEmbeddings,
+                  GPTForPipeline, GPTForPretraining, GPTModel,
+                  GPTPretrainingCriterion, gpt_tiny, gpt2_small, gpt3_1p3b)
+
+__all__ = ["GPTModel", "GPTForPretraining", "GPTForPipeline",
+           "GPTDecoderLayer", "GPTEmbeddings", "GPTPretrainingCriterion",
+           "GPT_CONFIGS", "gpt_tiny", "gpt2_small", "gpt3_1p3b"]

@@ -1,0 +1,364 @@
+"""GPT decoder-only LM, hybrid-parallel-native (dp x mp x pp x sep).
+
+TPU-first design notes
+  * Attention/MLP use the GSPMD tensor-parallel layers
+    (distributed/fleet/meta_parallel/mp_layers.py): weights carry
+    PartitionSpecs over the "mp" mesh axis, XLA inserts the ICI
+    collectives. With mp degree 1 the same code is the single-chip model.
+  * The attention math routes through F.scaled_dot_product_attention →
+    Pallas flash attention on TPU (ops/pallas_kernels.py), causal.
+  * Sequence parallelism: hidden states are sharding-constrained to
+    P("dp", "sep", None) between blocks when a "sep" axis exists, so
+    LayerNorm/dropout/elementwise work is split along the sequence —
+    the reference has NO sequence parallel (SURVEY.md §5); this is the
+    idiomatic-TPU upgrade. Ring attention lives in
+    distributed/fleet/meta_parallel/sep_utils.py.
+  * Pipeline: GPTForPipeline declares the same model as LayerDescs with
+    tied input/output embeddings via SharedLayerDesc (reference:
+    fleet/meta_parallel/parallel_layers/pp_layers.py:63 and the external
+    fleetx GPTForPipeline it hosts).
+
+Reference capability anchors: hybrid layer stack
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py:30-249,
+pp_layers.py:63-132; fused attention
+paddle/fluid/operators/fused/fused_attention_op.cu; BASELINE.md config 5.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from ..nn.layers import Dropout, Embedding, LayerList, LayerNorm, Linear
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, constrain)
+from ..distributed.fleet.meta_parallel.pp_layers import (
+    LayerDesc, PipelineLayer, SharedLayerDesc)
+
+__all__ = ["GPTModel", "GPTForPretraining", "GPTForPipeline",
+           "GPTEmbeddings", "GPTDecoderLayer", "GPTPretrainingCriterion",
+           "GPT_CONFIGS", "gpt_tiny", "gpt2_small", "gpt3_1p3b"]
+
+
+def _seq_spec():
+    """Activation spec [B, T, H] with batch on dp and sequence on sep."""
+    from jax.sharding import PartitionSpec as P
+    return P("dp", "sep", None)
+
+
+class GPTEmbeddings(Layer):
+    """Word + learned-position embeddings (vocab sharded over mp)."""
+
+    def __init__(self, vocab_size, hidden_size, max_position_embeddings,
+                 hidden_dropout_prob=0.1, initializer_range=0.02):
+        super().__init__()
+        init = I.Normal(0.0, initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            vocab_size, hidden_size)
+        self.word_embeddings.weight.set_value(
+            init((vocab_size, hidden_size), "float32"))
+        self.position_embeddings = Embedding(
+            max_position_embeddings, hidden_size,
+            weight_attr=None)
+        self.position_embeddings.weight.set_value(
+            init((max_position_embeddings, hidden_size), "float32"))
+        self.dropout = Dropout(hidden_dropout_prob)
+
+    def forward(self, input_ids, position_ids=None):
+        import jax.numpy as jnp
+        T = input_ids.shape[-1]
+        wemb = self.word_embeddings(input_ids)
+        if position_ids is None:
+            pos = Tensor(jnp.arange(T, dtype=jnp.int32), _internal=True)
+        else:
+            pos = position_ids
+        pemb = self.position_embeddings(pos)
+        x = wemb + pemb
+        return constrain(self.dropout(x), _seq_spec())
+
+
+class GPTAttention(Layer):
+    """Causal self-attention: fused QKV column-parallel, out row-parallel.
+
+    Heads divide across mp (the fused QKV output dim is sharded), matching
+    the reference's head-parallel fused attention
+    (operators/fused/fused_attention_op.cu) without hand-written
+    collectives."""
+
+    def __init__(self, hidden_size, num_heads, attn_dropout_prob=0.1,
+                 hidden_dropout_prob=0.1, use_flash=True):
+        super().__init__()
+        assert hidden_size % num_heads == 0
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.hidden_size = hidden_size
+        self.attn_dropout_prob = attn_dropout_prob
+        self.qkv_proj = ColumnParallelLinear(
+            hidden_size, 3 * hidden_size, gather_output=False)
+        self.out_proj = RowParallelLinear(
+            hidden_size, hidden_size, input_is_parallel=True)
+        self.dropout = Dropout(hidden_dropout_prob)
+
+    def forward(self, x, cache=None):
+        from ..ops import manipulation as mp
+        B, T = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)                      # [B, T, 3H] mp-sharded
+        qkv = qkv.reshape((B, T, 3, self.num_heads, self.head_dim))
+        qkv = qkv.transpose((2, 0, 3, 1, 4))        # [3, B, nh, T, hd]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        if cache is not None:
+            k = mp.concat([cache[0], k], axis=2)
+            v = mp.concat([cache[1], v], axis=2)
+            cache = (k, v)
+        out, _ = F.scaled_dot_product_attention(
+            q, k, v, is_causal=(cache is None or q.shape[2] > 1),
+            dropout_p=self.attn_dropout_prob, training=self.training)
+        out = out.transpose((0, 2, 1, 3)).reshape((B, T, self.hidden_size))
+        out = self.dropout(self.out_proj(out))
+        return out if cache is None else (out, cache)
+
+
+class GPTMLP(Layer):
+    def __init__(self, hidden_size, intermediate_size,
+                 hidden_dropout_prob=0.1):
+        super().__init__()
+        self.fc1 = ColumnParallelLinear(hidden_size, intermediate_size,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(intermediate_size, hidden_size,
+                                     input_is_parallel=True)
+        self.dropout = Dropout(hidden_dropout_prob)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN transformer decoder block."""
+
+    def __init__(self, hidden_size, num_heads, intermediate_size=None,
+                 attn_dropout_prob=0.1, hidden_dropout_prob=0.1,
+                 layer_norm_epsilon=1e-5):
+        super().__init__()
+        inter = intermediate_size or 4 * hidden_size
+        self.ln_1 = LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
+        self.attn = GPTAttention(hidden_size, num_heads, attn_dropout_prob,
+                                 hidden_dropout_prob)
+        self.ln_2 = LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
+        self.mlp = GPTMLP(hidden_size, inter, hidden_dropout_prob)
+
+    def forward(self, x, cache=None):
+        if cache is None:
+            x = x + self.attn(self.ln_1(x))
+        else:
+            a, cache = self.attn(self.ln_1(x), cache)
+            x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        x = constrain(x, _seq_spec())
+        return x if cache is None else (x, cache)
+
+
+class GPTModel(Layer):
+    """Embeddings + N decoder blocks + final LN → hidden states."""
+
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None,
+                 max_position_embeddings=1024, attn_dropout_prob=0.1,
+                 hidden_dropout_prob=0.1, layer_norm_epsilon=1e-5,
+                 initializer_range=0.02):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.embeddings = GPTEmbeddings(
+            vocab_size, hidden_size, max_position_embeddings,
+            hidden_dropout_prob, initializer_range)
+        self.layers = LayerList([
+            GPTDecoderLayer(hidden_size, num_heads, intermediate_size,
+                            attn_dropout_prob, hidden_dropout_prob,
+                            layer_norm_epsilon)
+            for _ in range(num_layers)])
+        self.ln_f = LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        x = self.embeddings(input_ids, position_ids)
+        if caches is None:
+            for blk in self.layers:
+                x = blk(x)
+            return self.ln_f(x)
+        new_caches = []
+        for blk, c in zip(self.layers, caches):
+            x, c = blk(x, c)
+            new_caches.append(c)
+        return self.ln_f(x), new_caches
+
+
+def _lm_logits(hidden, word_embedding_weight):
+    """Tied LM head: logits = h @ W_e^T, vocab dim mp-sharded like the
+    reference's parallel_matmul over c_identity/allreduce."""
+    from ..ops import math as m
+    from jax.sharding import PartitionSpec as P
+    logits = m.matmul(hidden, word_embedding_weight, transpose_y=True)
+    return constrain(logits, P("dp", "sep", "mp"))
+
+
+class GPTForPretraining(Layer):
+    def __init__(self, gpt: GPTModel):
+        super().__init__()
+        self.gpt = gpt
+
+    def forward(self, input_ids, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        return _lm_logits(hidden, self.gpt.embeddings.word_embeddings.weight)
+
+    def generate(self, input_ids, max_new_tokens=16):
+        """Greedy decode with per-layer KV caches (inference path)."""
+        from ..ops import creation as cr, manipulation as mp, math as m
+        caches = None
+        ids = input_ids
+        out = input_ids
+        pos0 = 0
+        for _ in range(max_new_tokens):
+            if caches is None:
+                B, T = ids.shape
+                zeros = [(cr.zeros((B, blk.attn.num_heads, 0,
+                                    blk.attn.head_dim), "float32"),
+                          cr.zeros((B, blk.attn.num_heads, 0,
+                                    blk.attn.head_dim), "float32"))
+                         for blk in self.gpt.layers]
+                hidden, caches = self.gpt(ids, None, zeros)
+                pos0 = T
+            else:
+                import jax.numpy as jnp
+                pos = Tensor(np.asarray([pos0], np.int32), _internal=True)
+                hidden, caches = self.gpt(ids, pos, caches)
+                pos0 += 1
+            logits = _lm_logits(
+                hidden[:, -1:], self.gpt.embeddings.word_embeddings.weight)
+            nxt = m.argmax(logits, axis=-1).astype("int64")
+            ids = nxt
+            out = mp.concat([out, nxt], axis=1)
+        return out
+
+
+class GPTPretrainingCriterion(Layer):
+    """Masked next-token CE; class dim may be mp-sharded
+    (reference: mp_layers.py:249 ParallelCrossEntropy)."""
+
+    def __init__(self):
+        super().__init__()
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, logits, labels, loss_mask=None):
+        from ..ops import math as m
+        loss = self.ce(logits, labels)              # [B, T]
+        if loss_mask is not None:
+            mask = loss_mask.reshape(loss.shape).astype(loss.dtype)
+            return m.sum(loss * mask) / m.clip(m.sum(mask), 1e-6, None)
+        return m.mean(loss)
+
+
+# ---------------------------------------------------------------------------
+# pipeline variant
+
+
+class _EmbeddingPipe(GPTEmbeddings):
+    """Embedding stage; also serves as the tied LM head on the last stage
+    (SharedLayerDesc re-uses this very object)."""
+
+    def forward(self, input_ids):
+        return super().forward(input_ids)
+
+
+def _head_forward(emb_layer: _EmbeddingPipe, hidden):
+    return _lm_logits(hidden, emb_layer.word_embeddings.weight)
+
+
+class _LNPipe(LayerNorm):
+    pass
+
+
+class GPTForPipeline(PipelineLayer):
+    """GPT as an ordered LayerDesc list for 1F1B pipeline execution, tied
+    embeddings shared between first and last stage (reference:
+    pp_layers.py SharedLayerDesc + fleetx GPTForPretrainingPipe)."""
+
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None,
+                 max_position_embeddings=1024, attn_dropout_prob=0.1,
+                 hidden_dropout_prob=0.1, layer_norm_epsilon=1e-5,
+                 initializer_range=0.02, num_stages=None, topology=None,
+                 seg_method="layer:GPTDecoderLayer", recompute_interval=0,
+                 **kwargs):
+        descs = [
+            SharedLayerDesc(
+                "embed", _EmbeddingPipe, forward_func=None,
+                shared_weight_attr="word_embeddings.weight",
+                vocab_size=vocab_size, hidden_size=hidden_size,
+                max_position_embeddings=max_position_embeddings,
+                hidden_dropout_prob=hidden_dropout_prob,
+                initializer_range=initializer_range),
+        ]
+        for _ in range(num_layers):
+            descs.append(LayerDesc(
+                GPTDecoderLayer, hidden_size=hidden_size,
+                num_heads=num_heads, intermediate_size=intermediate_size,
+                attn_dropout_prob=attn_dropout_prob,
+                hidden_dropout_prob=hidden_dropout_prob,
+                layer_norm_epsilon=layer_norm_epsilon))
+        descs.append(LayerDesc(_LNPipe, hidden_size,
+                               epsilon=layer_norm_epsilon))
+        descs.append(SharedLayerDesc(
+            "embed", _EmbeddingPipe, forward_func=_head_forward,
+            shared_weight_attr="word_embeddings.weight",
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            max_position_embeddings=max_position_embeddings,
+            hidden_dropout_prob=hidden_dropout_prob,
+            initializer_range=initializer_range))
+        criterion = GPTPretrainingCriterion()
+        super().__init__(layers=descs, num_stages=num_stages,
+                         topology=topology,
+                         loss_fn=lambda out, lab: criterion(out, lab),
+                         seg_method=seg_method,
+                         recompute_interval=recompute_interval, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# configs
+
+GPT_CONFIGS = {
+    # test-scale
+    "gpt-tiny": dict(vocab_size=128, hidden_size=64, num_layers=2,
+                     num_heads=4, intermediate_size=256,
+                     max_position_embeddings=128),
+    # GPT-2 124M
+    "gpt2-small": dict(vocab_size=50304, hidden_size=768, num_layers=12,
+                       num_heads=12, intermediate_size=3072,
+                       max_position_embeddings=1024),
+    # BASELINE config 5: GPT-3 1.3B
+    "gpt3-1.3b": dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                      num_heads=16, intermediate_size=8192,
+                      max_position_embeddings=2048),
+}
+
+
+def _make(name, pretraining=True, **overrides):
+    cfg = dict(GPT_CONFIGS[name])
+    cfg.update(overrides)
+    model = GPTModel(**cfg)
+    return GPTForPretraining(model) if pretraining else model
+
+
+def gpt_tiny(**kw):
+    return _make("gpt-tiny", **kw)
+
+
+def gpt2_small(**kw):
+    return _make("gpt2-small", **kw)
+
+
+def gpt3_1p3b(**kw):
+    return _make("gpt3-1.3b", **kw)

@@ -633,30 +633,6 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
     return Tensor(out.reshape(nt, c, h, w), _internal=True)
 
 
-# attention (reference: incubate fused_multi_head_attention /
-# sparse_attention; here a plain SDPA that XLA fuses; the Pallas flash
-# kernel lives in paddle_tpu/ops/pallas_ops.py and is used when available)
-def scaled_dot_product_attention(query, key, value, attn_mask=None,
-                                 dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
-    import math as pymath
-    d = query.shape[-1]
-    scores = _m.multiply(_m.matmul(query, key, transpose_y=True),
-                         1.0 / pymath.sqrt(d))
-    if is_causal:
-        import jax.numpy as jnp
-        L, S = scores.shape[-2], scores.shape[-1]
-        causal = Tensor(jnp.tril(jnp.ones((L, S), bool)), _internal=True)
-        scores = _m.where(causal, scores,
-                          Tensor(np.asarray(-1e9, np.float32)))
-    if attn_mask is not None:
-        scores = _m.add(scores, attn_mask)
-    attn = softmax(scores, axis=-1)
-    if dropout_p > 0.0 and training:
-        attn = dropout(attn, dropout_p, training=training)
-    return _m.matmul(attn, value)
-
-
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     import jax.numpy as jnp
     if maxlen is None:

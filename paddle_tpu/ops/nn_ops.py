@@ -676,7 +676,7 @@ def sdpa(q, k, v, mask, key, *, dropout_p=0.0, causal=False,
     """q/k/v: [B, H, T, D]; mask: additive float, broadcastable to
     [B, H, Tq, Tk]."""
     d = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (1.0 / np.sqrt(d))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (float(d) ** -0.5)
     if causal:
         Tq, Tk = s.shape[-2], s.shape[-1]
         cm = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)

@@ -84,7 +84,7 @@ def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False):
     """q/k/v: [B, H, Tq|Tk, D] → out [B, H, Tq, D]."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    sm_scale = 1.0 / np.sqrt(D)
+    sm_scale = float(D) ** -0.5
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
     qr = q.reshape(B * H, Tq, D)
@@ -111,7 +111,7 @@ def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False):
 def _xla_attention(q, k, v, causal):
     d = q.shape[-1]
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * (1.0 / np.sqrt(d))
+                   k.astype(jnp.float32)) * (float(d) ** -0.5)
     if causal:
         Tq, Tk = s.shape[-2], s.shape[-1]
         cm = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
