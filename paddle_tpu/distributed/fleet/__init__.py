@@ -118,7 +118,8 @@ def init(role_maker=None, is_collective=True, strategy=None):
         dp = max(1, n_dev // denom)
     topo = CommunicateTopology(("data", "pipe", "sharding", "model", "sep"),
                                (dp, pp, sd, mp, sep))
-    _state.hcg = HybridCommunicateGroup(topo)
+    _state.hcg = HybridCommunicateGroup(
+        topo, sep_method=hybrid.get("sep_method", "ring"))
     _set_hcg(_state.hcg)
     _state.initialized = True
     return _state

@@ -1,0 +1,44 @@
+"""Sequence/context-parallel attention routing ("sep" mesh axis).
+
+NEW capability vs the reference (SURVEY.md §5: no ring attention /
+Ulysses / context parallel anywhere in the reference tree). Layers call
+`sep_attention_or_none(q, k, v, ...)`; when the active mesh has a sep
+axis of degree > 1 it runs ring attention (default) or Ulysses
+all-to-all attention (strategy.hybrid_configs["sep_method"] =
+"alltoall") via shard_map over the traced arrays, else returns None and
+the caller falls back to the dense/flash path.
+
+Attention-probability dropout is not implemented on the sep path: when
+the caller passes an active dropout_p this returns None and the caller's
+dense path (which does apply it) runs under the sep sharding constraints
+instead — semantics never silently change with parallelism layout."""
+from __future__ import annotations
+
+from ....framework import state
+from ....framework.tensor import Tensor
+from ....ops.ring_attention import ring_attention, ulysses_attention
+from .. import topology as _topo
+
+
+def sep_method() -> str:
+    hcg = _topo.get_hybrid_communicate_group()
+    return getattr(hcg, "sep_method", "ring") if hcg is not None else "ring"
+
+
+def sep_attention_or_none(q: Tensor, k: Tensor, v: Tensor, *,
+                          causal=True, method=None, dropout_p=0.0,
+                          training=False):
+    """q/k/v: [B, H, T, D] Tensors inside a mesh trace. Returns the
+    attention output Tensor, or None when sequence parallelism is off or
+    attention dropout is active (dense fallback keeps semantics)."""
+    mesh = state.current_mesh()
+    if mesh is None or "sep" not in mesh.shape or mesh.shape["sep"] <= 1:
+        return None
+    if dropout_p > 0.0 and training:
+        return None
+    method = method or sep_method()
+    batch_axes = tuple(a for a in ("dp", "sharding") if a in mesh.shape)
+    fn = ulysses_attention if method == "alltoall" else ring_attention
+    out = fn(q._data, k._data, v._data, mesh, seq_axis="sep",
+             batch_axes=batch_axes, head_axis="mp", causal=causal)
+    return Tensor(out, _internal=True)

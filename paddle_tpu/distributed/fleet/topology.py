@@ -119,7 +119,9 @@ class HybridCommunicateGroup:
 
     def __init__(self, topology: CommunicateTopology = None,
                  dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
-                 sep_degree=1, devices: Optional[Sequence] = None):
+                 sep_degree=1, sep_method="ring",
+                 devices: Optional[Sequence] = None):
+        self.sep_method = sep_method
         if topology is not None:
             dims = dict(zip(topology.get_hybrid_group_names(),
                             topology._dims))

@@ -46,9 +46,11 @@ __all__ = ["GPTModel", "GPTForPretraining", "GPTForPipeline",
 
 
 def _seq_spec():
-    """Activation spec [B, T, H] with batch on dp and sequence on sep."""
+    """Activation spec [B, T, H] with batch on the data axes and sequence
+    on sep (sequence parallelism: LayerNorm/MLP elementwise work splits
+    along T between attention calls)."""
     from jax.sharding import PartitionSpec as P
-    return P("dp", "sep", None)
+    return P(("dp", "sharding"), "sep", None)
 
 
 class GPTEmbeddings(Layer):
@@ -115,9 +117,20 @@ class GPTAttention(Layer):
             k = mp.concat([cache[0], k], axis=2)
             v = mp.concat([cache[1], v], axis=2)
             cache = (k, v)
-        out, _ = F.scaled_dot_product_attention(
-            q, k, v, is_causal=(cache is None or q.shape[2] > 1),
-            dropout_p=self.attn_dropout_prob, training=self.training)
+        causal = cache is None or q.shape[2] > 1
+        out = None
+        if cache is None:
+            # sequence-parallel ring/ulysses attention when a sep axis is
+            # active (sep_utils; NEW vs reference — SURVEY.md §5)
+            from ..distributed.fleet.meta_parallel.sep_utils import (
+                sep_attention_or_none)
+            out = sep_attention_or_none(
+                q, k, v, causal=causal, dropout_p=self.attn_dropout_prob,
+                training=self.training)
+        if out is None:
+            out, _ = F.scaled_dot_product_attention(
+                q, k, v, is_causal=causal,
+                dropout_p=self.attn_dropout_prob, training=self.training)
         out = out.transpose((0, 2, 1, 3)).reshape((B, T, self.hidden_size))
         out = self.dropout(self.out_proj(out))
         return out if cache is None else (out, cache)

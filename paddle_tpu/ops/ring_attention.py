@@ -1,0 +1,170 @@
+"""Ring attention + Ulysses all-to-all attention over a sequence-parallel
+mesh axis.
+
+NEW capability relative to the reference (SURVEY.md §5 "Long-context /
+sequence parallelism: ABSENT — no ring attention / Ulysses / CP"); the
+reference scales sequence length only via recompute + pipeline
+micro-batching + fused attention (operators/fused/fused_attention_op.cu).
+This module is the idiomatic-TPU upgrade: K/V blocks rotate around the
+"sep" ring with lax.ppermute (ICI neighbour exchange), combined with an
+online-softmax (flash-style) accumulator so the full [T, T] score matrix
+never materializes; or, Ulysses-style, heads and sequence are exchanged
+with lax.all_to_all and attention runs locally per head shard.
+
+Both run inside shard_map, nested in the surrounding jit: XLA sees the
+collectives explicitly and overlaps the ppermute with the block matmuls
+(MXU work hides ICI latency for T_local*D big enough).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _online_block(q, k_blk, v_blk, acc, l, m, *, scale, keep):
+    """Fold one K/V block into the online-softmax accumulator.
+
+    q [B,H,Tq,D], k_blk/v_blk [B,H,Tk,D], keep [Tq,Tk] bool mask.
+    Returns updated (acc [B,H,Tq,D] f32, l [B,H,Tq] f32, m [B,H,Tq] f32)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(keep[None, None], s, jnp.asarray(-1e30, s.dtype))
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # fully-masked rows keep m == -inf/-1e30: exp underflows to 0 safely
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)                    # rescale old accumulator
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + \
+        jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+    return acc_new, l_new, m_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
+    """Per-shard body (inside shard_map). q/k/v: [B, H, T_local, D] — the
+    sequence dim is the axis_name shard. Online-softmax across ring steps;
+    causal masking is done by GLOBAL positions so the result equals
+    full-sequence causal attention. Block 0 (the local K/V) is folded
+    before the scan so only size-1 ppermute rotations happen — none of
+    them wasted."""
+    size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t_local = q.shape[-2]
+    tq_pos = jnp.arange(t_local) + my_idx * t_local
+
+    def keep_for(kb):
+        if not causal:
+            return jnp.ones((t_local, t_local), bool)
+        tk = jnp.arange(t_local) + kb * t_local
+        return tq_pos[:, None] >= tk[None, :]
+
+    acc0 = jnp.zeros(q.shape[:-1] + (q.shape[-1],), jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    acc0, l0, m0 = _online_block(q, k, v, acc0, l0, m0, scale=scale,
+                                 keep=keep_for(my_idx))
+
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def step(carry, i):
+        acc, l, m, k_cur, v_cur = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        kb = (my_idx - i) % size                 # global block id of k_cur
+        acc, l, m = _online_block(q, k_cur, v_cur, acc, l, m, scale=scale,
+                                  keep=keep_for(kb))
+        return (acc, l, m, k_cur, v_cur), ()
+
+    (acc, l, m, _, _), _ = lax.scan(
+        step, (acc0, l0, m0, k, v), jnp.arange(1, size))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, seq_axis="sep", batch_axes=("dp",),
+                   head_axis="mp", causal=True, scale=None):
+    """Full-sequence attention with q/k/v sharded over `seq_axis` on dim 2.
+
+    q/k/v: jax arrays [B, H, T, D] (T = GLOBAL sequence). Returns [B,H,T,D]
+    with the same sharding. Differentiable (scan+ppermute transpose)."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    spec = P(batch_axes, head_axis if head_axis in mesh.shape else None,
+             seq_axis, None)
+    fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
+                           causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _blockwise_attention(q, k, v, *, causal, scale, block_k=512):
+    """Single-device flash-style attention: scan K/V in blocks with the
+    online-softmax accumulator, so the [Tq, Tk] score matrix never
+    materializes (only [Tq, block_k] tiles). q/k/v: [B,H,T,D]."""
+    t = k.shape[-2]
+    bk = min(block_k, t)
+    nblk = -(-t // bk)
+    pad = nblk * bk - t
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    tq_pos = jnp.arange(q.shape[-2])
+
+    acc = jnp.zeros(q.shape[:-1] + (q.shape[-1],), jnp.float32)
+    l = jnp.zeros(q.shape[:-1], jnp.float32)
+    m = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+
+    kb = jnp.moveaxis(k.reshape(k.shape[:2] + (nblk, bk, k.shape[-1])), 2, 0)
+    vb = jnp.moveaxis(v.reshape(v.shape[:2] + (nblk, bk, v.shape[-1])), 2, 0)
+
+    def step(carry, blk):
+        acc, l, m, i = carry
+        k_blk, v_blk = blk
+        tk = jnp.arange(bk) + i * bk
+        keep = tk[None, :] < t
+        if causal:
+            keep = keep & (tq_pos[:, None] >= tk[None, :])
+        else:
+            keep = jnp.broadcast_to(keep, (q.shape[-2], bk))
+        acc, l, m = _online_block(q, k_blk, v_blk, acc, l, m, scale=scale,
+                                  keep=keep)
+        return (acc, l, m, i + 1), ()
+
+    (acc, l, m, _), _ = lax.scan(step, (acc, l, m, 0), (kb, vb))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def _ulysses_local(q, k, v, *, axis_name, causal, scale):
+    """Ulysses (all-to-all) body: exchange sequence shards for head shards,
+    run blockwise (online-softmax) local attention on the full sequence /
+    subset of heads, exchange back. q/k/v local: [B, H, T_local, D]; H
+    divisible by ring size."""
+    def seq2head(x):
+        # [B,H,Tl,D] -> [B, H/size, T, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+    def head2seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+    o = _blockwise_attention(qh, kh, vh, causal=causal, scale=scale)
+    return head2seq(o)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis="sep",
+                      batch_axes=("dp",), head_axis="mp", causal=True,
+                      scale=None):
+    """DeepSpeed-Ulysses-style sequence parallelism: all_to_all turns the
+    sequence shard into a head shard, local attention sees the FULL
+    sequence. Needs num_heads_local % sep_degree == 0."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    spec = P(batch_axes, head_axis if head_axis in mesh.shape else None,
+             seq_axis, None)
+    fn = functools.partial(_ulysses_local, axis_name=seq_axis,
+                           causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
