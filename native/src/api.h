@@ -1,0 +1,77 @@
+// C ABI of the paddle_tpu native runtime library.
+//
+// TPU-native C++ equivalents of the reference's C++ runtime layer
+// (reference: paddle/fluid/memory/allocation/auto_growth_best_fit_allocator.cc,
+// framework/data_feed.cc, platform/profiler.cc,
+// operators/reader/blocking_queue.h). Python binds via ctypes
+// (paddle_tpu/native/__init__.py) — no pybind11 in this image.
+#pragma once
+#include <cstddef>
+#include <cstdint>
+
+#if defined(_WIN32)
+#define PT_EXPORT __declspec(dllexport)
+#else
+#define PT_EXPORT __attribute__((visibility("default")))
+#endif
+
+extern "C" {
+
+// ---- host arena allocator (auto-growth best-fit with coalescing) ----------
+typedef void* pt_arena_t;
+PT_EXPORT pt_arena_t pt_arena_create(size_t chunk_bytes, size_t alignment);
+PT_EXPORT void pt_arena_destroy(pt_arena_t);
+PT_EXPORT void* pt_arena_alloc(pt_arena_t, size_t bytes);
+PT_EXPORT void pt_arena_free(pt_arena_t, void* p);
+// stats: [0]=reserved_bytes [1]=in_use_bytes [2]=n_allocs [3]=n_frees
+//        [4]=n_chunks [5]=peak_in_use
+PT_EXPORT void pt_arena_stats(pt_arena_t, uint64_t out[6]);
+
+// ---- blocking bounded queue (DataLoader double-buffering) -----------------
+typedef void* pt_queue_t;
+PT_EXPORT pt_queue_t pt_queue_create(size_t capacity);
+PT_EXPORT void pt_queue_destroy(pt_queue_t);
+// push/pop opaque pointers; timeout_ms < 0 = block forever.
+// return 0 on success, 1 on timeout, 2 on closed.
+PT_EXPORT int pt_queue_push(pt_queue_t, void* item, int64_t timeout_ms);
+PT_EXPORT int pt_queue_pop(pt_queue_t, void** item, int64_t timeout_ms);
+PT_EXPORT void pt_queue_close(pt_queue_t);
+PT_EXPORT size_t pt_queue_size(pt_queue_t);
+
+// ---- profiler: RecordEvent spans + chrome-trace export --------------------
+PT_EXPORT void pt_prof_enable(int on);
+PT_EXPORT int64_t pt_prof_begin(const char* name, const char* category);
+PT_EXPORT void pt_prof_end(int64_t handle);
+// instant event (counter-style annotations)
+PT_EXPORT void pt_prof_instant(const char* name, const char* category);
+// serialize all finished spans as chrome://tracing JSON into caller buffer;
+// returns bytes needed (call with buf=null to size), writes at most cap.
+PT_EXPORT size_t pt_prof_dump_json(char* buf, size_t cap);
+PT_EXPORT void pt_prof_clear(void);
+PT_EXPORT size_t pt_prof_num_events(void);
+
+// ---- MultiSlot data feed: parse slot-based text records -------------------
+// Format per line (reference data_feed.cc MultiSlotDataFeed):
+//   <num><space><v1>...<vnum>  repeated per slot, slots space-separated.
+// Slot types are declared at creation: 0 = int64, 1 = float32.
+typedef void* pt_feed_t;
+PT_EXPORT pt_feed_t pt_feed_create(const int* slot_types, int num_slots,
+                                   int batch_size);
+PT_EXPORT void pt_feed_destroy(pt_feed_t);
+// add a file to the roster (read lazily by worker threads)
+PT_EXPORT int pt_feed_add_file(pt_feed_t, const char* path);
+// start N parser threads; safe to call once
+PT_EXPORT void pt_feed_start(pt_feed_t, int num_threads);
+// fetch next parsed batch. For slot s the caller receives:
+//   lens[s]  — number of values (concatenated over batch rows)
+//   offs[s]  — pointer to int64[batch_size+1] row offsets (LoD)
+//   data[s]  — pointer to the value buffer (int64* or float*)
+// Returns number of rows in the batch (0 = end of data).
+// Buffers stay valid until the next call / destroy.
+PT_EXPORT int pt_feed_next(pt_feed_t, int64_t** offs, void** data,
+                           int64_t* lens);
+
+// ---- version ---------------------------------------------------------------
+PT_EXPORT const char* pt_native_version(void);
+
+}  // extern "C"

@@ -1,0 +1,156 @@
+// Auto-growth best-fit host arena with free-block coalescing.
+//
+// TPU-native equivalent of the reference's AutoGrowthBestFitAllocator
+// (reference: paddle/fluid/memory/allocation/
+// auto_growth_best_fit_allocator.cc). On TPU the device heap belongs to
+// XLA/PJRT; what the framework still owns is HOST staging memory for the
+// input pipeline (batch assembly before device_put). Same strategy as the
+// reference: carve from large chunks, best-fit on a size-ordered free map,
+// coalesce neighbours on free, grow by max(chunk, request).
+#include "api.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Block {
+  char* ptr;
+  size_t size;
+  bool free;
+  Block* prev = nullptr;  // address-ordered neighbours within the chunk
+  Block* next = nullptr;
+};
+
+class Arena {
+ public:
+  Arena(size_t chunk_bytes, size_t alignment)
+      : chunk_(chunk_bytes ? chunk_bytes : (8u << 20)),
+        align_(alignment ? alignment : 64) {}
+
+  ~Arena() {
+    // every Block lives in exactly one of the two maps
+    for (auto& kv : free_by_size_) delete kv.second;
+    for (auto& kv : by_ptr_) delete kv.second;
+    for (void* c : chunks_) std::free(c);
+  }
+
+  void* Alloc(size_t bytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    bytes = Align(bytes ? bytes : 1);
+    auto it = free_by_size_.lower_bound({bytes, nullptr});
+    Block* b;
+    if (it == free_by_size_.end()) {
+      b = Grow(bytes);
+      if (!b) return nullptr;
+    } else {
+      b = it->second;
+      free_by_size_.erase(it);
+    }
+    if (b->size >= bytes + align_) {  // split the tail back to free list
+      Block* tail = new Block{b->ptr + bytes, b->size - bytes, true,
+                              b, b->next};
+      if (b->next) b->next->prev = tail;
+      b->next = tail;
+      b->size = bytes;
+      free_by_size_.insert({{tail->size, tail}, tail});
+    }
+    b->free = false;
+    by_ptr_[b->ptr] = b;
+    in_use_ += b->size;
+    if (in_use_ > peak_) peak_ = in_use_;
+    ++n_allocs_;
+    return b->ptr;
+  }
+
+  void Free(void* p) {
+    if (!p) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = by_ptr_.find(static_cast<char*>(p));
+    if (it == by_ptr_.end()) return;  // not ours / double free: ignore
+    Block* b = it->second;
+    by_ptr_.erase(it);
+    in_use_ -= b->size;
+    ++n_frees_;
+    b->free = true;
+    // coalesce with next, then prev
+    if (b->next && b->next->free) {
+      Block* n = b->next;
+      EraseFree(n);
+      b->size += n->size;
+      b->next = n->next;
+      if (n->next) n->next->prev = b;
+      delete n;
+    }
+    if (b->prev && b->prev->free) {
+      Block* pr = b->prev;
+      EraseFree(pr);
+      pr->size += b->size;
+      pr->next = b->next;
+      if (b->next) b->next->prev = pr;
+      delete b;
+      b = pr;
+    }
+    free_by_size_.insert({{b->size, b}, b});
+  }
+
+  void Stats(uint64_t out[6]) {
+    std::lock_guard<std::mutex> g(mu_);
+    out[0] = reserved_;
+    out[1] = in_use_;
+    out[2] = n_allocs_;
+    out[3] = n_frees_;
+    out[4] = chunks_.size();
+    out[5] = peak_;
+  }
+
+ private:
+  size_t Align(size_t n) const { return (n + align_ - 1) & ~(align_ - 1); }
+
+  void EraseFree(Block* b) { free_by_size_.erase({b->size, b}); }
+
+  Block* Grow(size_t need) {
+    size_t sz = need > chunk_ ? Align(need) : chunk_;
+    void* mem = nullptr;
+    if (posix_memalign(&mem, align_ < sizeof(void*) ? sizeof(void*) : align_,
+                       sz) != 0)
+      return nullptr;
+    chunks_.push_back(mem);
+    reserved_ += sz;
+    return new Block{static_cast<char*>(mem), sz, true, nullptr, nullptr};
+  }
+
+  std::mutex mu_;
+  size_t chunk_, align_;
+  std::vector<void*> chunks_;
+  // (size, block) ordered set = best-fit lookup via lower_bound
+  std::map<std::pair<size_t, Block*>, Block*> free_by_size_;
+  std::unordered_map<char*, Block*> by_ptr_;
+  uint64_t reserved_ = 0, in_use_ = 0, peak_ = 0;
+  uint64_t n_allocs_ = 0, n_frees_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+pt_arena_t pt_arena_create(size_t chunk_bytes, size_t alignment) {
+  return new (std::nothrow) Arena(chunk_bytes, alignment);
+}
+void pt_arena_destroy(pt_arena_t a) { delete static_cast<Arena*>(a); }
+void* pt_arena_alloc(pt_arena_t a, size_t bytes) {
+  return static_cast<Arena*>(a)->Alloc(bytes);
+}
+void pt_arena_free(pt_arena_t a, void* p) { static_cast<Arena*>(a)->Free(p); }
+void pt_arena_stats(pt_arena_t a, uint64_t out[6]) {
+  static_cast<Arena*>(a)->Stats(out);
+}
+
+const char* pt_native_version(void) { return "paddle_tpu_native 0.1"; }
+
+}  // extern "C"

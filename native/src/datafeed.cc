@@ -1,0 +1,212 @@
+// MultiSlot text data feed: threaded file parsing into LoD batches.
+//
+// TPU-native equivalent of the reference's C++ DataFeed
+// (reference: paddle/fluid/framework/data_feed.cc MultiSlotDataFeed —
+// line format "<num> v1 ... vnum" per slot; data_feed.h:505,692) and the
+// file-roster Dataset (data_set.h:161). Worker threads pull files from a
+// shared roster, parse records, and push them to a bounded queue; the
+// trainer thread assembles fixed-size batches with ragged row offsets
+// (the LoD) — on TPU the offsets become segment-ids/masks instead of a
+// runtime LoD type.
+#include "api.h"
+
+#include <atomic>
+#include <cctype>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Record {
+  // per slot: either int64 or float values
+  std::vector<std::vector<int64_t>> ints;
+  std::vector<std::vector<float>> floats;
+};
+
+class Feed {
+ public:
+  Feed(const int* slot_types, int num_slots, int batch_size)
+      : types_(slot_types, slot_types + num_slots), batch_(batch_size) {}
+
+  ~Feed() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  int AddFile(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return 1;
+    std::fclose(f);
+    files_.push_back(path);
+    return 0;
+  }
+
+  void Start(int n_threads) {
+    if (started_) return;
+    started_ = true;
+    if (n_threads < 1) n_threads = 1;
+    active_.store(n_threads);
+    for (int i = 0; i < n_threads; ++i)
+      threads_.emplace_back([this] { Worker(); });
+  }
+
+  // assemble up to batch_ records; returns rows
+  int Next(int64_t** offs, void** data, int64_t* lens) {
+    std::vector<Record> rows;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      while (true) {
+        while (!q_.empty() && (int)rows.size() < batch_) {
+          rows.push_back(std::move(q_.front()));
+          q_.pop_front();
+          cv_.notify_all();
+        }
+        if ((int)rows.size() == batch_) break;
+        if (active_.load() == 0 && q_.empty()) break;  // drained
+        cv_.wait(lk, [&] {
+          return !q_.empty() || (active_.load() == 0) || stop_;
+        });
+        if (stop_) break;
+      }
+    }
+    if (rows.empty()) return 0;
+    size_t ns = types_.size();
+    offs_.assign(ns, {});
+    ints_.assign(ns, {});
+    floats_.assign(ns, {});
+    for (size_t s = 0; s < ns; ++s) {
+      offs_[s].reserve(rows.size() + 1);
+      offs_[s].push_back(0);
+      for (auto& r : rows) {
+        size_t n = types_[s] == 0 ? r.ints[s].size() : r.floats[s].size();
+        offs_[s].push_back(offs_[s].back() + (int64_t)n);
+        if (types_[s] == 0)
+          ints_[s].insert(ints_[s].end(), r.ints[s].begin(),
+                          r.ints[s].end());
+        else
+          floats_[s].insert(floats_[s].end(), r.floats[s].begin(),
+                            r.floats[s].end());
+      }
+      offs[s] = offs_[s].data();
+      lens[s] = (int64_t)(types_[s] == 0 ? ints_[s].size()
+                                         : floats_[s].size());
+      data[s] = types_[s] == 0 ? (void*)ints_[s].data()
+                               : (void*)floats_[s].data();
+    }
+    return (int)rows.size();
+  }
+
+ private:
+  void Worker() {
+    while (true) {
+      size_t fi = next_file_.fetch_add(1);
+      if (fi >= files_.size()) break;
+      ParseFile(files_[fi]);
+    }
+    if (active_.fetch_sub(1) == 1) cv_.notify_all();
+  }
+
+  void ParseFile(const std::string& path) {
+    FILE* f = std::fopen(path.c_str(), "rb");
+    if (!f) return;
+    std::string line;
+    char buf[1 << 16];
+    while (std::fgets(buf, sizeof buf, f)) {
+      line.assign(buf);
+      // join continuation if the line was longer than buf
+      while (!line.empty() && line.back() != '\n' &&
+             std::fgets(buf, sizeof buf, f))
+        line += buf;
+      Record r;
+      if (ParseLine(line.c_str(), &r)) Push(std::move(r));
+      if (stop_) break;
+    }
+    std::fclose(f);
+  }
+
+  bool ParseLine(const char* p, Record* r) {
+    size_t ns = types_.size();
+    r->ints.resize(ns);
+    r->floats.resize(ns);
+    for (size_t s = 0; s < ns; ++s) {
+      char* end;
+      long long n = std::strtoll(p, &end, 10);
+      if (end == p || n < 0) return false;  // malformed: drop record
+      p = end;
+      if (types_[s] == 0) {
+        r->ints[s].reserve(n);
+        for (long long i = 0; i < n; ++i) {
+          long long v = std::strtoll(p, &end, 10);
+          if (end == p) return false;
+          r->ints[s].push_back(v);
+          p = end;
+        }
+      } else {
+        r->floats[s].reserve(n);
+        for (long long i = 0; i < n; ++i) {
+          float v = std::strtof(p, &end);
+          if (end == p) return false;
+          r->floats[s].push_back(v);
+          p = end;
+        }
+      }
+    }
+    return true;
+  }
+
+  void Push(Record&& r) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return q_.size() < kQueueCap || stop_; });
+    if (stop_) return;
+    q_.push_back(std::move(r));
+    cv_.notify_all();
+  }
+
+  static constexpr size_t kQueueCap = 4096;
+  std::vector<int> types_;
+  int batch_;
+  std::vector<std::string> files_;
+  std::atomic<size_t> next_file_{0};
+  std::atomic<int> active_{0};
+  bool started_ = false, stop_ = false;
+  std::deque<Record> q_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<std::thread> threads_;
+  // batch output buffers (valid until next call)
+  std::vector<std::vector<int64_t>> offs_, ints_;
+  std::vector<std::vector<float>> floats_;
+};
+
+}  // namespace
+
+extern "C" {
+
+pt_feed_t pt_feed_create(const int* slot_types, int num_slots,
+                         int batch_size) {
+  return new (std::nothrow) Feed(slot_types, num_slots, batch_size);
+}
+void pt_feed_destroy(pt_feed_t f) { delete static_cast<Feed*>(f); }
+int pt_feed_add_file(pt_feed_t f, const char* path) {
+  return static_cast<Feed*>(f)->AddFile(path);
+}
+void pt_feed_start(pt_feed_t f, int num_threads) {
+  static_cast<Feed*>(f)->Start(num_threads);
+}
+int pt_feed_next(pt_feed_t f, int64_t** offs, void** data, int64_t* lens) {
+  return static_cast<Feed*>(f)->Next(offs, data, lens);
+}
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+// RecordEvent span profiler with chrome://tracing JSON export.
+//
+// TPU-native equivalent of the reference's profiler
+// (reference: paddle/fluid/platform/profiler.cc RecordEvent /
+// EnableProfiler, device_tracer.cc chrome-trace export via
+// tools/timeline.py). Spans are recorded per-thread with nanosecond
+// wall-clock stamps into lock-striped buffers; pt_prof_dump_json emits the
+// Trace Event Format consumed by chrome://tracing / Perfetto. Device-side
+// (XLA) timelines come from the jax profiler; this recorder covers the
+// HOST side: op dispatch, data pipeline, step boundaries.
+#include "api.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  std::string cat;
+  uint64_t tid;
+  int64_t ts_us_x1000;  // ns precision, exported as fractional us
+  int64_t dur_ns;       // -1 = instant
+};
+
+struct Open {
+  std::string name;
+  std::string cat;
+  uint64_t tid;
+  int64_t t0_ns;
+};
+
+std::mutex g_mu;
+std::vector<Event> g_events;
+std::vector<Open> g_open;   // index+1 = handle
+std::atomic<bool> g_enabled{false};
+std::atomic<int64_t> g_next{1};
+
+int64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+uint64_t Tid() {
+  return std::hash<std::thread::id>{}(std::this_thread::get_id());
+}
+
+void JsonEscape(const std::string& in, std::string* out) {
+  for (char c : in) {
+    if (c == '"' || c == '\\') { out->push_back('\\'); out->push_back(c); }
+    else if (c == '\n') *out += "\\n";
+    else out->push_back(c);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_prof_enable(int on) { g_enabled.store(on != 0); }
+
+int64_t pt_prof_begin(const char* name, const char* category) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return 0;
+  std::lock_guard<std::mutex> g(g_mu);
+  g_open.push_back({name ? name : "", category ? category : "op", Tid(),
+                    NowNs()});
+  return static_cast<int64_t>(g_open.size());  // handle = index+1
+}
+
+void pt_prof_end(int64_t handle) {
+  if (handle <= 0) return;
+  std::lock_guard<std::mutex> g(g_mu);
+  size_t idx = static_cast<size_t>(handle) - 1;
+  if (idx >= g_open.size()) return;
+  Open& o = g_open[idx];
+  if (o.t0_ns < 0) return;  // already closed
+  int64_t t1 = NowNs();
+  g_events.push_back({o.name, o.cat, o.tid, o.t0_ns, t1 - o.t0_ns});
+  o.t0_ns = -1;
+}
+
+void pt_prof_instant(const char* name, const char* category) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  std::lock_guard<std::mutex> g(g_mu);
+  g_events.push_back({name ? name : "", category ? category : "marker",
+                      Tid(), NowNs(), -1});
+}
+
+size_t pt_prof_dump_json(char* buf, size_t cap) {
+  std::lock_guard<std::mutex> g(g_mu);
+  std::string out = "{\"traceEvents\":[";
+  char tmp[256];
+  bool first = true;
+  for (const Event& e : g_events) {
+    if (!first) out += ",";
+    first = false;
+    std::string name;
+    JsonEscape(e.name, &name);
+    double ts_us = e.ts_us_x1000 / 1000.0;
+    if (e.dur_ns >= 0) {
+      snprintf(tmp, sizeof tmp,
+               "{\"ph\":\"X\",\"pid\":1,\"tid\":%llu,\"ts\":%.3f,"
+               "\"dur\":%.3f,\"cat\":\"%s\",\"name\":\"",
+               (unsigned long long)(e.tid % 100000), ts_us,
+               e.dur_ns / 1000.0, e.cat.c_str());
+    } else {
+      snprintf(tmp, sizeof tmp,
+               "{\"ph\":\"i\",\"pid\":1,\"tid\":%llu,\"ts\":%.3f,"
+               "\"s\":\"t\",\"cat\":\"%s\",\"name\":\"",
+               (unsigned long long)(e.tid % 100000), ts_us, e.cat.c_str());
+    }
+    out += tmp;
+    out += name;
+    out += "\"}";
+  }
+  out += "]}";
+  if (buf && cap) {
+    size_t n = out.size() < cap - 1 ? out.size() : cap - 1;
+    std::memcpy(buf, out.data(), n);
+    buf[n] = 0;
+  }
+  return out.size() + 1;
+}
+
+void pt_prof_clear(void) {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_events.clear();
+  g_open.clear();
+}
+
+size_t pt_prof_num_events(void) {
+  std::lock_guard<std::mutex> g(g_mu);
+  return g_events.size();
+}
+
+}  // extern "C"

@@ -1,0 +1,89 @@
+// Bounded blocking MPMC queue.
+//
+// TPU-native equivalent of the reference's reader blocking queue
+// (reference: paddle/fluid/operators/reader/blocking_queue.h and
+// lod_tensor_blocking_queue.h) used for DataLoader double-buffering:
+// producer threads park parsed host batches, the trainer thread pops and
+// device_puts while the next batch is being assembled.
+#include "api.h"
+
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <new>
+
+namespace {
+
+class Queue {
+ public:
+  explicit Queue(size_t cap) : cap_(cap ? cap : 1) {}
+
+  int Push(void* item, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!Wait(lk, timeout_ms, [&] { return q_.size() < cap_ || closed_; }))
+      return 1;
+    if (closed_) return 2;
+    q_.push_back(item);
+    cond_.notify_all();
+    return 0;
+  }
+
+  int Pop(void** item, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!Wait(lk, timeout_ms, [&] { return !q_.empty() || closed_; }))
+      return 1;
+    if (q_.empty()) return 2;  // closed and drained
+    *item = q_.front();
+    q_.pop_front();
+    cond_.notify_all();
+    return 0;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> g(mu_);
+    closed_ = true;
+    cond_.notify_all();
+  }
+
+  size_t Size() {
+    std::lock_guard<std::mutex> g(mu_);
+    return q_.size();
+  }
+
+ private:
+  template <class Pred>
+  bool Wait(std::unique_lock<std::mutex>& lk, int64_t timeout_ms, Pred p) {
+    if (timeout_ms < 0) {
+      cond_.wait(lk, p);
+      return true;
+    }
+    return cond_.wait_for(lk, std::chrono::milliseconds(timeout_ms), p);
+  }
+
+  size_t cap_;
+  bool closed_ = false;
+  std::deque<void*> q_;
+  std::mutex mu_;
+  // one cv for both directions keeps Wait simple (notify_all on change)
+  std::condition_variable cond_;
+};
+
+}  // namespace
+
+extern "C" {
+
+pt_queue_t pt_queue_create(size_t capacity) {
+  return new (std::nothrow) Queue(capacity);
+}
+void pt_queue_destroy(pt_queue_t q) { delete static_cast<Queue*>(q); }
+int pt_queue_push(pt_queue_t q, void* item, int64_t timeout_ms) {
+  return static_cast<Queue*>(q)->Push(item, timeout_ms);
+}
+int pt_queue_pop(pt_queue_t q, void** item, int64_t timeout_ms) {
+  return static_cast<Queue*>(q)->Pop(item, timeout_ms);
+}
+void pt_queue_close(pt_queue_t q) { static_cast<Queue*>(q)->Close(); }
+size_t pt_queue_size(pt_queue_t q) { return static_cast<Queue*>(q)->Size(); }
+
+}  // extern "C"

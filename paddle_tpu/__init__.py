@@ -49,6 +49,8 @@ from . import distributed  # noqa: F401
 from . import device  # noqa: F401
 from . import static  # noqa: F401
 from . import amp  # noqa: F401
+from . import utils  # noqa: F401
+from . import models  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -42,6 +42,7 @@ __all__ = [
     "get_hybrid_communicate_group", "worker_num", "worker_index",
     "is_first_worker", "worker_endpoints", "barrier_worker", "recompute",
     "meta_parallel", "HybridParallelOptimizer", "DygraphShardingOptimizer",
+    "QueueDataset", "InMemoryDataset",
 ]
 
 
@@ -96,6 +97,9 @@ class _FleetState:
 
 
 _state = _FleetState()
+
+
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
 
 
 def init(role_maker=None, is_collective=True, strategy=None):

@@ -1,0 +1,167 @@
+"""File-sharded slot dataset over the C++ MultiSlot feed.
+
+TPU-native equivalent of the reference's fleet Dataset facade
+(reference: python/paddle/distributed/fleet/dataset/dataset.py:24-192
+QueueDataset/InMemoryDataset over the C++ MultiSlotDataset,
+framework/data_feed.cc parser, data_set.h:161). The filelist is sharded
+across HOST PROCESSES (jax.process_index round-robin, the
+util_factory.get_file_shard equivalent) — within one host the single
+controller feeds the whole per-host batch, so no per-device split.
+Parsing runs in C++ threads (native/src/datafeed.cc); batches come out as
+dense numpy values with LoD-style offsets (→ masks/segment ids on TPU)."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+def _shard_files(files: Sequence[str]) -> List[str]:
+    """Round-robin shard of the roster by host process (reference:
+    fleet/base/util_factory.py get_file_shard)."""
+    import jax
+    n, i = jax.process_count(), jax.process_index()
+    if n <= 1:
+        return list(files)
+    return [f for k, f in enumerate(files) if k % n == i]
+
+
+class QueueDataset:
+    """Streaming slot dataset: set_filelist → iterate batches."""
+
+    def __init__(self):
+        self._slots: List[str] = []
+        self._types: List[str] = []
+        self._batch = 1
+        self._threads = 2
+        self._files: List[str] = []
+
+    # reference API surface -------------------------------------------------
+    def init(self, batch_size=1, thread_num=2, use_var=None,
+             pipe_command=None, input_type=0):
+        self._batch = int(batch_size)
+        self._threads = int(thread_num)
+        return self
+
+    def set_batch_size(self, batch_size):
+        self._batch = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._threads = int(thread_num)
+
+    def set_use_var(self, slots):
+        """slots: list of (name, dtype) or framework Variables/Tensors."""
+        self._slots, self._types = [], []
+        for s in slots:
+            if isinstance(s, tuple):
+                name, dtype = s
+            else:
+                name = getattr(s, "name", str(s))
+                dtype = str(getattr(s, "dtype", "int64"))
+            self._slots.append(name)
+            self._types.append("int64" if "int" in dtype else "float32")
+
+    def set_filelist(self, files: Sequence[str]):
+        self._files = list(files)
+
+    def get_filelist(self):
+        return list(self._files)
+
+    def slots(self):
+        return list(self._slots)
+
+    # iteration -------------------------------------------------------------
+    def __iter__(self):
+        from ... import native
+        if not native.available():
+            yield from self._py_iter()
+            return
+        feed = native.MultiSlotFeed(self._types, self._batch)
+        for f in _shard_files(self._files):
+            feed.add_file(f)
+        feed.start(self._threads)
+        while True:
+            batch = feed.next_batch()
+            if batch is None:
+                return
+            yield batch
+
+    def _py_iter(self):
+        """Pure-python fallback parser (same line format)."""
+        import numpy as np
+        rows = []
+        for path in _shard_files(self._files):
+            with open(path) as f:
+                for line in f:
+                    toks = line.split()
+                    rec, i, ok = [], 0, True
+                    for t in self._types:
+                        if i >= len(toks):
+                            ok = False
+                            break
+                        n = int(toks[i])
+                        i += 1
+                        vals = toks[i:i + n]
+                        i += n
+                        if len(vals) != n:
+                            ok = False
+                            break
+                        rec.append(np.asarray(
+                            vals, np.int64 if t == "int64" else np.float32))
+                    if ok:
+                        rows.append(rec)
+                    if len(rows) == self._batch:
+                        yield self._assemble(rows)
+                        rows = []
+        if rows:
+            yield self._assemble(rows)
+
+    def _assemble(self, rows):
+        import numpy as np
+        out = []
+        for s in range(len(self._types)):
+            vals = [r[s] for r in rows]
+            offs = np.zeros(len(rows) + 1, np.int64)
+            np.cumsum([len(v) for v in vals], out=offs[1:])
+            out.append((offs, np.concatenate(vals) if vals else
+                        np.empty((0,))))
+        return out
+
+
+class InMemoryDataset(QueueDataset):
+    """reference: dataset.py InMemoryDataset — loads all RECORDS into
+    memory, shuffles at record granularity (batch composition changes
+    every shuffle, like the reference), then re-batches on iteration."""
+
+    def __init__(self):
+        super().__init__()
+        self._records = None
+
+    def load_into_memory(self):
+        records = []
+        for batch in super().__iter__():
+            rows = len(batch[0][0]) - 1
+            for r in range(rows):
+                records.append([vals[offs[r]:offs[r + 1]]
+                                for offs, vals in batch])
+        self._records = records
+
+    def local_shuffle(self, seed=None):
+        import numpy as np
+        if self._records is None:
+            self.load_into_memory()
+        rs = np.random.RandomState(seed)
+        rs.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=None):
+        # single-controller: global == local shuffle over the host's shard
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        self._records = None
+
+    def __iter__(self):
+        if self._records is None:
+            yield from super().__iter__()
+            return
+        for i in range(0, len(self._records), self._batch):
+            chunk = self._records[i:i + self._batch]
+            yield self._assemble(chunk)

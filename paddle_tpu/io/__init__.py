@@ -312,24 +312,33 @@ class DataLoader:
             yield from self._raw_iter()
             return
         # background prefetch thread (double buffering; the host→device copy
-        # overlaps with compute because jax device_put is async)
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
+        # overlaps with compute because jax device_put is async). Uses the
+        # C++ blocking queue (native/src/queue.cc — the reference's
+        # operators/reader/blocking_queue.h) when built, else queue.Queue.
+        from .. import native as _native
+        use_native = _native.available()
+        if use_native:
+            q = _native.NativeQueue(capacity=self.prefetch_factor)
+            put, get = q.push, q.pop
+        else:
+            pyq: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
+            put, get = pyq.put, pyq.get
         sentinel = object()
         err = []
 
         def producer():
             try:
                 for item in self._raw_iter():
-                    q.put(item)
+                    put(item)
             except BaseException as e:  # noqa: BLE001
                 err.append(e)
             finally:
-                q.put(sentinel)
+                put(sentinel)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         while True:
-            item = q.get()
+            item = get()
             if item is sentinel:
                 if err:
                     raise err[0]

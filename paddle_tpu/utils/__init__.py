@@ -1,0 +1,3 @@
+"""Utilities (reference: python/paddle/utils/)."""
+from . import profiler  # noqa: F401
+from .profiler import RecordEvent  # noqa: F401

@@ -1,0 +1,146 @@
+"""Host-side profiler: RecordEvent spans + chrome-trace export.
+
+TPU-native equivalent of the reference's profiler stack
+(reference: paddle/fluid/platform/profiler.h:130 RecordEvent RAII spans,
+python/paddle/fluid/profiler.py start_profiler/stop_profiler,
+tools/timeline.py chrome-trace writer). Host spans are recorded by the
+C++ native recorder (native/src/profiler.cc) when built, else a python
+fallback; DEVICE-side timelines come from `jax.profiler` (XLA traces) —
+`start_profiler(tracer_option="All")` starts a jax trace alongside.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Optional
+
+_py_events = []
+_py_lock = threading.Lock()
+_enabled = False
+_native_rec = None
+_jax_trace_dir: Optional[str] = None
+
+
+def _native():
+    global _native_rec
+    if _native_rec is None:
+        from .. import native
+        if native.available():
+            _native_rec = native.TraceRecorder()
+        else:
+            _native_rec = False
+    return _native_rec or None
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   jax_trace_dir=None):
+    """reference: fluid/profiler.py start_profiler."""
+    global _enabled, _jax_trace_dir
+    _enabled = True
+    rec = _native()
+    if rec:
+        rec.enable(True)
+    if jax_trace_dir or tracer_option == "All":
+        import jax
+        _jax_trace_dir = jax_trace_dir or "/tmp/paddle_tpu_jax_trace"
+        jax.profiler.start_trace(_jax_trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """reference: fluid/profiler.py stop_profiler — writes chrome trace."""
+    global _enabled, _jax_trace_dir
+    _enabled = False
+    rec = _native()
+    if _jax_trace_dir is not None:
+        import jax
+        jax.profiler.stop_trace()
+        _jax_trace_dir = None
+    data = export_chrome_trace()
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(data)
+    if rec:
+        rec.enable(False)
+    return data
+
+
+def export_chrome_trace() -> str:
+    rec = _native()
+    if rec:
+        return rec.dump_json()
+    with _py_lock:
+        evs = [{"ph": "X", "pid": 1, "tid": e[3], "ts": e[1] * 1e6,
+                "dur": e[2] * 1e6, "cat": e[4], "name": e[0]}
+               for e in _py_events]
+    return json.dumps({"traceEvents": evs})
+
+
+def reset_profiler():
+    rec = _native()
+    if rec:
+        rec.clear()
+    with _py_lock:
+        _py_events.clear()
+
+
+def num_events() -> int:
+    rec = _native()
+    if rec:
+        return rec.num_events()
+    with _py_lock:
+        return len(_py_events)
+
+
+class RecordEvent:
+    """Context manager / explicit span (reference: platform/profiler.h:130
+    RecordEvent + python wrapper)."""
+
+    def __init__(self, name: str, category: str = "op"):
+        self.name = name
+        self.category = category
+        self._h = None
+        self._t0 = None
+
+    def begin(self):
+        if not _enabled:
+            return
+        rec = _native()
+        if rec:
+            self._h = rec.begin(self.name, self.category)
+        else:
+            self._t0 = time.perf_counter()
+
+    def end(self):
+        if not _enabled:
+            return
+        rec = _native()
+        if rec and self._h is not None:
+            rec.end(self._h)
+            self._h = None
+        elif self._t0 is not None:
+            dt = time.perf_counter() - self._t0
+            with _py_lock:
+                _py_events.append((self.name, self._t0, dt,
+                                   threading.get_ident() % 100000,
+                                   self.category))
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+@contextlib.contextmanager
+def profiler(state="All", tracer_option="Default", profile_path="/tmp/profile"):
+    """reference: fluid/profiler.py profiler context manager."""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(profile_path=profile_path)
