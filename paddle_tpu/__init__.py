@@ -51,6 +51,15 @@ from . import static  # noqa: F401
 from . import amp  # noqa: F401
 from . import utils  # noqa: F401
 from . import models  # noqa: F401
+from . import autograd  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import distribution  # noqa: F401
+from . import text  # noqa: F401
+from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
+from . import onnx  # noqa: F401
+from . import quantization  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,302 @@
+"""paddle.autograd parity: PyLayer + functional transforms.
+
+TPU-native equivalents of the reference's
+  * PyLayer custom-backward ops (reference: python/paddle/autograd/
+    py_layer.py, C++ hook in imperative/py_layer_fwd.h) — realized as a
+    jax.custom_vjp function whose backward rule calls the user's
+    `backward`, recorded on the eager tape via the same raw-vjp path as
+    dynamic ops, and fully traceable inside compiled steps;
+  * functional vjp/jvp/Jacobian/Hessian (reference: python/paddle/
+    autograd/functional.py) — thin adapters over jax.vjp/jvp/jacfwd/
+    jacrev, which is the natural TPU realization (the reference builds
+    these from repeated backward passes).
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import state
+from ..framework.autograd import GLOBAL_TAPE, backward as _backward
+from ..framework.dispatch import TapeNode, _next_seq
+from ..framework.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext", "backward", "vjp", "jvp",
+           "jacobian", "hessian", "Jacobian", "Hessian"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """reference: autograd/backward_mode.py backward()."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    for t, g in zip(tensors, grad_tensors):
+        _backward(t, grad_tensor=g, retain_graph=True)
+    if not retain_graph:
+        from ..framework.autograd import reset_tape
+        reset_tape()
+
+
+class PyLayerContext:
+    """reference: py_layer.py PyLayerContext."""
+
+    def __init__(self):
+        self._saved: Tuple[Tensor, ...] = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):  # API-compat no-ops (functional XLA)
+        pass
+
+    def mark_non_differentiable(self, *args):
+        pass
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom forward/backward op (reference: py_layer.py:PyLayer).
+
+        class cus_tanh(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle.tanh(x)
+                ctx.save_for_backward(y)
+                return y
+            @staticmethod
+            def backward(ctx, dy):
+                y, = ctx.saved_tensor()
+                return dy * (1 - paddle.square(y))
+
+        y = cus_tanh.apply(x)
+
+    Works eagerly (recorded on the tape; loss.backward() invokes the
+    user's backward) AND inside compiled steps (custom_vjp under jit)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        if state.in_static_mode() and not state.in_trace():
+            raise RuntimeError(
+                "PyLayer is a dygraph-only API (reference parity: "
+                "py_layer.py supports dynamic graph only); use plain ops "
+                "or a registered primitive in static graphs")
+        tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        arrays = tuple(args[i]._data for i in tensor_idx)
+        side = {}  # ctx state shared forward→backward for this call
+
+        def run_forward(ctx, arrs):
+            full = list(args)
+            for i, a in zip(tensor_idx, arrs):
+                full[i] = Tensor(a, _internal=True)
+            with state.trace_guard(), state.no_grad_guard():
+                outs = cls.forward(ctx, *full, **kwargs)
+            single = not isinstance(outs, (tuple, list))
+            outs_t = (outs,) if single else tuple(outs)
+            return tuple(o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                         for o in outs_t), single
+
+        @jax.custom_vjp
+        def f(*arrs):
+            out, _ = run_forward(PyLayerContext(), arrs)
+            return out
+
+        def f_fwd(*arrs):
+            ctx = PyLayerContext()
+            out, single = run_forward(ctx, arrs)
+            side["single"] = single
+            side["ctx"] = ctx
+            res = tuple(t._data if isinstance(t, Tensor) else t
+                        for t in ctx._saved)
+            side["n_out"] = len(out)
+            return out, res
+
+        def f_bwd(res, cts):
+            ctx = side.get("ctx") or PyLayerContext()
+            ctx._saved = tuple(
+                Tensor(r, _internal=True) if hasattr(r, "dtype") else r
+                for r in res)
+            ct_tensors = tuple(Tensor(c, _internal=True) for c in cts)
+            with state.trace_guard(), state.no_grad_guard():
+                gouts = cls.backward(
+                    ctx, *(ct_tensors if len(ct_tensors) > 1
+                           else (ct_tensors[0],)))
+            if not isinstance(gouts, (tuple, list)):
+                gouts = (gouts,)
+            gouts = tuple(g._data if isinstance(g, Tensor) else g
+                          for g in gouts)
+            if len(gouts) != len(arrays):
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(gouts)} grads "
+                    f"for {len(arrays)} tensor inputs")
+            return tuple(jnp.zeros_like(a) if g is None else g
+                         for g, a in zip(gouts, arrays))
+
+        f.defvjp(f_fwd, f_bwd)
+
+        traced = state.in_trace() or any(
+            isinstance(a, jax.core.Tracer) for a in arrays)
+        if traced:
+            outs = f(*arrays)
+        else:
+            ctx = PyLayerContext()
+            outs, single_flag = run_forward(ctx, arrays)
+            side["ctx"] = ctx
+            side["single"] = single_flag
+
+        in_tensors = tuple(args[i] for i in tensor_idx)
+        requires = tuple(not t.stop_gradient for t in in_tensors)
+        record = state.grad_enabled() and any(requires) and not traced
+        out_tensors = tuple(Tensor(o, stop_gradient=not record,
+                                   _internal=True) for o in outs)
+        if record:
+            node = TapeNode(
+                name=f"pylayer_{cls.__name__}", fn=f,
+                attr_key=("__raw__", ()),
+                in_arrays=arrays, in_tensors=in_tensors,
+                out_refs=tuple(weakref.ref(t) for t in out_tensors),
+                out_avals=tuple((tuple(o.shape), o.dtype) for o in outs),
+                need_mask=requires, seq=_next_seq())
+            for t in out_tensors:
+                t._node = node
+            GLOBAL_TAPE.append(node)
+        single = side.get("single", len(out_tensors) == 1)
+        return out_tensors[0] if single else out_tensors
+
+
+# ---------------------------------------------------------------------------
+# functional transforms (reference: autograd/functional.py)
+
+
+def _as_tuple(x):
+    return (x,) if isinstance(x, Tensor) else tuple(x)
+
+
+def _array_fn(func):
+    def fn(*arrays):
+        with state.trace_guard(), state.no_grad_guard():
+            outs = func(*[Tensor(a, _internal=True) for a in arrays])
+        if isinstance(outs, (tuple, list)):
+            return tuple(o._data for o in outs)
+        return outs._data
+    return fn
+
+
+def vjp(func, xs, v=None):
+    """reference: autograd/functional.py vjp — returns (outputs, vjp_result)."""
+    xs = _as_tuple(xs)
+    fn = _array_fn(func)
+    primals, vjp_fn = jax.vjp(fn, *[x._data for x in xs])
+    multi_out = isinstance(primals, tuple)
+    if v is None:
+        seed = (jax.tree_util.tree_map(jnp.ones_like, primals))
+    else:
+        vt = _as_tuple(v)
+        seed = tuple(t._data for t in vt)
+        if not multi_out:
+            seed = seed[0]
+    grads = vjp_fn(seed)
+    outs = (tuple(Tensor(p, _internal=True) for p in primals)
+            if multi_out else Tensor(primals, _internal=True))
+    gs = tuple(Tensor(g, _internal=True) for g in grads)
+    return outs, gs if len(gs) > 1 else gs[0]
+
+
+def jvp(func, xs, v=None):
+    """reference: autograd/functional.py jvp."""
+    xs = _as_tuple(xs)
+    fn = _array_fn(func)
+    prim_arrays = [x._data for x in xs]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in prim_arrays]
+    else:
+        tangents = [t._data for t in _as_tuple(v)]
+    primals, tans = jax.jvp(fn, tuple(prim_arrays), tuple(tangents))
+    wrap = lambda o: (tuple(Tensor(t, _internal=True) for t in o)
+                      if isinstance(o, tuple) else Tensor(o, _internal=True))
+    return wrap(primals), wrap(tans)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """Dense Jacobian via jacrev (reference: functional.py jacobian)."""
+    xs = _as_tuple(xs)
+    fn = _array_fn(func)
+    jac = jax.jacrev(fn, argnums=tuple(range(len(xs))))(
+        *[x._data for x in xs])
+    def wrap(j):
+        if isinstance(j, tuple):
+            return tuple(wrap(x) for x in j)
+        return Tensor(j, _internal=True)
+    w = wrap(jac)
+    if len(xs) == 1 and isinstance(w, tuple) and len(w) == 1:
+        return w[0]
+    return w
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """Dense Hessian of a scalar function (reference: functional.py
+    hessian) — forward-over-reverse, the efficient order on TPU."""
+    xs = _as_tuple(xs)
+    fn = _array_fn(func)
+    hess = jax.hessian(fn, argnums=tuple(range(len(xs))))(
+        *[x._data for x in xs])
+    def wrap(h):
+        if isinstance(h, tuple):
+            return tuple(wrap(x) for x in h)
+        return Tensor(h, _internal=True)
+    w = wrap(hess)
+    if len(xs) == 1:
+        while isinstance(w, tuple) and len(w) == 1:
+            w = w[0]
+    return w
+
+
+class Jacobian:
+    """Lazy Jacobian view (reference: functional.py Jacobian class)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._j = jacobian(func, xs)
+
+    def __getitem__(self, idx):
+        return self._j[idx] if isinstance(self._j, tuple) else \
+            self._j.__getitem__(idx)
+
+    @property
+    def shape(self):
+        return self._j.shape
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        self._h = hessian(func, xs)
+
+    def __getitem__(self, idx):
+        return self._h[idx] if isinstance(self._h, tuple) else \
+            self._h.__getitem__(idx)
+
+    @property
+    def shape(self):
+        return self._h.shape

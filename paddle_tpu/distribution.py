@@ -1,0 +1,153 @@
+"""paddle.distribution parity (reference: python/paddle/distribution.py —
+Distribution/Uniform/Normal/Categorical with sample/entropy/log_prob/
+probs/kl_divergence). Sampling draws from the framework RNG key chain so
+seeded runs reproduce; math stays in jnp so it traces into compiled
+steps."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.random import RNG
+from .framework.tensor import Tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "kl_divergence"]
+
+
+def _arr(x):
+    """raw() + float32 coercion for python scalars (distribution params
+    default to f32 like the reference)."""
+    from .framework.dispatch import raw
+    out = raw(x)
+    if not isinstance(out, jnp.ndarray):
+        out = jnp.asarray(out, jnp.float32)
+    return out
+
+
+class Distribution:
+    """reference: distribution.py Distribution (abstract)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """reference: distribution.py Uniform(low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        key = RNG.next_key()
+        base = jnp.broadcast_shapes(jnp.shape(self.low),
+                                    jnp.shape(self.high))
+        u = jax.random.uniform(key, shape + base, jnp.float32)
+        return Tensor(self.low + u * (self.high - self.low), _internal=True)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low), _internal=True)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return Tensor(lp, _internal=True)
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data), _internal=True)
+
+
+class Normal(Distribution):
+    """reference: distribution.py Normal(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        key = RNG.next_key()
+        base = jnp.broadcast_shapes(jnp.shape(self.loc),
+                                    jnp.shape(self.scale))
+        z = jax.random.normal(key, shape + base, jnp.float32)
+        return Tensor(self.loc + z * self.scale, _internal=True)
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) +
+                      jnp.log(self.scale * jnp.ones_like(self.loc)),
+                      _internal=True)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) -
+                      jnp.log(self.scale) - 0.5 * math.log(2 * math.pi),
+                      _internal=True)
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data), _internal=True)
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two normals (reference:
+        distribution.py Normal.kl_divergence)."""
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio)),
+                      _internal=True)
+
+
+class Categorical(Distribution):
+    """reference: distribution.py Categorical(logits)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+
+    def _log_pmf(self):
+        return jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        key = RNG.next_key()
+        shape = tuple(shape)
+        out = jax.random.categorical(key, self.logits, axis=-1,
+                                     shape=shape + self.logits.shape[:-1])
+        return Tensor(out.astype(jnp.int64), _internal=True)
+
+    def entropy(self):
+        lp = self._log_pmf()
+        return Tensor(-jnp.sum(jnp.exp(lp) * lp, axis=-1), _internal=True)
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        lp = self._log_pmf()
+        return Tensor(jnp.take_along_axis(lp, v[..., None],
+                                          axis=-1)[..., 0], _internal=True)
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data), _internal=True)
+
+    def kl_divergence(self, other):
+        lp, lq = self._log_pmf(), other._log_pmf()
+        return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1),
+                      _internal=True)
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return p.kl_divergence(q)

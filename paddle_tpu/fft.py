@@ -1,0 +1,90 @@
+"""paddle.fft parity over jnp.fft (reference: python/paddle/fft.py,
+kernels paddle/fluid/operators/spectral_op.cc/.cu). Complex grads flow
+through jax's native fft differentiation rules; all entry points are
+registered primitives so eager calls land on the tape."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.dispatch import primitive
+from .framework.tensor import Tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2",
+           "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    return None if norm == "backward" else norm
+
+
+def _mk1d(jfn, opname):
+    @primitive(opname)
+    def op(x, *, n=None, axis=-1, norm="backward"):
+        return jfn(x, n=n, axis=axis, norm=_norm(norm))
+
+    def api(x, n=None, axis=-1, norm="backward", name=None):
+        return op(x, n=n, axis=axis, norm=norm)
+    api.__name__ = opname
+    return api
+
+
+def _mknd(jfn, opname, default_axes=None):
+    @primitive(opname)
+    def op(x, *, s=None, axes=default_axes, norm="backward"):
+        return jfn(x, s=s, axes=axes, norm=_norm(norm))
+
+    def api(x, s=None, axes=default_axes, norm="backward", name=None):
+        if axes is not None and not isinstance(axes, (tuple, type(None))):
+            axes = tuple(axes)
+        return op(x, s=None if s is None else tuple(s), axes=axes, norm=norm)
+    api.__name__ = opname
+    return api
+
+
+fft = _mk1d(jnp.fft.fft, "fft")
+ifft = _mk1d(jnp.fft.ifft, "ifft")
+rfft = _mk1d(jnp.fft.rfft, "rfft")
+irfft = _mk1d(jnp.fft.irfft, "irfft")
+hfft = _mk1d(jnp.fft.hfft, "hfft")
+ihfft = _mk1d(jnp.fft.ihfft, "ihfft")
+
+fft2 = _mknd(jnp.fft.fft2, "fft2", (-2, -1))
+ifft2 = _mknd(jnp.fft.ifft2, "ifft2", (-2, -1))
+rfft2 = _mknd(jnp.fft.rfft2, "rfft2", (-2, -1))
+irfft2 = _mknd(jnp.fft.irfft2, "irfft2", (-2, -1))
+fftn = _mknd(jnp.fft.fftn, "fftn", None)
+ifftn = _mknd(jnp.fft.ifftn, "ifftn", None)
+rfftn = _mknd(jnp.fft.rfftn, "rfftn", None)
+irfftn = _mknd(jnp.fft.irfftn, "irfftn", None)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"),
+                  _internal=True)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"),
+                  _internal=True)
+
+
+@primitive("fftshift")
+def _fftshift(x, *, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@primitive("ifftshift")
+def _ifftshift(x, *, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+def fftshift(x, axes=None, name=None):
+    return _fftshift(x, axes=None if axes is None else tuple(
+        axes if isinstance(axes, (list, tuple)) else (axes,)))
+
+
+def ifftshift(x, axes=None, name=None):
+    return _ifftshift(x, axes=None if axes is None else tuple(
+        axes if isinstance(axes, (list, tuple)) else (axes,)))

@@ -1,0 +1,4 @@
+"""paddle.incubate parity surface (reference: python/paddle/incubate/)."""
+from . import nn  # noqa: F401
+
+__all__ = ["nn"]

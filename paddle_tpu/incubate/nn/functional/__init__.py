@@ -1,0 +1,4 @@
+from .fused_transformer import (fused_feedforward,  # noqa: F401
+                                fused_multi_head_attention)
+
+__all__ = ["fused_feedforward", "fused_multi_head_attention"]

@@ -633,6 +633,49 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
     return Tensor(out.reshape(nt, c, h, w), _internal=True)
 
 
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention by CSR pattern (reference:
+    python/paddle/nn/functional/sparse_attention.py over
+    operators/sparse_attention_op.cu). q/k/v: [B, H, M, D];
+    offset: [B, H, M+1] row pointers; columns: [B, H, nnz].
+
+    Mask semantics follow the reference kernel
+    (sparse_attention_op.cu:79-99): `attn_mask` is a 0/1 KEEP mask
+    ([M, M]; 0 → -inf) and `key_padding_mask` ([B, M]) is ADDED to the
+    scores. Computed as masked dense attention through a tape primitive
+    (differentiable); a Pallas block-sparse kernel is the perf path."""
+    import jax
+    import jax.numpy as jnp
+    from ...framework.dispatch import raw
+    q, offs = raw(query), raw(sparse_csr_offset)
+    cols = raw(sparse_csr_columns)
+    B, H, M, D = q.shape
+    nnz = cols.shape[-1]
+    # CSR -> additive mask [B, H, M, M] (non-differentiable; built once)
+    idx = jnp.arange(nnz)
+
+    def per_bh(off_bh):
+        return jnp.searchsorted(off_bh[1:], idx, side="right")
+    row_ids = jax.vmap(jax.vmap(per_bh))(offs)         # [B,H,nnz]
+    keep = jnp.zeros((B, H, M, M), bool)
+    b_ix = jnp.arange(B)[:, None, None]
+    h_ix = jnp.arange(H)[None, :, None]
+    counts = offs[..., 1:] - offs[..., :-1]
+    valid = idx[None, None, :] < counts.sum(-1, keepdims=True)
+    keep = keep.at[b_ix, h_ix, row_ids, cols.astype(jnp.int32)].set(
+        jnp.where(valid, True, False))
+    if attn_mask is not None:
+        keep = keep & (raw(attn_mask)[None, None] != 0)
+    add = jnp.where(keep, 0.0, -1e30).astype(q.dtype)
+    if key_padding_mask is not None:
+        add = add + raw(key_padding_mask).astype(
+            q.dtype)[:, None, None, :]
+    return _nn.masked_sdpa(query, key, value,
+                           Tensor(add, _internal=True))
+
+
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     import jax.numpy as jnp
     if maxlen is None:

@@ -1,0 +1,17 @@
+"""paddle.onnx shim (reference: python/paddle/onnx/export.py — a thin
+wrapper over the external paddle2onnx package). There is no paddle2onnx
+for this framework; the deployable interchange artifact is StableHLO
+(paddle_tpu.inference.Predictor.export_stablehlo), which is what TPU
+serving stacks consume. export() raises with that guidance."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is not supported by paddle_tpu (the reference shims "
+        "to the external paddle2onnx tool). Use paddle.jit.save for "
+        "python-reloadable deployment, or "
+        "paddle_tpu.inference.Predictor.export_stablehlo() for a portable "
+        "compiled artifact (StableHLO is the TPU-serving interchange).")

@@ -691,3 +691,18 @@ def sdpa(q, k, v, mask, key, *, dropout_p=0.0, causal=False,
     if return_weights:
         return out, w
     return out
+
+
+@primitive("masked_sdpa")
+def masked_sdpa(q, k, v, add_mask):
+    """Dense attention with a precomputed ADDITIVE mask (used by
+    F.sparse_attention; rows that are fully masked produce zeros, matching
+    the reference sparse kernel's empty-row behavior)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (float(d) ** -0.5) + add_mask
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - jax.lax.stop_gradient(m))
+    e = jnp.where(add_mask <= -1e29, 0.0, e)
+    denom = jnp.sum(e, axis=-1, keepdims=True)
+    w = e / jnp.maximum(denom, 1e-30)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)

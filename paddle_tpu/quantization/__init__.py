@@ -1,0 +1,200 @@
+"""Quantization: QAT (fake-quant with straight-through grads) and PTQ
+(abs-max calibration).
+
+TPU-native equivalent of the reference's slim quantization stack
+(reference: python/paddle/fluid/contrib/slim/quantization/ — imperative
+QAT `ImperativeQuantAware` over fake_quantize ops
+paddle/fluid/operators/fake_quantize_op.cc, PTQ calibration). The
+fake-quant op uses the straight-through estimator expressed functionally
+(x + stop_gradient(q(x) - x)) so it traces into compiled steps; int8
+deployment on TPU lowers through XLA's native int8 matmul support."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.dispatch import primitive
+from ..framework.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["fake_quantize_dequantize_abs_max",
+           "fake_channel_wise_quantize_dequantize_abs_max",
+           "QuantizedLinear", "QuantizedConv2D", "ImperativeQuantAware",
+           "PTQ"]
+
+
+@primitive("fake_quantize_dequantize_abs_max")
+def _fq_absmax(x, *, bit_length=8):
+    n = float(2 ** (bit_length - 1) - 1)
+    scale = jnp.max(jnp.abs(x)) / n
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.round(x / scale) * scale
+    # straight-through estimator: identity gradient
+    return x + lax.stop_gradient(q - x)
+
+
+@primitive("fake_quantize_dequantize_fixed_scale")
+def _fq_fixed(x, *, scale, bit_length=8):
+    """Fixed-scale quant for PTQ-calibrated activations (reference:
+    fake_quantize_op.cc with a loaded InScale)."""
+    n = float(2 ** (bit_length - 1) - 1)
+    s = max(float(scale) / n, 1e-9)
+    q = jnp.clip(jnp.round(x / s), -n, n) * s
+    return x + lax.stop_gradient(q - x)
+
+
+@primitive("fake_channel_wise_quantize_dequantize_abs_max")
+def _fq_channel(x, *, bit_length=8, quant_axis=0):
+    n = float(2 ** (bit_length - 1) - 1)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes, keepdims=True) / n
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.round(x / scale) * scale
+    return x + lax.stop_gradient(q - x)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8):
+    return _fq_absmax(x, bit_length=int(bit_length))
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0):
+    return _fq_channel(x, bit_length=int(bit_length),
+                       quant_axis=int(quant_axis))
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weight + activation (reference:
+    slim/quantization imperative QuantizedLinear). With `act_scale`
+    (from PTQ calibration) the activation quant uses that fixed scale,
+    else live per-batch abs-max (QAT)."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 act_scale=None):
+        super().__init__()
+        self.inner = inner
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.channel_wise = weight_quantize_type.startswith("channel")
+        self.act_scale = act_scale
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.act_scale is not None:
+            xq = _fq_fixed(x, scale=float(self.act_scale),
+                           bit_length=self.activation_bits)
+        else:
+            xq = fake_quantize_dequantize_abs_max(x, self.activation_bits)
+        if self.channel_wise:
+            wq = fake_channel_wise_quantize_dequantize_abs_max(
+                self.inner.weight, self.weight_bits, quant_axis=1)
+        else:
+            wq = fake_quantize_dequantize_abs_max(self.inner.weight,
+                                                  self.weight_bits)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 act_scale=None):
+        super().__init__()
+        self.inner = inner
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.channel_wise = weight_quantize_type.startswith("channel")
+        self.act_scale = act_scale
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.act_scale is not None:
+            xq = _fq_fixed(x, scale=float(self.act_scale),
+                           bit_length=self.activation_bits)
+        else:
+            xq = fake_quantize_dequantize_abs_max(x, self.activation_bits)
+        if self.channel_wise:
+            wq = fake_channel_wise_quantize_dequantize_abs_max(
+                self.inner.weight, self.weight_bits, quant_axis=0)
+        else:
+            wq = fake_quantize_dequantize_abs_max(self.inner.weight,
+                                                  self.weight_bits)
+        return F.conv2d(xq, wq, self.inner.bias,
+                        stride=self.inner._stride,
+                        padding=self.inner._padding,
+                        dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+class ImperativeQuantAware:
+    """reference: imperative/qat.py ImperativeQuantAware — in-place swap
+    of quantizable sublayers for QAT training."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 quantizable_layer_type=("Linear", "Conv2D")):
+        self.wb = weight_bits
+        self.ab = activation_bits
+        self.wq_type = weight_quantize_type
+        self.types = set(quantizable_layer_type)
+
+    def quantize(self, model: Layer, act_scales=None, _prefix=""):
+        """In-place swap; `act_scales` (PTQ) maps layer path → fixed
+        input-activation scale."""
+        for name, sub in list(model._sub_layers.items()):
+            cls = type(sub).__name__
+            path = _prefix + name
+            scale = (act_scales or {}).get(path)
+            if cls == "Linear" and "Linear" in self.types:
+                model._sub_layers[name] = QuantizedLinear(
+                    sub, self.wb, self.ab, self.wq_type, act_scale=scale)
+            elif cls == "Conv2D" and "Conv2D" in self.types:
+                model._sub_layers[name] = QuantizedConv2D(
+                    sub, self.wb, self.ab, self.wq_type, act_scale=scale)
+            else:
+                self.quantize(sub, act_scales, path + ".")
+        return model
+
+
+class PTQ:
+    """Post-training quantization via abs-max calibration (reference:
+    slim/quantization/post_training_quantization.py). sample_data hooks
+    every quantizable layer and records the abs-max of its INPUT over the
+    calibration set; quantize() bakes those as fixed activation scales."""
+
+    def __init__(self, activation_bits=8, weight_bits=8):
+        self.ab = activation_bits
+        self.wb = weight_bits
+        self._scales: Dict[str, float] = {}
+
+    def sample_data(self, model: Layer, inputs: List[Tensor]):
+        """Run calibration batches; returns {layer_path: abs_max}."""
+        hooks = []
+
+        def make_hook(path):
+            def hook(layer, ins):
+                x = ins[0]
+                self._scales[path] = max(
+                    self._scales.get(path, 0.0),
+                    float(jnp.max(jnp.abs(x._data))))
+            return hook
+
+        for path, sub in model.named_sublayers():
+            if type(sub).__name__ in ("Linear", "Conv2D"):
+                hooks.append(sub.register_forward_pre_hook(make_hook(path)))
+        try:
+            for x in inputs:
+                model(x)
+        finally:
+            for h in hooks:
+                h.remove()
+        return dict(self._scales)
+
+    def quantize(self, model: Layer):
+        """Swap layers using the calibrated fixed activation scales."""
+        return ImperativeQuantAware(
+            weight_bits=self.wb, activation_bits=self.ab).quantize(
+                model, act_scales=self._scales)

@@ -1,0 +1,124 @@
+"""paddle.signal parity: frame / overlap_add / stft / istft
+(reference: python/paddle/signal.py over operators/frame_op,
+overlap_add_op, spectral ops). Framing is a gather (TPU-friendly); the
+FFTs ride paddle_tpu.fft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.dispatch import primitive
+from .framework.tensor import Tensor
+from . import fft as _fft
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+@primitive("frame")
+def _frame(x, *, frame_length, hop_length, axis=-1):
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("frame: axis must be the last dim")
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [num, flen]
+    out = jnp.take(x, idx, axis=-1)          # [..., num, flen]
+    return jnp.moveaxis(out, -1, -2)         # [..., flen, num] (ref layout)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    return _frame(x, frame_length=int(frame_length),
+                  hop_length=int(hop_length), axis=axis)
+
+
+@primitive("overlap_add")
+def _overlap_add(x, *, hop_length, axis=-1):
+    # x: [..., frame_length, num_frames] -> [..., seq]
+    flen, num = x.shape[-2], x.shape[-1]
+    seq = (num - 1) * hop_length + flen
+    frames = jnp.moveaxis(x, -1, -2)         # [..., num, flen]
+    out = jnp.zeros(x.shape[:-2] + (seq,), x.dtype)
+    idx = (jnp.arange(num)[:, None] * hop_length +
+           jnp.arange(flen)[None, :]).reshape(-1)
+    flat = frames.reshape(frames.shape[:-2] + (-1,))
+    return out.at[..., idx].add(flat)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    if axis not in (-1,):
+        raise NotImplementedError("overlap_add: axis must be -1")
+    return _overlap_add(x, hop_length=int(hop_length), axis=axis)
+
+
+def _window_arr(window, n_fft):
+    if window is None:
+        return jnp.ones((n_fft,), jnp.float32)
+    if isinstance(window, Tensor):
+        return window._data
+    return jnp.asarray(window)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """reference: signal.py stft — returns [..., n_fft//2+1 or n_fft,
+    num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_arr(window, win_length)
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if center:
+        pad = n_fft // 2
+        widths = [(0, 0)] * (arr.ndim - 1) + [(pad, pad)]
+        arr = jnp.pad(arr, widths, mode=pad_mode)
+    frames = frame(Tensor(arr, _internal=True), n_fft, hop_length)
+    spec = frames._data * w[:, None]
+    spec = jnp.moveaxis(spec, -2, -1)        # [..., num, n_fft]
+    f = jnp.fft.rfft(spec, axis=-1) if onesided else \
+        jnp.fft.fft(spec, axis=-1)
+    if normalized:
+        f = f / jnp.sqrt(jnp.asarray(n_fft, f.real.dtype))
+    return Tensor(jnp.moveaxis(f, -1, -2), _internal=True)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """reference: signal.py istft — least-squares inverse with window
+    envelope normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_arr(window, win_length)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    spec = jnp.moveaxis(arr, -2, -1)         # [..., num, bins]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * w[None, :]
+    frames = jnp.moveaxis(frames, -1, -2)    # [..., n_fft, num]
+    out_dt = frames.dtype if jnp.iscomplexobj(frames) else jnp.float32
+    y = _overlap_add(Tensor(frames.astype(out_dt), _internal=True),
+                     hop_length=hop_length)._data
+    # window envelope for COLA normalization
+    num = frames.shape[-1]
+    env = _overlap_add(Tensor(jnp.broadcast_to(
+        (w * w)[:, None], (n_fft, num)).astype(jnp.float32),
+        _internal=True), hop_length=hop_length)._data
+    y = y / jnp.maximum(env, 1e-11)
+    if center:
+        pad = n_fft // 2
+        y = y[..., pad:y.shape[-1] - pad]
+    if length is not None:
+        y = y[..., :length]
+    return Tensor(y, _internal=True)

@@ -1,0 +1,8 @@
+"""paddle.text parity (reference: python/paddle/text/datasets/ — Imdb,
+UCIHousing, Movielens, Conll05st, WMT14/16, ViterbiDecoder lives in
+nn). Zero-egress environment: datasets load local files when present,
+else deterministic synthetic corpora with the reference's shapes/dtypes
+— see vision/datasets.py for the same policy."""
+from .datasets import Imdb, UCIHousing, WMT14  # noqa: F401
+
+__all__ = ["Imdb", "UCIHousing", "WMT14"]

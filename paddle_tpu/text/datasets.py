@@ -1,0 +1,114 @@
+"""Text datasets (reference: python/paddle/text/datasets/imdb.py,
+uci_housing.py, wmt14.py). Local-file loading when available, else
+deterministic synthetic data with matching schema (ids/label tuples)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+_CACHE = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+class Imdb(Dataset):
+    """Sentiment pairs (token ids, 0/1 label). reference: imdb.py —
+    builds a word dict and yields (ids, label)."""
+
+    VOCAB = 5000
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        self.mode = mode
+        path = data_file or os.path.join(_CACHE, "imdb", f"{mode}.npz")
+        if os.path.exists(path):
+            z = np.load(path, allow_pickle=True)
+            self.docs = list(z["docs"])
+            self.labels = z["labels"].astype(np.int64)
+            return
+        n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES",
+                               25000 if mode == "train" else 25000))
+        rs = np.random.RandomState(0 if mode == "train" else 1)
+        self.labels = rs.randint(0, 2, n).astype(np.int64)
+        self.docs = []
+        for i in range(n):
+            ln = rs.randint(8, 64)
+            ids = rs.randint(2, self.VOCAB, ln)
+            # weak signal: positive docs over-sample low ids
+            if self.labels[i] == 1:
+                ids = np.where(rs.rand(ln) < 0.3,
+                               rs.randint(2, self.VOCAB // 10, ln), ids)
+            self.docs.append(ids.astype(np.int64))
+
+    def word_idx(self):
+        return {f"w{i}": i for i in range(self.VOCAB)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """13 features → price (reference: uci_housing.py)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        path = data_file or os.path.join(_CACHE, "uci_housing",
+                                         "housing.data")
+        if os.path.exists(path):
+            raw = np.loadtxt(path).astype(np.float32)
+        else:
+            rs = np.random.RandomState(0)
+            n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", 506))
+            X = rs.randn(n, 13).astype(np.float32)
+            w = rs.randn(13).astype(np.float32)
+            y = X @ w + 0.1 * rs.randn(n).astype(np.float32)
+            raw = np.concatenate([X, y[:, None]], 1)
+        split = int(len(raw) * 0.8)
+        raw = raw[:split] if mode == "train" else raw[split:]
+        # feature-wise normalization like the reference loader
+        mu, sd = raw[:, :13].mean(0), raw[:, :13].std(0) + 1e-8
+        self.X = ((raw[:, :13] - mu) / sd).astype(np.float32)
+        self.y = raw[:, 13:].astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.X[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.X)
+
+
+class WMT14(Dataset):
+    """Token-id translation pairs (src_ids, trg_ids, trg_next) —
+    reference: wmt14.py (dict size 30k, <s>/<e>/<unk> specials)."""
+
+    DICT_SIZE = 30000
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1):
+        self.dict_size = self.DICT_SIZE if dict_size < 0 else dict_size
+        path = data_file or os.path.join(_CACHE, "wmt14", f"{mode}.npz")
+        if os.path.exists(path):
+            z = np.load(path, allow_pickle=True)
+            self.src, self.trg = list(z["src"]), list(z["trg"])
+            return
+        n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", 2000))
+        rs = np.random.RandomState(2 if mode == "train" else 3)
+        self.src, self.trg = [], []
+        for _ in range(n):
+            ls, lt = rs.randint(4, 30), rs.randint(4, 30)
+            self.src.append(
+                rs.randint(3, self.dict_size, ls).astype(np.int64))
+            self.trg.append(
+                rs.randint(3, self.dict_size, lt).astype(np.int64))
+
+    def __getitem__(self, idx):
+        s, t = self.src[idx], self.trg[idx]
+        src = s
+        trg = np.concatenate([[self.BOS], t]).astype(np.int64)
+        trg_next = np.concatenate([t, [self.EOS]]).astype(np.int64)
+        return src, trg, trg_next
+
+    def __len__(self):
+        return len(self.src)

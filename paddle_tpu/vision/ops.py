@@ -1,0 +1,265 @@
+"""Vision ops (reference: python/paddle/vision/ops.py over
+operators/detection/ — yolo_box, roi_align, nms, deform_conv2d,
+distribute_fpn_proposals). Dense, vectorized jnp implementations that
+trace into XLA; detection post-processing (nms) is host-side numpy like
+typical TPU deployments (dynamic output shapes don't belong in jit)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import primitive, raw
+from ..framework.tensor import Tensor
+
+__all__ = ["yolo_box", "roi_align", "nms", "deform_conv2d", "RoIAlign",
+           "DeformConv2D"]
+
+
+@primitive("roi_align", dynamic=True)
+def _roi_align(x, boxes, boxes_num, *, output_size, spatial_scale=1.0,
+               sampling_ratio=-1, aligned=True):
+    """x: [N, C, H, W]; boxes: [R, 4] (x1,y1,x2,y2); boxes_num: [N].
+    Bilinear average pooling per output bin (reference:
+    operators/roi_align_op.cu)."""
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    ph, pw = output_size
+    # map each roi to its batch image
+    img_of_roi = jnp.repeat(jnp.arange(N), boxes_num, total_repeat_length=R)
+    off = 0.5 if aligned else 0.0
+    b = boxes * spatial_scale
+    x1, y1, x2, y2 = b[:, 0] - off, b[:, 1] - off, b[:, 2] - off, b[:, 3] - off
+    rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+    rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+    bin_h, bin_w = rh / ph, rw / pw
+    sr_h = sampling_ratio if sampling_ratio > 0 else 2
+    sr_w = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [R, ph, sr_h] x [R, pw, sr_w]
+    iy = (y1[:, None, None] + bin_h[:, None, None] *
+          (jnp.arange(ph)[None, :, None] +
+           (jnp.arange(sr_h)[None, None, :] + 0.5) / sr_h))
+    ix = (x1[:, None, None] + bin_w[:, None, None] *
+          (jnp.arange(pw)[None, :, None] +
+           (jnp.arange(sr_w)[None, None, :] + 0.5) / sr_w))
+
+    def bilinear(img, yy, xx):
+        """img: [C, H, W]; yy/xx: [ph*sr_h], [pw*sr_w] -> [C, Ny, Nx]."""
+        yy = jnp.clip(yy, 0.0, H - 1.0)
+        xx = jnp.clip(xx, 0.0, W - 1.0)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1c = jnp.minimum(y0 + 1, H - 1)
+        x1c = jnp.minimum(x0 + 1, W - 1)
+        wy1 = yy - y0
+        wx1 = xx - x0
+        g = lambda yi, xi: img[:, yi, :][:, :, xi]
+        v = (g(y0, x0) * ((1 - wy1)[None, :, None] * (1 - wx1)[None, None, :])
+             + g(y0, x1c) * ((1 - wy1)[None, :, None] * wx1[None, None, :])
+             + g(y1c, x0) * (wy1[None, :, None] * (1 - wx1)[None, None, :])
+             + g(y1c, x1c) * (wy1[None, :, None] * wx1[None, None, :]))
+        return v
+
+    def per_roi(r):
+        img = x[img_of_roi[r]]
+        yy = iy[r].reshape(-1)            # [ph*sr_h]
+        xx = ix[r].reshape(-1)            # [pw*sr_w]
+        v = bilinear(img, yy, xx)         # [C, ph*sr_h, pw*sr_w]
+        v = v.reshape(C, ph, sr_h, pw, sr_w)
+        return v.mean(axis=(2, 4))        # [C, ph, pw]
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_align(x, boxes, boxes_num, output_size=tuple(output_size),
+                      spatial_scale=float(spatial_scale),
+                      sampling_ratio=int(sampling_ratio),
+                      aligned=bool(aligned))
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes+scores (reference:
+    operators/detection/yolo_box_op.cu). x: [N, C, H, W] with
+    C = len(anchors)/2 * (5 + class_num); img_size: [N, 2] (h, w).
+    Returns (boxes [N, H*W*A, 4], scores [N, H*W*A, class_num])."""
+    xd = raw(x)
+    imgs = raw(img_size)
+    N, C, H, W = xd.shape
+    A = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    feats = xd.reshape(N, A, 5 + class_num, H, W)
+    tx, ty, tw, th, tobj = (feats[:, :, 0], feats[:, :, 1], feats[:, :, 2],
+                            feats[:, :, 3], feats[:, :, 4])
+    grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(tx) * alpha + beta + grid_x) / W
+    cy = (jax.nn.sigmoid(ty) * alpha + beta + grid_y) / H
+    input_h = downsample_ratio * H
+    input_w = downsample_ratio * W
+    bw = jnp.exp(tw) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(th) * an[None, :, 1, None, None] / input_h
+    obj = jax.nn.sigmoid(tobj)
+    cls = jax.nn.sigmoid(feats[:, :, 5:])
+    scores = obj[:, :, None] * cls                 # [N, A, ncls, H, W]
+    img_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (cx - bw / 2) * img_w
+    y1 = (cy - bh / 2) * img_h
+    x2 = (cx + bw / 2) * img_w
+    y2 = (cy + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)   # [N, A, H, W, 4]
+    boxes = boxes.reshape(N, A * H * W, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(N, A * H * W,
+                                                     class_num)
+    keep = obj.reshape(N, A * H * W) > conf_thresh
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    scores = jnp.where(keep[..., None], scores, 0.0)
+    return Tensor(boxes, _internal=True), Tensor(scores, _internal=True)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS on host (reference: operators/detection/nms_op.cc —
+    dynamic-size output, so host-side by design). boxes: [M, 4];
+    returns kept indices (int64 Tensor)."""
+    b = np.asarray(raw(boxes))
+    s = (np.asarray(raw(scores)) if scores is not None
+         else np.ones(len(b), np.float32))
+    cats = (np.asarray(raw(category_idxs)) if category_idxs is not None
+            else np.zeros(len(b), np.int64))
+
+    def iou(a, rest):
+        xx1 = np.maximum(a[0], rest[:, 0])
+        yy1 = np.maximum(a[1], rest[:, 1])
+        xx2 = np.minimum(a[2], rest[:, 2])
+        yy2 = np.minimum(a[3], rest[:, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        area_a = (a[2] - a[0]) * (a[3] - a[1])
+        area_r = (rest[:, 2] - rest[:, 0]) * (rest[:, 3] - rest[:, 1])
+        return inter / np.maximum(area_a + area_r - inter, 1e-9)
+
+    keep = []
+    for c in np.unique(cats):
+        idx = np.where(cats == c)[0]
+        order = idx[np.argsort(-s[idx])]
+        while len(order):
+            i = order[0]
+            keep.append(i)
+            if len(order) == 1:
+                break
+            rest = order[1:]
+            order = rest[iou(b[i], b[rest]) <= iou_threshold]
+    keep = np.asarray(sorted(keep, key=lambda i: -s[i]), np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep, _internal=True)
+
+
+@primitive("deform_conv2d")
+def _deform_conv2d(x, offset, weight, mask, *, stride, padding, dilation,
+                   groups):
+    """Deformable conv v1/v2 (reference: operators/deformable_conv_op.cu).
+    x: [N, Cin, H, W]; offset: [N, 2*kh*kw*dg, Ho, Wo];
+    mask: [N, kh*kw*dg, Ho, Wo] or None (v1); weight: [Cout, Cin/g, kh, kw].
+    Gather-based: sample deformed input patches bilinearly, then a plain
+    einsum contraction (MXU-friendly)."""
+    N, Cin, H, W = x.shape
+    Cout, Cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    # offset channel layout is INTERLEAVED per kernel point: channel
+    # 2*(i*kw+j) = dy, 2*(i*kw+j)+1 = dx (reference:
+    # operators/deformable_conv_op.h:69-76)
+    off = offset.reshape(N, -1, kh * kw, 2, Ho, Wo)
+    dg = off.shape[1]
+    base_y = (jnp.arange(Ho) * sh - ph)[:, None, None]
+    base_x = (jnp.arange(Wo) * sw - pw)[None, :, None]
+    ky = (jnp.arange(kh) * dh)[None, None, :, None]
+    kx = (jnp.arange(kw) * dw)[None, None, None, :]
+    # sample positions [Ho, Wo, kh, kw]
+    gy = base_y[..., None] + ky
+    gx = base_x[..., None] + kx
+    gy = jnp.broadcast_to(gy, (Ho, Wo, kh, kw)).reshape(Ho, Wo, kh * kw)
+    gx = jnp.broadcast_to(gx, (Ho, Wo, kh, kw)).reshape(Ho, Wo, kh * kw)
+    # add offsets: off[n, g, k, 0] = dy, off[n, g, k, 1] = dx
+    sy = gy[None, None] + off[:, :, :, 0].transpose(0, 1, 3, 4, 2)
+    sx = gx[None, None] + off[:, :, :, 1].transpose(0, 1, 3, 4, 2)
+
+    def bilin(img, yy, xx):
+        """img [C,H,W]; yy/xx [...]: bilinear sample with zero padding."""
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy = yy - y0
+        wx = xx - x0
+        out = 0.0
+        for (yi, wyi) in ((y0, 1 - wy), (y0 + 1, wy)):
+            for (xi, wxi) in ((x0, 1 - wx), (x0 + 1, wx)):
+                inb = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+                yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                v = img[:, yc, xc]
+                out = out + v * (wyi * wxi * inb)[None]
+        return out
+
+    cpg = Cin // dg  # channels per deformable group
+
+    def per_image(n):
+        cols = []
+        for g in range(dg):
+            img = jax.lax.dynamic_slice_in_dim(x[n], g * cpg, cpg, axis=0)
+            smp = bilin(img, sy[n, g], sx[n, g])   # [cpg, Ho, Wo, khkw]
+            if mask is not None:
+                mk = mask.reshape(N, dg, kh * kw, Ho, Wo)
+                smp = smp * mk[n, g].transpose(1, 2, 0)[None]
+            cols.append(smp)
+        return jnp.concatenate(cols, axis=0)       # [Cin, Ho, Wo, khkw]
+
+    col = jax.vmap(per_image)(jnp.arange(N))       # [N, Cin, Ho, Wo, khkw]
+    col = col.reshape(N, groups, Cin // groups, Ho, Wo, kh * kw)
+    wg = weight.reshape(groups, Cout // groups, Cin_g, kh * kw)
+    out = jnp.einsum("ngchwk,gock->ngohw", col, wg)
+    return out.reshape(N, Cout, Ho, Wo)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    to2 = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+    out = _deform_conv2d(x, offset, weight, mask, stride=to2(stride),
+                         padding=to2(padding), dilation=to2(dilation),
+                         groups=groups)
+    if bias is not None:
+        from ..ops import math as m
+        out = m.add(out, bias.reshape((1, -1, 1, 1)))
+    return out
+
+
+class DeformConv2D:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "use paddle_tpu.vision.ops.deform_conv2d functional form")

@@ -1,0 +1,450 @@
+"""API-surface breadth tests: autograd (PyLayer/functional), fft, signal,
+distribution, sparse attention, fused transformer, vision ops, inference
+predictor, quantization, text datasets.
+
+Parity oracles are numpy/jax closed forms, matching the reference's OpTest
+numeric style (reference: python/paddle/fluid/tests/unittests/op_test.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+# ---------------------------------------------------------------- autograd
+def test_pylayer_custom_backward_eager():
+    class cus_tanh(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle.tanh(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            y, = ctx.saved_tensor()
+            return dy * (1 - y * y) * 2.0          # doubled on purpose
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4).astype(np.float32))
+    x.stop_gradient = False
+    y = cus_tanh.apply(x)
+    np.testing.assert_allclose(y.numpy(), np.tanh(x.numpy()), rtol=1e-6)
+    y.backward(paddle.to_tensor(np.ones(4, np.float32)))
+    expect = (1 - np.tanh(x.numpy()) ** 2) * 2.0   # custom rule respected
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-5)
+
+
+def test_pylayer_inside_compiled_step():
+    from paddle_tpu.jit.engine import make_train_step
+
+    class scale2(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2.0
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 2.0
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return scale2.apply(self.fc(x))
+
+    paddle.seed(0)
+    net = Net()
+    opt = paddle.optimizer.SGD(parameters=net.parameters(), learning_rate=0.1)
+    step = make_train_step(net, nn.CrossEntropyLoss(), opt)
+    x = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 2, (8,))
+    l1, _ = step([paddle.to_tensor(x)], [paddle.to_tensor(y)])
+    l2, _ = step([paddle.to_tensor(x)], [paddle.to_tensor(y)])
+    assert float(l2.numpy()) < float(l1.numpy())
+
+
+def test_functional_vjp_jvp_jacobian_hessian():
+    def f(x):
+        return paddle.sum(x * x * x)
+
+    x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+    _, g = paddle.autograd.vjp(f, x)
+    np.testing.assert_allclose(g.numpy(), 3 * x.numpy() ** 2, rtol=1e-6)
+    _, jv = paddle.autograd.jvp(f, x, paddle.to_tensor(
+        np.asarray([1.0, 0.0], np.float32)))
+    np.testing.assert_allclose(jv.numpy(), 3.0, rtol=1e-6)
+    jac = paddle.autograd.jacobian(f, x)
+    np.testing.assert_allclose(jac.numpy(), 3 * x.numpy() ** 2, rtol=1e-6)
+    hes = paddle.autograd.hessian(f, x)
+    np.testing.assert_allclose(hes.numpy(), np.diag(6 * x.numpy()),
+                               rtol=1e-6)
+
+
+# --------------------------------------------------------------------- fft
+def test_fft_roundtrip_and_grad():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 16).astype(np.float32)
+    X = paddle.fft.fft(paddle.to_tensor(x.astype(np.complex64)))
+    back = paddle.fft.ifft(X)
+    np.testing.assert_allclose(back.numpy().real, x, atol=1e-5)
+    np.testing.assert_allclose(X.numpy(), np.fft.fft(x), atol=1e-3)
+    # rfft/irfft real path with grad
+    t = paddle.to_tensor(x)
+    t.stop_gradient = False
+    y = paddle.fft.irfft(paddle.fft.rfft(t))
+    loss = paddle.sum(y * y)
+    loss.backward()
+    assert t.grad is not None and np.isfinite(t.grad.numpy()).all()
+
+
+def test_fft2_and_shift():
+    rs = np.random.RandomState(1)
+    x = rs.randn(3, 8, 8).astype(np.float32)
+    got = paddle.fft.fft2(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, np.fft.fft2(x), atol=1e-3)
+    sh = paddle.fft.fftshift(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(sh, np.fft.fftshift(x), atol=1e-6)
+
+
+# ------------------------------------------------------------------ signal
+def test_stft_istft_roundtrip():
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 512).astype(np.float32)
+    w = np.hanning(128).astype(np.float32)
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=128, hop_length=32,
+                              window=paddle.to_tensor(w))
+    assert list(spec.shape) == [2, 65, 1 + 512 // 32]
+    back = paddle.signal.istft(spec, n_fft=128, hop_length=32,
+                               window=paddle.to_tensor(w), length=512)
+    # COLA holds for hann with 75% overlap: mid-section reconstructs
+    np.testing.assert_allclose(back.numpy()[:, 64:-64], x[:, 64:-64],
+                               atol=1e-3)
+
+
+def test_frame_overlap_add_inverse():
+    x = np.arange(32, dtype=np.float32)[None]
+    f = paddle.signal.frame(paddle.to_tensor(x), frame_length=8,
+                            hop_length=8)
+    assert list(f.shape) == [1, 8, 4]
+    y = paddle.signal.overlap_add(f, hop_length=8)
+    np.testing.assert_allclose(y.numpy()[0], x[0], rtol=1e-6)
+
+
+# ------------------------------------------------------------ distribution
+def test_distributions():
+    paddle.seed(7)
+    n = paddle.distribution.Normal(0.0, 1.0)
+    s = n.sample((20000,))
+    assert abs(float(paddle.mean(s).numpy())) < 0.05
+    np.testing.assert_allclose(
+        n.log_prob(paddle.to_tensor(np.float32(0.0))).numpy(),
+        -0.5 * np.log(2 * np.pi), rtol=1e-5)
+    u = paddle.distribution.Uniform(0.0, 2.0)
+    np.testing.assert_allclose(u.entropy().numpy(), np.log(2.0), rtol=1e-6)
+    c = paddle.distribution.Categorical(
+        paddle.to_tensor(np.asarray([0.0, 0.0], np.float32)))
+    np.testing.assert_allclose(c.entropy().numpy(), np.log(2.0), rtol=1e-5)
+    n2 = paddle.distribution.Normal(1.0, 2.0)
+    kl = paddle.distribution.kl_divergence(n, n2).numpy()
+    expect = 0.5 * ((1 / 4) + (1 / 4) - 1 - np.log(1 / 4))
+    np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+
+# -------------------------------------------------------- sparse attention
+def test_sparse_attention_matches_dense_mask():
+    rs = np.random.RandomState(3)
+    B, H, M, D = 1, 2, 8, 4
+    q, k, v = (rs.randn(B, H, M, D).astype(np.float32) for _ in range(3))
+    # banded pattern: each row attends to itself and previous position
+    offs = np.zeros((B, H, M + 1), np.int32)
+    cols_list = []
+    for r in range(M):
+        c = [r] if r == 0 else [r - 1, r]
+        cols_list.append(c)
+        offs[:, :, r + 1] = offs[:, :, r] + len(c)
+    cols = np.concatenate(cols_list).astype(np.int32)
+    cols = np.broadcast_to(cols, (B, H, len(cols))).copy()
+    out = nn.functional.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(offs), paddle.to_tensor(cols)).numpy()
+    # dense oracle
+    mask = np.zeros((M, M), bool)
+    for r in range(M):
+        for c in ([r] if r == 0 else [r - 1, r]):
+            mask[r, c] = True
+    s = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(D)
+    s = np.where(mask, s, -1e30)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    expect = w @ v
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+# -------------------------------------------------------- fused transformer
+def test_fused_mha_matches_unfused():
+    from paddle_tpu.incubate.nn.functional import fused_multi_head_attention
+    rs = np.random.RandomState(4)
+    B, T, E, H = 2, 6, 16, 4
+    x = paddle.to_tensor(rs.randn(B, T, E).astype(np.float32))
+    qkvw = paddle.to_tensor(rs.randn(3, H, E // H, E).astype(np.float32) * .1)
+    lw = paddle.to_tensor(rs.randn(E, E).astype(np.float32) * 0.1)
+    ln_s = paddle.to_tensor(np.ones(E, np.float32))
+    ln_b = paddle.to_tensor(np.zeros(E, np.float32))
+    out = fused_multi_head_attention(
+        x, qkvw, lw, pre_layer_norm=False, ln_scale=ln_s, ln_bias=ln_b,
+        dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+    assert list(out.shape) == [B, T, E]
+    # numpy oracle
+    xn = x.numpy()
+    w = qkvw.numpy().reshape(3 * E, E).T
+    qkv = (xn @ w).reshape(B, T, 3, H, E // H).transpose(2, 0, 3, 1, 4)
+    qn, kn, vn = qkv[0], qkv[1], qkv[2]
+    s = (qn @ kn.transpose(0, 1, 3, 2)) / np.sqrt(E // H)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    o = (p @ vn).transpose(0, 2, 1, 3).reshape(B, T, E) @ lw.numpy()
+    res = xn + o
+    mu = res.mean(-1, keepdims=True)
+    var = res.var(-1, keepdims=True)
+    expect = (res - mu) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_feedforward_runs():
+    from paddle_tpu.incubate.nn.functional import fused_feedforward
+    rs = np.random.RandomState(5)
+    x = paddle.to_tensor(rs.randn(2, 4, 8).astype(np.float32))
+    w1 = paddle.to_tensor(rs.randn(8, 16).astype(np.float32) * 0.1)
+    w2 = paddle.to_tensor(rs.randn(16, 8).astype(np.float32) * 0.1)
+    out = fused_feedforward(x, w1, w2, dropout1_rate=0.0, dropout2_rate=0.0,
+                            ln2_scale=paddle.to_tensor(np.ones(8, np.float32)),
+                            ln2_bias=paddle.to_tensor(np.zeros(8, np.float32)),
+                            training=False)
+    assert list(out.shape) == [2, 4, 8]
+    assert np.isfinite(out.numpy()).all()
+
+
+# -------------------------------------------------------------- vision ops
+def test_roi_align_constant_region():
+    # constant image -> every pooled value equals the constant
+    x = np.full((1, 3, 16, 16), 5.0, np.float32)
+    boxes = np.asarray([[2.0, 2.0, 10.0, 10.0]], np.float32)
+    out = paddle.vision.ops.roi_align(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.asarray([1], np.int32)), output_size=4)
+    assert list(out.shape) == [1, 3, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 5.0, rtol=1e-5)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                       np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+    keep = paddle.vision.ops.nms(paddle.to_tensor(boxes), 0.5,
+                                 paddle.to_tensor(scores)).numpy()
+    assert set(keep.tolist()) == {0, 2}
+
+
+def test_yolo_box_shapes():
+    rs = np.random.RandomState(6)
+    N, A, ncls, H, W = 1, 2, 3, 4, 4
+    x = rs.randn(N, A * (5 + ncls), H, W).astype(np.float32)
+    boxes, scores = paddle.vision.ops.yolo_box(
+        paddle.to_tensor(x),
+        paddle.to_tensor(np.asarray([[64, 64]], np.int32)),
+        anchors=[10, 13, 16, 30], class_num=ncls, conf_thresh=-1.0,
+        downsample_ratio=16)
+    assert list(boxes.shape) == [N, A * H * W, 4]
+    assert list(scores.shape) == [N, A * H * W, ncls]
+    b = boxes.numpy()
+    assert (b[..., 2] >= b[..., 0]).all() and (b[..., 3] >= b[..., 1]).all()
+
+
+def test_deform_conv2d_zero_offset_matches_conv():
+    """Zero offsets + ones mask == plain convolution (the reference
+    kernel's degenerate case — deformable_conv_op.h:69-76 layout)."""
+    rs = np.random.RandomState(7)
+    N, Cin, H, W, Cout, K = 1, 2, 6, 6, 3, 3
+    x = rs.randn(N, Cin, H, W).astype(np.float32)
+    w = rs.randn(Cout, Cin, K, K).astype(np.float32)
+    Ho = Wo = H - K + 1
+    offset = np.zeros((N, 2 * K * K, Ho, Wo), np.float32)
+    mask = np.ones((N, K * K, Ho, Wo), np.float32)
+    out = paddle.vision.ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(offset), paddle.to_tensor(w),
+        mask=paddle.to_tensor(mask)).numpy()
+    ref = nn.functional.conv2d(paddle.to_tensor(x),
+                               paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_interleaved_offset_layout():
+    """A dx shift of +1 on every kernel point == shifting the input window
+    right by one column (verifies the interleaved dy/dx channel order)."""
+    rs = np.random.RandomState(8)
+    x = rs.randn(1, 1, 6, 8).astype(np.float32)
+    w = np.ones((1, 1, 3, 3), np.float32)
+    Ho, Wo = 4, 6
+    offset = np.zeros((1, 2 * 9, Ho, Wo), np.float32)
+    offset[:, 1::2] = 1.0                      # all dx = +1, dy = 0
+    out = paddle.vision.ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(offset),
+        paddle.to_tensor(w)).numpy()
+    ref = nn.functional.conv2d(paddle.to_tensor(x),
+                               paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(out[..., :-1], ref[..., 1:], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_sparse_attention_grads_flow():
+    rs = np.random.RandomState(9)
+    B, H, M, D = 1, 1, 4, 2
+    q = paddle.to_tensor(rs.randn(B, H, M, D).astype(np.float32))
+    k = paddle.to_tensor(rs.randn(B, H, M, D).astype(np.float32))
+    v = paddle.to_tensor(rs.randn(B, H, M, D).astype(np.float32))
+    for t in (q, k, v):
+        t.stop_gradient = False
+    offs = np.asarray([[[0, 1, 2, 3, 4]]], np.int32)
+    cols = np.asarray([[[0, 1, 2, 3]]], np.int32)   # diagonal pattern
+    out = nn.functional.sparse_attention(
+        q, k, v, paddle.to_tensor(offs), paddle.to_tensor(cols))
+    # diagonal-only: each row attends to itself -> out == v
+    np.testing.assert_allclose(out.numpy(), v.numpy(), rtol=1e-5)
+    paddle.sum(out * out).backward()
+    assert v.grad is not None
+    np.testing.assert_allclose(v.grad.numpy(), 2 * v.numpy(), rtol=1e-5)
+
+
+def test_ptq_calibration_sets_fixed_scales():
+    from paddle_tpu.quantization import PTQ, QuantizedLinear
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+
+    paddle.seed(0)
+    net = Net()
+    ptq = PTQ()
+    calib = [paddle.to_tensor(
+        np.random.RandomState(i).randn(4, 4).astype(np.float32) * 3)
+        for i in range(3)]
+    scales = ptq.sample_data(net, calib)
+    assert set(scales) == {"fc1", "fc2"} and all(
+        v > 0 for v in scales.values())
+    qnet = ptq.quantize(net)
+    quant_layers = [l for _, l in qnet.named_sublayers()
+                    if isinstance(l, QuantizedLinear)]
+    assert len(quant_layers) == 2
+    assert all(l.act_scale is not None for l in quant_layers)
+    out = qnet(calib[0])
+    assert np.isfinite(out.numpy()).all()
+
+
+# --------------------------------------------------------------- inference
+def test_inference_predictor_roundtrip(tmp_path):
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+        main = static.Program()
+        start = static.Program()
+        with static.program_guard(main, start):
+            x = static.data("x", [None, 4], "float32")
+            lin = nn.Linear(4, 2)
+            y = lin(x)
+        exe = static.Executor()
+        exe.run(start)
+        prefix = str(tmp_path / "deploy")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+    finally:
+        paddle.disable_static()
+
+    from paddle_tpu.inference import Config, create_predictor
+    cfg = Config(prefix + ".pdmodel")
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    xin = np.random.RandomState(8).randn(3, 4).astype(np.float32)
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(xin)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    expect = xin @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+    # StableHLO export is non-empty and mentions the entry computation
+    hlo = pred.export_stablehlo([xin])
+    assert "func" in hlo and len(hlo) > 100
+
+
+# ------------------------------------------------------------ quantization
+def test_fake_quant_ste_grad():
+    from paddle_tpu.quantization import fake_quantize_dequantize_abs_max
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+    x.stop_gradient = False
+    y = fake_quantize_dequantize_abs_max(x, 8)
+    # quantization error bounded by scale/2
+    assert np.abs(y.numpy() - x.numpy()).max() <= (1.0 / 127) / 2 + 1e-6
+    loss = paddle.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(11), rtol=1e-6)  # STE
+
+
+def test_qat_quantize_model_trains():
+    from paddle_tpu.quantization import ImperativeQuantAware
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.act = nn.ReLU()
+            self.fc2 = nn.Linear(16, 2)
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    paddle.seed(0)
+    net = ImperativeQuantAware().quantize(Net())
+    names = [type(l).__name__ for _, l in net.named_sublayers()]
+    assert names.count("QuantizedLinear") == 2
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-2)
+    loss_fn = nn.CrossEntropyLoss()
+    x = np.random.RandomState(1).randn(16, 8).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 2, (16,))
+    losses = []
+    for _ in range(15):
+        loss = loss_fn(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+# -------------------------------------------------------------------- text
+def test_text_datasets():
+    os.environ["PADDLE_TPU_SYNTH_SAMPLES"] = "64"
+    try:
+        imdb = paddle.text.Imdb(mode="train")
+        ids, lab = imdb[0]
+        assert ids.dtype == np.int64 and lab in (0, 1)
+        housing = paddle.text.UCIHousing(mode="train")
+        xr, yr = housing[0]
+        assert xr.shape == (13,) and yr.shape == (1,)
+        wmt = paddle.text.WMT14(mode="train")
+        src, trg, nxt = wmt[1]
+        assert trg[0] == paddle.text.WMT14.BOS and nxt[-1] == \
+            paddle.text.WMT14.EOS
+    finally:
+        del os.environ["PADDLE_TPU_SYNTH_SAMPLES"]
