@@ -17,6 +17,8 @@ from .collective import (ReduceOp, Group, all_gather, all_reduce, alltoall,
 from .parallel import DataParallel, sync_params_buffers
 from .utils import global_gather, global_scatter
 from . import fleet
+from . import auto_parallel
+from .auto_parallel import ProcessMesh, shard_op, shard_tensor
 from .spawn import spawn
 
 __all__ = [
@@ -25,5 +27,6 @@ __all__ = [
     "alltoall", "barrier", "broadcast", "destroy_process_group", "get_group",
     "is_initialized", "new_group", "recv", "reduce", "reduce_scatter",
     "scatter", "send", "wait", "DataParallel", "sync_params_buffers",
-    "global_gather", "global_scatter", "fleet", "spawn",
+    "global_gather", "global_scatter", "fleet", "spawn", "auto_parallel",
+    "ProcessMesh", "shard_tensor", "shard_op",
 ]

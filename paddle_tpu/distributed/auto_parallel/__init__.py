@@ -1,0 +1,145 @@
+"""Semi-auto parallelism: ProcessMesh + shard_tensor/shard_op markers.
+
+TPU-native equivalent of the reference's auto_parallel package
+(reference: python/paddle/distributed/auto_parallel/ — ProcessMesh
+process_mesh.py:39, shard_tensor/shard_op interface.py:34,73, dist-attr
+completion completion.py, Partitioner partitioner.py, Reshard
+reshard.py). The division of labor changes on TPU: the user marks
+shardings (this module), and XLA's GSPMD partitioner IS the completion +
+partitioner + reshard pipeline — it propagates shardings through the
+whole program and inserts the collectives, which is exactly what the
+reference's 2.7k-LoC completion/partitioner/reshard python implements
+manually. So this module is thin by design: it maps ProcessMesh to a
+jax Mesh and annotations to PartitionSpecs consumed by the jit engine."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...framework import state
+from ...framework.tensor import Tensor
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "get_default_mesh",
+           "set_default_mesh"]
+
+_default_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """reference: auto_parallel/process_mesh.py:39 — an N-D arrangement
+    of processes. Here each position is a jax device; dim_names name the
+    mesh axes used in shard specs."""
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 parent=None):
+        arr = np.asarray(mesh)
+        self.topology = list(arr.shape)
+        self.processes = [int(i) for i in arr.reshape(-1)]
+        self.dim_names = list(dim_names) if dim_names else \
+            [f"d{i}" for i in range(arr.ndim)]
+        devs = jax.devices()
+        if len(self.processes) > len(devs) or (
+                self.processes and max(self.processes) >= len(devs)):
+            raise ValueError(
+                f"ProcessMesh device ids {self.processes} out of range for "
+                f"{len(devs)} available devices")
+        dev_arr = np.asarray([devs[i] for i in self.processes]).reshape(
+            arr.shape)
+        self.jax_mesh = Mesh(dev_arr, tuple(self.dim_names))
+
+    @property
+    def ndim(self):
+        return len(self.topology)
+
+    def __enter__(self):
+        global _default_mesh
+        self._prev = _default_mesh
+        _default_mesh = self
+        return self
+
+    def __exit__(self, *exc):
+        global _default_mesh
+        _default_mesh = self._prev
+        return False
+
+    def __repr__(self):
+        return (f"ProcessMesh(topology={self.topology}, "
+                f"dim_names={self.dim_names})")
+
+
+def get_default_mesh() -> Optional[ProcessMesh]:
+    return _default_mesh
+
+
+def set_default_mesh(mesh: Optional[ProcessMesh]):
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def _spec_from(shard_spec: Sequence[Optional[str]]) -> P:
+    return P(*[None if s is None else s for s in shard_spec])
+
+
+def shard_tensor(x: Tensor, process_mesh: Optional[ProcessMesh] = None,
+                 shard_spec: Optional[Sequence[Optional[str]]] = None,
+                 place_now: bool = False):
+    """reference: auto_parallel/interface.py:34 shard_tensor — mark a
+    tensor/parameter with a sharding. shard_spec: one entry per dim,
+    either a mesh dim name or None (replicated).
+
+    The marker is an ANNOTATION (like the reference's dist attr): the
+    compiled step places the parameter sharded when it traces under a
+    mesh (jit/engine.py _param_spec). Eager math keeps working because
+    the array stays on its current device until then. `place_now=True`
+    forces immediate physical sharding (only sensible when every tensor
+    it meets is also mesh-resident)."""
+    pm = process_mesh or _default_mesh
+    if pm is None:
+        raise ValueError("shard_tensor needs a ProcessMesh "
+                         "(pass one or enter a `with ProcessMesh(...)`) ")
+    spec = _spec_from(shard_spec or [None] * x.ndim)
+    x.sharding_spec = spec
+    x.process_mesh = pm
+    if place_now and not isinstance(x._data, jax.core.Tracer):
+        x._data = jax.device_put(x._data, NamedSharding(pm.jax_mesh, spec))
+    return x
+
+
+def shard_op(op_fn, process_mesh: Optional[ProcessMesh] = None,
+             in_shard_specs: Optional[Sequence] = None,
+             out_shard_specs: Optional[Sequence] = None):
+    """reference: auto_parallel/interface.py:73 shard_op — wrap a callable
+    so its outputs carry sharding constraints (GSPMD propagates the
+    rest)."""
+    pm = process_mesh or _default_mesh
+
+    def wrapped(*args, **kwargs):
+        mesh = pm.jax_mesh if pm is not None else state.current_mesh()
+        if mesh is not None and in_shard_specs is not None:
+            cons = []
+            for a, s in zip(args, in_shard_specs):
+                if (isinstance(a, Tensor) and s is not None
+                        and isinstance(a._data, jax.core.Tracer)):
+                    a = Tensor(jax.lax.with_sharding_constraint(
+                        a._data, NamedSharding(mesh, _spec_from(s))),
+                        _internal=True)
+                cons.append(a)
+            args = tuple(cons)
+        outs = op_fn(*args, **kwargs)
+        if mesh is None or out_shard_specs is None:
+            return outs
+        single = not isinstance(outs, (tuple, list))
+        outs_t = [outs] if single else list(outs)
+        for i, (o, s) in enumerate(zip(outs_t, out_shard_specs)):
+            if (isinstance(o, Tensor) and s is not None
+                    and isinstance(o._data, jax.core.Tracer)):
+                outs_t[i] = Tensor(jax.lax.with_sharding_constraint(
+                    o._data, NamedSharding(mesh, _spec_from(s))),
+                    _internal=True)
+        return outs_t[0] if single else tuple(outs_t)
+
+    return wrapped

@@ -100,6 +100,7 @@ _state = _FleetState()
 
 
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
+from . import elastic  # noqa: F401,E402
 
 
 def init(role_maker=None, is_collective=True, strategy=None):

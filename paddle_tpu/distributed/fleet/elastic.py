@@ -1,0 +1,193 @@
+"""Elastic training manager.
+
+TPU-native equivalent of the reference's ElasticManager
+(reference: python/paddle/distributed/fleet/elastic/manager.py:103 —
+etcd3-backed node registration with TTL leases, membership watch, scale
+via PADDLE_ELASTIC_SCALE, relaunch on change). etcd is replaced by a
+pluggable Store: FileStore (shared filesystem — the common substrate on
+TPU pods) or an in-memory store for tests. On TPU slices the platform
+(GKE JobSet / queued resources) does the actual re-scheduling; this
+manager covers membership tracking, health TTLs, and the
+relaunch/resume decision."""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ElasticStatus", "ElasticManager", "FileStore", "MemoryStore"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class MemoryStore:
+    """In-process store (tests / single host)."""
+
+    def __init__(self):
+        self._d: Dict[str, tuple] = {}
+        self._mu = threading.Lock()
+
+    def put(self, key: str, value: str, ttl: Optional[float] = None):
+        with self._mu:
+            exp = time.time() + ttl if ttl else None
+            self._d[key] = (value, exp)
+
+    def get(self, key: str) -> Optional[str]:
+        with self._mu:
+            v = self._d.get(key)
+            if v is None:
+                return None
+            if v[1] is not None and time.time() > v[1]:
+                del self._d[key]
+                return None
+            return v[0]
+
+    def list_prefix(self, prefix: str) -> Dict[str, str]:
+        with self._mu:
+            now = time.time()
+            out = {}
+            for k, (v, exp) in list(self._d.items()):
+                if exp is not None and now > exp:
+                    del self._d[k]
+                elif k.startswith(prefix):
+                    out[k] = v
+            return out
+
+    def delete(self, key: str):
+        with self._mu:
+            self._d.pop(key, None)
+
+
+class FileStore:
+    """Shared-filesystem store: one json file per key (name =
+    percent-encoded key, injective), atomic writes (tmp + rename), TTL
+    stamped inside the record."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        from urllib.parse import quote
+        return os.path.join(self.root, quote(key, safe=""))
+
+    @staticmethod
+    def _key_of(name: str) -> str:
+        from urllib.parse import unquote
+        return unquote(name)
+
+    def put(self, key: str, value: str, ttl: Optional[float] = None):
+        path = self._path(key)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"v": value, "ttl": ttl, "t": time.time()}, f)
+        os.replace(tmp, path)
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with open(self._path(key)) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if d["ttl"] is not None and time.time() > d["t"] + d["ttl"]:
+            self.delete(key)
+            return None
+        return d["v"]
+
+    def list_prefix(self, prefix: str) -> Dict[str, str]:
+        out = {}
+        for name in os.listdir(self.root):
+            if ".tmp" in name:
+                continue
+            key = self._key_of(name)
+            if key.startswith(prefix):
+                v = self.get(key)
+                if v is not None:
+                    out[key] = v
+        return out
+
+    def delete(self, key: str):
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
+
+class ElasticManager:
+    """reference: elastic/manager.py:103. Registers this host under
+    /paddle_tpu/elastic/nodes/<id> with a TTL heartbeat; watch() reports
+    membership changes; np scaling honors PADDLE_ELASTIC_SCALE."""
+
+    HEARTBEAT = 2.0
+    TTL = 6.0
+
+    def __init__(self, node_id: Optional[str] = None, np: Optional[int] = None,
+                 store=None, prefix="/paddle_tpu/elastic"):
+        self.node_id = node_id or os.environ.get(
+            "PADDLE_TRAINER_ID", str(os.getpid()))
+        self.np = int(np if np is not None
+                      else os.environ.get("PADDLE_ELASTIC_NP", 1))
+        self.store = store if store is not None else MemoryStore()
+        self.prefix = prefix
+        self._stop = threading.Event()
+        self._hb: Optional[threading.Thread] = None
+        self._watchers: List[Callable] = []
+        self.elastic_level = int(os.environ.get(
+            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 1))
+
+    # -- membership ---------------------------------------------------------
+    def _node_key(self, nid=None):
+        return f"{self.prefix}/nodes/{nid or self.node_id}"
+
+    def register(self):
+        self.store.put(self._node_key(), json.dumps(
+            {"host": self.node_id, "t": time.time()}), ttl=self.TTL)
+        if self._hb is None:
+            self._hb = threading.Thread(target=self._heartbeat, daemon=True)
+            self._hb.start()
+
+    def _heartbeat(self):
+        while not self._stop.wait(self.HEARTBEAT):
+            self.store.put(self._node_key(), json.dumps(
+                {"host": self.node_id, "t": time.time()}), ttl=self.TTL)
+
+    def alive_nodes(self) -> List[str]:
+        nodes = self.store.list_prefix(f"{self.prefix}/nodes/")
+        return sorted(json.loads(v)["host"] for v in nodes.values())
+
+    def world_ready(self) -> bool:
+        scale = int(os.environ.get("PADDLE_ELASTIC_SCALE", 0))
+        want = self.np + scale
+        return len(self.alive_nodes()) >= want
+
+    # -- watch / decision ---------------------------------------------------
+    def watch(self, interval=0.5, timeout=None) -> str:
+        """Block until membership changes or timeout; returns an
+        ElasticStatus (reference: manager.py watch loop)."""
+        base = self.alive_nodes()
+        t0 = time.time()
+        while timeout is None or time.time() - t0 < timeout:
+            time.sleep(interval)
+            cur = self.alive_nodes()
+            if cur != base:
+                if len(cur) < len(base):
+                    # node lost: restart if fault tolerant, else exit
+                    return (ElasticStatus.RESTART if self.elastic_level >= 1
+                            else ElasticStatus.ERROR)
+                return ElasticStatus.RESTART  # scale-up: relaunch bigger
+            if self._stop.is_set():
+                return ElasticStatus.EXIT
+        return ElasticStatus.HOLD
+
+    def exit(self, completed=True):
+        self._stop.set()
+        self.store.delete(self._node_key())
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.EXIT

@@ -1,4 +1,5 @@
 """paddle.incubate parity surface (reference: python/paddle/incubate/)."""
 from . import nn  # noqa: F401
+from . import checkpoint  # noqa: F401
 
-__all__ = ["nn"]
+__all__ = ["nn", "checkpoint"]

@@ -211,34 +211,41 @@ class Optimizer:
         return None, None
 
     def state_dict(self):
+        """Accumulators keyed by PARAMETER ORDER (stable across fresh
+        processes, unlike auto-generated tensor names); name-based keys
+        are also emitted for reference-style consumers."""
         sd = {}
-        for p, accs in self._iter_named_accumulators():
+        for i, p in enumerate(self._parameter_list or []):
+            accs = self._accumulators.get(id(p))
+            if not accs:
+                continue
             for name, arr in accs.items():
-                sd[f"{p.name}_{name}"] = Tensor(arr, _internal=True)
+                t = Tensor(arr, _internal=True)
+                sd[f"@acc_{i}_{name}"] = t
+                if p.name:
+                    sd[f"{p.name}_{name}"] = t
         if isinstance(self._lr, LRScheduler):
             sd["LR_Scheduler"] = self._lr.state_dict()
+        # the reference stores beta1_pow/beta2_pow accumulators; our
+        # analogue of that bias-correction state is the step count
+        sd["@step_count"] = self._step_count
         return sd
-
-    def _iter_named_accumulators(self):
-        if not self._parameter_list:
-            return
-        for p in self._parameter_list:
-            accs = self._accumulators.get(id(p))
-            if accs:
-                yield p, accs
 
     def set_state_dict(self, state_dict):
         sched = state_dict.get("LR_Scheduler")
         if sched and isinstance(self._lr, LRScheduler):
             self._lr.set_state_dict(sched)
+        if "@step_count" in state_dict:
+            self._step_count = int(np.asarray(state_dict["@step_count"]))
         if not self._parameter_list:
             return
-        for p in self._parameter_list:
+        for i, p in enumerate(self._parameter_list):
             accs = self._get_accumulators(p)
             for name in list(accs):
-                key = f"{p.name}_{name}"
-                if key in state_dict:
-                    v = state_dict[key]
+                v = state_dict.get(f"@acc_{i}_{name}")
+                if v is None:
+                    v = state_dict.get(f"{p.name}_{name}")
+                if v is not None:
                     accs[name] = jnp.asarray(
                         v.numpy() if isinstance(v, Tensor) else v)
 

@@ -1,3 +1,4 @@
 """Utilities (reference: python/paddle/utils/)."""
 from . import profiler  # noqa: F401
 from .profiler import RecordEvent  # noqa: F401
+from . import cpp_extension  # noqa: F401

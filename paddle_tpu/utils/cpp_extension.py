@@ -1,0 +1,156 @@
+"""Custom C++ op extension: JIT-compile user C++ into framework ops.
+
+TPU-native equivalent of the reference's custom-op toolchain
+(reference: paddle/fluid/extension/include/ext_op_meta_info.h:501
+PD_BUILD_OP + python/paddle/utils/cpp_extension/cpp_extension.py `load`).
+pybind11 isn't in this image, so the ABI is plain C: the user exports
+
+    extern "C" void my_op(const float* x, float* out, int64_t n);
+
+and `load(name, sources)` compiles a shared lib (g++ -O2 -fPIC -shared),
+binds it with ctypes, and registers a framework primitive that invokes it
+through jax.pure_callback — so the op works eagerly AND inside jit
+(executed host-side at run time; TPU-resident custom kernels are written
+in Pallas instead, see ops/pallas_kernels.py). An optional `grad_fn`
+C symbol `<name>_grad(const float* x, const float* dy, float* dx,
+int64_t n)` makes the op differentiable."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "CppExtension", "get_build_directory"]
+
+_BUILD_ROOT = os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")
+
+
+def get_build_directory():
+    os.makedirs(_BUILD_ROOT, exist_ok=True)
+    return _BUILD_ROOT
+
+
+class CppExtension:
+    """setup()-style declaration (reference: cpp_extension.py
+    CppExtension); here just a named source bundle for load()."""
+
+    def __init__(self, sources: Sequence[str], name: Optional[str] = None,
+                 extra_compile_args=None):
+        self.sources = list(sources)
+        self.name = name
+        self.extra_compile_args = list(extra_compile_args or [])
+
+
+def _compile(name: str, sources: Sequence[str], extra_args) -> str:
+    """Cache keyed by a hash of (source CONTENTS, flags) so different
+    checkouts/flag sets never collide on the shared /tmp dir and edits
+    always rebuild."""
+    import hashlib
+    srcs = [os.path.abspath(s) for s in sources]
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_args).encode())
+    key = h.hexdigest()[:16]
+    out_dir = os.path.join(get_build_directory(), f"{name}-{key}")
+    os.makedirs(out_dir, exist_ok=True)
+    lib = os.path.join(out_dir, f"lib{name}.so")
+    if os.path.exists(lib):
+        return lib
+    tmp = lib + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", *extra_args,
+           *srcs, "-o", tmp]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"custom op build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+    os.replace(tmp, lib)
+    return lib
+
+
+def load(name: str, sources=None, extra_cxx_cflags=None,
+         build_directory=None, verbose=False):
+    """Compile + register. `sources` is a list of paths or a
+    CppExtension (whose extra_compile_args are honored). Returns a
+    module-like namespace holding one python callable per exported op
+    symbol `name` (and using `<name>_grad` when present).
+    reference: cpp_extension.py load()."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.dispatch import Primitive
+
+    flags = list(extra_cxx_cflags or [])
+    if isinstance(sources, CppExtension):
+        ext = sources
+        sources = ext.sources
+        flags += ext.extra_compile_args
+        name = name or ext.name
+    lib_path = _compile(name, sources, flags)
+    lib = ctypes.CDLL(lib_path)
+
+    fn = getattr(lib, name, None)
+    if fn is None:
+        raise RuntimeError(f"symbol {name!r} not found in {lib_path}")
+    fn.restype = None
+    fn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                   ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    gfn = getattr(lib, name + "_grad", None)
+    if gfn is not None:
+        gfn.restype = None
+        gfn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                        ctypes.POINTER(ctypes.c_float),
+                        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    def host_call(x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        out = np.empty_like(x)
+        fn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+           out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+           x.size)
+        return out
+
+    def host_grad(x: np.ndarray, dy: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        dy = np.ascontiguousarray(dy, np.float32)
+        dx = np.empty_like(x)
+        gfn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            dy.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            dx.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            x.size)
+        return dx
+
+    @jax.custom_vjp
+    def op_jax(x):
+        return jax.pure_callback(
+            host_call, jax.ShapeDtypeStruct(x.shape, jnp.float32), x,
+            vmap_method="sequential")
+
+    def op_fwd(x):
+        return op_jax(x), x
+
+    def op_bwd(x, dy):
+        if gfn is None:
+            raise RuntimeError(
+                f"custom op {name} has no {name}_grad symbol — mark inputs "
+                "stop_gradient or export a grad function")
+        dx = jax.pure_callback(
+            host_grad, jax.ShapeDtypeStruct(x.shape, jnp.float32), x, dy,
+            vmap_method="sequential")
+        return (dx,)
+
+    op_jax.defvjp(op_fwd, op_bwd)
+
+    prim = Primitive(f"custom_{name}", lambda x: op_jax(x),
+                     nondiff=(gfn is None))
+
+    class _Module:
+        pass
+
+    mod = _Module()
+    setattr(mod, name, lambda x: prim(x))
+    return mod

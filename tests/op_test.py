@@ -1,0 +1,129 @@
+"""OpTest harness: numeric-vs-analytic gradient checking.
+
+TPU-native equivalent of the reference's OpTest
+(reference: python/paddle/fluid/tests/unittests/op_test.py:277 —
+check_output compares the op against a numpy reference on every place;
+check_grad compares tape-backward gradients against central finite
+differences, op_test.py:110 get_numeric_gradient). Here the "places" are
+the eager jitted path and the traced (jax.jit whole-fn) path."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.dispatch import OPS
+from paddle_tpu.framework.tensor import Tensor
+
+
+def get_numeric_gradient(fn: Callable, inputs: List[np.ndarray], wrt: int,
+                         delta=5e-3, weights=None) -> np.ndarray:
+    """Central finite difference of sum(w * fn(*inputs)) w.r.t.
+    inputs[wrt] (reference: op_test.py:110). `weights` (one array per
+    output) keeps the loss non-degenerate for ops whose plain sum is
+    constant (softmax rows sum to 1)."""
+    x = inputs[wrt].astype(np.float64, copy=True)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def loss(outs):
+        outs = _tup(outs)
+        ws = weights or [np.ones_like(np.asarray(o)) for o in outs]
+        return sum((np.asarray(o, np.float64) * w).sum()
+                   for o, w in zip(outs, ws))
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        args = list(inputs)
+        args[wrt] = x.astype(inputs[wrt].dtype)
+        hi = loss(fn(*args))
+        flat[i] = orig - delta
+        args[wrt] = x.astype(inputs[wrt].dtype)
+        lo = loss(fn(*args))
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * delta)
+    return grad
+
+
+def _tup(x):
+    return x if isinstance(x, tuple) else (x,)
+
+
+class OpTest:
+    """Subclass and set: op_type (registry name), inputs (dict name →
+    np array), attrs (dict), and a numpy reference via ref_fn."""
+
+    op_type: str = ""
+    inputs: Dict[str, np.ndarray] = {}
+    attrs: Dict = {}
+
+    def ref_fn(self, *arrays):
+        raise NotImplementedError
+
+    # -- machinery ----------------------------------------------------------
+    def _run_op(self, arrays, traced=False):
+        prim = OPS[self.op_type]
+        if traced:
+            import jax
+            f = jax.jit(lambda *a: prim.fn(*a, **self.attrs))
+            return _tup(f(*arrays))
+        ts = [paddle.to_tensor(a) for a in arrays]
+        out = prim(*ts, **self.attrs)
+        return tuple(o.numpy() for o in _tup(out))
+
+    def check_output(self, rtol=1e-5, atol=1e-6):
+        arrays = list(self.inputs.values())
+        expect = _tup(self.ref_fn(*arrays))
+        for traced in (False, True):
+            got = self._run_op(arrays, traced=traced)
+            assert len(got) == len(expect), \
+                f"{self.op_type}: {len(got)} outputs vs {len(expect)}"
+            for g, e in zip(got, expect):
+                np.testing.assert_allclose(
+                    np.asarray(g), e, rtol=rtol, atol=atol,
+                    err_msg=f"{self.op_type} traced={traced}")
+
+    def check_grad(self, inputs_to_check: Optional[Sequence[str]] = None,
+                   delta=5e-3, max_relative_error=5e-3):
+        names = list(self.inputs)
+        arrays = [self.inputs[n] for n in names]
+        check = inputs_to_check or [n for n in names
+                                    if np.issubdtype(
+                                        self.inputs[n].dtype, np.floating)]
+        prim = OPS[self.op_type]
+
+        # analytic via the eager tape, with a fixed random cotangent so
+        # sum-invariant ops (softmax) keep a non-degenerate gradient
+        ts = [paddle.to_tensor(a) for a in arrays]
+        for n, t in zip(names, ts):
+            if n in check:
+                t.stop_gradient = False
+        outs = _tup(prim(*ts, **self.attrs))
+        rs = np.random.RandomState(1234)
+        weights = [rs.rand(*np.shape(o.numpy())).astype(np.float64)
+                   for o in outs]
+        loss = None
+        for o, w in zip(outs, weights):
+            s = paddle.sum(o * paddle.to_tensor(w.astype(np.float32)))
+            loss = s if loss is None else loss + s
+        loss.backward()
+
+        def fnp(*arrs):
+            return prim.fn(*arrs, **self.attrs)
+
+        for n in check:
+            idx = names.index(n)
+            analytic = ts[idx].grad.numpy()
+            numeric = get_numeric_gradient(fnp, arrays, idx, delta,
+                                           weights=weights)
+            abs_err = np.abs(analytic - numeric)
+            denom = np.maximum(np.maximum(np.abs(analytic),
+                                          np.abs(numeric)), 1e-3)
+            rel = (abs_err / denom).max()
+            assert rel < max_relative_error, \
+                (f"{self.op_type} grad w.r.t. {n}: max rel err {rel:.2e} "
+                 f"(numeric {numeric.reshape(-1)[:4]}, "
+                 f"analytic {analytic.reshape(-1)[:4]})")

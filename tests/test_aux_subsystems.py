@@ -1,0 +1,223 @@
+"""Aux subsystem tests: auto-parallel markers, elastic manager,
+custom C++ op extension, auto-checkpoint resume.
+
+reference models: auto_parallel tests (unittests/auto_parallel/),
+elastic manager tests (unittests/test_fleet_elastic_manager.py),
+custom-op tests (tests/custom_op/), auto-checkpoint tests
+(unittests/test_auto_checkpoint.py)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+# ------------------------------------------------------------ auto parallel
+def test_process_mesh_and_shard_tensor():
+    from paddle_tpu.distributed import ProcessMesh, shard_tensor
+
+    mesh = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    assert mesh.topology == [2, 4] and mesh.ndim == 2
+    t = paddle.to_tensor(np.zeros((8, 12), np.float32))
+    shard_tensor(t, mesh, ["x", "y"])          # annotation only
+    assert tuple(t.sharding_spec) == ("x", "y")
+    assert t.process_mesh is mesh
+    # eager math still works against single-device tensors
+    other = paddle.to_tensor(np.ones((8, 12), np.float32))
+    assert float(paddle.sum(t + other).numpy()) == 96.0
+    # place_now forces physical sharding
+    shard_tensor(t, mesh, ["x", "y"], place_now=True)
+    assert not t._data.sharding.is_fully_replicated
+
+
+def test_shard_tensor_trains_sharded():
+    """A parameter marked via shard_tensor stays physically sharded
+    through a compiled train step (GSPMD does completion/partition)."""
+    from paddle_tpu.distributed import ProcessMesh, shard_tensor
+    from paddle_tpu.jit.engine import make_train_step
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    shard_tensor(net[0].weight, mesh, [None, "mp"])
+    shard_tensor(net[2].weight, mesh, ["mp", None])
+    opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                               learning_rate=0.1)
+    step = make_train_step(net, nn.CrossEntropyLoss(), opt,
+                           mesh=mesh.jax_mesh)
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, (8,))
+    for _ in range(2):
+        loss, _ = step([paddle.to_tensor(x)], [paddle.to_tensor(y)])
+    assert np.isfinite(float(loss.numpy()))
+    assert not net[0].weight._data.sharding.is_fully_replicated
+
+
+def test_shard_op_constrains_outputs():
+    from paddle_tpu.distributed import ProcessMesh, shard_op
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["a", "b"])
+
+    def f(x):
+        return paddle.matmul(x, x, transpose_y=True)
+
+    wrapped = shard_op(f, mesh, out_shard_specs=[["a", None]])
+    # eager (non-traced): passes through untouched
+    x = paddle.to_tensor(np.random.RandomState(2).randn(8, 8)
+                         .astype(np.float32))
+    np.testing.assert_allclose(wrapped(x).numpy(), f(x).numpy(), rtol=1e-6)
+
+
+# ----------------------------------------------------------------- elastic
+def test_elastic_membership_and_watch():
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus,
+                                                      MemoryStore)
+    store = MemoryStore()
+    m1 = ElasticManager(node_id="n1", np=2, store=store)
+    m2 = ElasticManager(node_id="n2", np=2, store=store)
+    m1.register()
+    assert not m1.world_ready()
+    m2.register()
+    assert m1.world_ready()
+    assert m1.alive_nodes() == ["n1", "n2"]
+    # membership change detection (node join)
+    m3 = ElasticManager(node_id="n3", np=2, store=store)
+    import threading
+    status = []
+    th = threading.Thread(
+        target=lambda: status.append(m1.watch(interval=0.05, timeout=5)))
+    th.start()
+    time.sleep(0.15)
+    m3.register()
+    th.join(timeout=6)
+    assert status == [ElasticStatus.RESTART]
+    for m in (m1, m2, m3):
+        m.exit()
+    assert m1.alive_nodes() == []
+
+
+def test_elastic_file_store(tmp_path):
+    from paddle_tpu.distributed.fleet.elastic import FileStore
+    fs = FileStore(str(tmp_path / "estore"))
+    fs.put("/a/b", "v1")
+    assert fs.get("/a/b") == "v1"
+    fs.put("/a/c", "v2", ttl=0.1)
+    time.sleep(0.15)
+    assert fs.get("/a/c") is None
+    assert fs.list_prefix("/a/") == {"/a/b": "v1"}
+    fs.delete("/a/b")
+    assert fs.get("/a/b") is None
+
+
+# ---------------------------------------------------------------- custom op
+CUSTOM_SRC = r"""
+#include <cstdint>
+#include <cmath>
+extern "C" void mish(const float* x, float* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = x[i] * std::tanh(std::log1p(std::exp(x[i])));
+}
+extern "C" void mish_grad(const float* x, const float* dy, float* dx,
+                          int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    float sp = std::log1p(std::exp(x[i]));
+    float t = std::tanh(sp);
+    float sig = 1.0f / (1.0f + std::exp(-x[i]));
+    dx[i] = dy[i] * (t + x[i] * (1 - t * t) * sig);
+  }
+}
+"""
+
+
+def test_custom_cpp_op_forward_and_grad(tmp_path):
+    src = tmp_path / "mish_op.cc"
+    src.write_text(CUSTOM_SRC)
+    mod = paddle.utils.cpp_extension.load("mish", [str(src)])
+    x = paddle.to_tensor(np.linspace(-2, 2, 9).astype(np.float32))
+    x.stop_gradient = False
+    y = mod.mish(x)
+    xe = x.numpy()
+    expect = xe * np.tanh(np.log1p(np.exp(xe)))
+    np.testing.assert_allclose(y.numpy(), expect, rtol=1e-5)
+    paddle.sum(y).backward()
+    sp = np.log1p(np.exp(xe))
+    t = np.tanh(sp)
+    sig = 1 / (1 + np.exp(-xe))
+    np.testing.assert_allclose(x.grad.numpy(), t + xe * (1 - t * t) * sig,
+                               rtol=1e-5)
+
+
+def test_custom_op_inside_jit(tmp_path):
+    src = tmp_path / "mish2_op.cc"
+    src.write_text(CUSTOM_SRC.replace("mish", "mish2"))
+    mod = paddle.utils.cpp_extension.load("mish2", [str(src)])
+    import jax.numpy as jnp
+    from paddle_tpu.framework.dispatch import OPS
+
+    f = jax.jit(lambda a: OPS["custom_mish2"].fn(a) * 2.0)
+    x = np.linspace(-1, 1, 5).astype(np.float32)
+    got = np.asarray(f(x))
+    expect = 2 * x * np.tanh(np.log1p(np.exp(x)))
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+# ------------------------------------------------------------- checkpointing
+def test_auto_checkpoint_resume(tmp_path):
+    from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+    def build():
+        paddle.seed(5)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=1e-2)
+        return net, opt
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 2, (8,)))
+    loss_fn = nn.CrossEntropyLoss()
+
+    def train_epochs(tr, net, opt, upto=None):
+        seen = []
+        for e in tr.get():
+            loss = loss_fn(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            tr.save(layer=net, optimizer=opt, meta={"loss": float(
+                loss.numpy())})
+            seen.append(e)
+            if upto is not None and e >= upto:
+                break
+        return seen
+
+    # run 1: epochs 0..2 then "crash"
+    net, opt = build()
+    tr = TrainEpochRange(6, "job_a", checkpoint_dir=str(tmp_path))
+    assert tr.restored_epoch == -1
+    train_epochs(tr, net, opt, upto=2)
+    w_after_3 = net.weight.numpy().copy()
+
+    # run 2: fresh process resumes at epoch 3 with restored state
+    net2, opt2 = build()
+    tr2 = TrainEpochRange(6, "job_a", checkpoint_dir=str(tmp_path))
+    assert tr2.restored_epoch == 2
+    meta = tr2.restore(layer=net2, optimizer=opt2)
+    assert meta["epoch"] == 2
+    np.testing.assert_allclose(net2.weight.numpy(), w_after_3, rtol=1e-6)
+    seen = train_epochs(tr2, net2, opt2)
+    assert seen == [3, 4, 5]
+
+    # continuous single-run reference must match the resumed run exactly
+    net3, opt3 = build()
+    tr3 = TrainEpochRange(6, "job_b", checkpoint_dir=str(tmp_path))
+    seen3 = train_epochs(tr3, net3, opt3)
+    assert seen3 == [0, 1, 2, 3, 4, 5]
+    np.testing.assert_allclose(net2.weight.numpy(), net3.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)

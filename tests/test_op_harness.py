@@ -1,0 +1,120 @@
+"""Op unit tests through the OpTest harness (numeric-vs-analytic grads),
+mirroring the reference's per-op test style (reference:
+unittests/test_elementwise_add_op.py, test_matmul_v2_op.py,
+test_softmax_op.py, test_layer_norm_op.py ...)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rnd(shape, seed, scale=1.0, shift=0.0):
+    return (np.random.RandomState(seed).rand(*shape).astype(np.float32)
+            * scale + shift)
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+    inputs = {"x": _rnd((3, 4), 0), "y": _rnd((3, 4), 1)}
+
+    def ref_fn(self, x, y):
+        return x + y
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+    inputs = {"x": _rnd((3, 4), 0), "y": _rnd((4,), 1)}
+
+    def ref_fn(self, x, y):
+        return x + y
+
+
+class TestElementwiseMul(OpTest):
+    op_type = "elementwise_mul"
+    inputs = {"x": _rnd((2, 5), 2), "y": _rnd((2, 5), 3)}
+
+    def ref_fn(self, x, y):
+        return x * y
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = "elementwise_div"
+    inputs = {"x": _rnd((2, 5), 4), "y": _rnd((2, 5), 5, shift=0.5)}
+
+    def ref_fn(self, x, y):
+        return x / y
+
+
+class TestMatmulV2(OpTest):
+    op_type = "matmul_v2"
+    inputs = {"x": _rnd((4, 6), 6, 0.5), "y": _rnd((6, 3), 7, 0.5)}
+
+    def ref_fn(self, x, y):
+        return x @ y
+
+
+class TestMatmulTransY(OpTest):
+    op_type = "matmul_v2"
+    inputs = {"x": _rnd((4, 6), 8, 0.5), "y": _rnd((3, 6), 9, 0.5)}
+    attrs = {"transpose_y": True}
+
+    def ref_fn(self, x, y):
+        return x @ y.T
+
+
+class TestExp(OpTest):
+    op_type = "exp"
+    inputs = {"x": _rnd((3, 3), 10)}
+
+    def ref_fn(self, x):
+        return np.exp(x)
+
+
+class TestLogSafe(OpTest):
+    op_type = "log"
+    inputs = {"x": _rnd((3, 3), 11, shift=0.5)}
+
+    def ref_fn(self, x):
+        return np.log(x)
+
+
+class TestSigmoidGrad(OpTest):
+    op_type = "sigmoid"
+    inputs = {"x": _rnd((4, 4), 12, 4.0, -2.0)}
+
+    def ref_fn(self, x):
+        return 1 / (1 + np.exp(-x))
+
+
+class TestTanhGrad(OpTest):
+    op_type = "tanh"
+    inputs = {"x": _rnd((4, 4), 13, 2.0, -1.0)}
+
+    def ref_fn(self, x):
+        return np.tanh(x)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax_op"
+    inputs = {"x": _rnd((3, 6), 14, 3.0)}
+    attrs = {"axis": -1}
+
+    def ref_fn(self, x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+
+ALL_CASES = [TestElementwiseAdd, TestElementwiseAddBroadcast,
+             TestElementwiseMul, TestElementwiseDiv, TestMatmulV2,
+             TestMatmulTransY, TestExp, TestLogSafe, TestSigmoidGrad,
+             TestTanhGrad, TestSoftmax]
+
+
+@pytest.mark.parametrize("case", ALL_CASES, ids=lambda c: c.__name__)
+def test_output(case):
+    case().check_output(rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("case", ALL_CASES, ids=lambda c: c.__name__)
+def test_grad(case):
+    case().check_grad(max_relative_error=5e-3)
