@@ -633,6 +633,27 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
     return Tensor(out.reshape(nt, c, h, w), _internal=True)
 
 
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss (reference: nn/functional/loss.py ctc_loss over
+    operators/warpctc_op). log_probs: [T, B, C] (pre- or post-log-softmax;
+    normalized here), labels: [B, L] padded."""
+    lp = log_softmax(log_probs, axis=-1)
+    from ...ops.nn_ops import ctc_loss_op
+    from ...ops import math as _mm
+    nll = ctc_loss_op(lp, labels, input_lengths, label_lengths,
+                      blank=int(blank))
+    if norm_by_times:
+        nll = _mm.divide(nll, input_lengths.astype(nll.dtype))
+    if reduction == "mean":
+        # reference semantics: per-sample NLL / label_length, then batch
+        # mean (matches paddle & torch ctc_loss 'mean')
+        denom = _mm.maximum(label_lengths.astype(nll.dtype),
+                            Tensor(np.float32(1.0)))
+        return _m.mean(_mm.divide(nll, denom))
+    return _reduce_loss(nll, reduction)
+
+
 def sparse_attention(query, key, value, sparse_csr_offset,
                      sparse_csr_columns, key_padding_mask=None,
                      attn_mask=None, name=None):

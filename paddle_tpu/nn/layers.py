@@ -32,7 +32,7 @@ __all__ = [
     "LogSoftmax", "Softmax", "Maxout", "ThresholdedReLU", "GLU",
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
     "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "MarginRankingLoss",
-    "HingeEmbeddingLoss", "Identity",
+    "HingeEmbeddingLoss", "Identity", "CTCLoss",
 ]
 
 
@@ -1009,3 +1009,18 @@ class HingeEmbeddingLoss(Layer):
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, self._margin,
                                       self._reduction)
+
+
+class CTCLoss(Layer):
+    """reference: nn/layer/loss.py CTCLoss over warpctc."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)

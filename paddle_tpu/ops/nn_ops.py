@@ -706,3 +706,82 @@ def masked_sdpa(q, k, v, add_mask):
     denom = jnp.sum(e, axis=-1, keepdims=True)
     w = e / jnp.maximum(denom, 1e-30)
     return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+@primitive("warpctc")
+def ctc_loss_op(log_probs, labels, input_lengths, label_lengths, *,
+                blank=0):
+    """CTC loss, log-space forward algorithm via lax.scan
+    (reference: operators/warpctc_op.* wrapping warp-ctc; here the DP runs
+    as one compiled scan over time — TPU-friendly, differentiable by jax).
+
+    Numerics: alpha is renormalized each step (per-sample max subtracted and
+    accumulated separately), so values stay O(1) regardless of T/C and the
+    masked-state surrogate (-1e4 relative) can never outweigh a real path.
+
+    log_probs: [T, B, C] log-softmax scores; labels: [B, L] int padded;
+    input_lengths/label_lengths: [B]. Returns per-sample negative log
+    likelihood [B]."""
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    # "impossible" surrogate RELATIVE to the renormalized alpha (max 0):
+    # finite so grads through masked paths are exactly 0 in f32
+    neg_inf = jnp.asarray(-1e4, jnp.float32)
+
+    # extended label sequence with blanks: [B, S]
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    # positions beyond 2*label_len+1 are invalid
+    s_idx = jnp.arange(S)[None, :]
+    valid = s_idx < (2 * label_lengths[:, None] + 1)
+
+    # allow skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32),
+                              ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    b_range = jnp.arange(B)
+
+    alpha0 = jnp.full((B, S), neg_inf)
+    lp0 = log_probs[0]                                # [B, C]
+    alpha0 = alpha0.at[:, 0].set(lp0[b_range, ext[:, 0]])
+    has_lab = (label_lengths > 0)
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(has_lab, lp0[b_range, ext[:, 1]], neg_inf))
+    m0 = jnp.max(alpha0, axis=1)
+    alpha0 = jnp.where(valid, alpha0 - m0[:, None], neg_inf)
+    shift0 = m0
+
+    def lse3(a, b, c):
+        m = jnp.maximum(jnp.maximum(a, b), c)
+        return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m) +
+                           jnp.exp(c - m))
+
+    def masked_step(carry, lp_t):
+        alpha, shift, t = carry
+        shift1 = jnp.concatenate(
+            [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate(
+            [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(can_skip, shift2, neg_inf)
+        em = lp_t[b_range[:, None], ext]              # [B, S]
+        new = lse3(alpha, shift1, shift2) + em
+        m = jnp.maximum(jnp.max(new, axis=1), neg_inf)  # renormalize
+        new = jnp.where(valid, new - m[:, None], neg_inf)
+        # freeze sequences past their input length
+        keep = (t < input_lengths)
+        alpha_out = jnp.where(keep[:, None], new, alpha)
+        shift_out = jnp.where(keep, shift + m, shift)
+        return (alpha_out, shift_out, t + 1), ()
+
+    (alpha_T, shift_T, _), _ = jax.lax.scan(
+        masked_step, (alpha0, shift0, jnp.int32(1)), log_probs[1:])
+    # final: alpha at last blank + last label state
+    endb = 2 * label_lengths                           # index of final blank
+    endl = jnp.maximum(endb - 1, 0)
+    a_b = alpha_T[b_range, endb]
+    a_l = jnp.where(label_lengths > 0, alpha_T[b_range, endl], neg_inf)
+    m = jnp.maximum(a_b, a_l)
+    ll = shift_T + m + jnp.log(jnp.exp(a_b - m) + jnp.exp(a_l - m))
+    return -ll

@@ -70,3 +70,14 @@ def device_places(device_ids=None):
 def name_scope(prefix=None):
     import contextlib
     return contextlib.nullcontext()
+
+
+from . import control_flow  # noqa: E402
+from .control_flow import case, cond, switch_case, while_loop  # noqa: E402
+
+
+class nn:  # namespace mirror of paddle.static.nn (reference: static/nn/)
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)
+    case = staticmethod(case)
+    switch_case = staticmethod(switch_case)

@@ -1,0 +1,147 @@
+"""Control flow ops (reference: paddle/fluid/operators/controlflow/ —
+conditional_block_op.cc, while_op.cc; python fluid/layers/control_flow.py
+cond/while_loop/case/switch_case).
+
+The reference executes sub-blocks by Executor re-entry with a host-side
+branch. TPU-native: under a trace these lower to lax.cond / lax.while_loop
+(compiled, no host round-trip — the XLA-semantics requirement from
+SURVEY §7); eagerly they are plain python dispatch on concrete values."""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _is_traced(*vals):
+    for v in vals:
+        a = v._data if isinstance(v, Tensor) else v
+        if isinstance(a, jax.core.Tracer):
+            return True
+    return False
+
+
+def _unwrap_tree(t):
+    return jax.tree_util.tree_map(
+        lambda x: x._data if isinstance(x, Tensor) else x, t,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_tree(t):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x, _internal=True) if hasattr(x, "dtype") else x, t)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference: fluid/layers/control_flow.py cond → lax.cond when
+    traced."""
+    p = pred._data if isinstance(pred, Tensor) else pred
+    if not _is_traced(pred):
+        return true_fn() if bool(p) else (
+            false_fn() if false_fn is not None else None)
+    if false_fn is None:
+        raise ValueError(
+            "cond: false_fn is required under tracing (both branches of "
+            "lax.cond must produce the same structure)")
+
+    def tf(_):
+        return _unwrap_tree(true_fn())
+
+    def ff(_):
+        return _unwrap_tree(false_fn())
+
+    out = jax.lax.cond(jnp.asarray(p).reshape(()), tf, ff, operand=None)
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """reference: fluid/layers/control_flow.py while_loop → lax.while_loop
+    when traced (body must keep shapes/dtypes fixed, the XLA contract)."""
+    loop_vars = list(loop_vars)
+    if not _is_traced(*[v for v in loop_vars if isinstance(v, Tensor)]):
+        # eager: python loop over concrete values
+        vals = loop_vars
+        while True:
+            r = cond_fn(*vals)
+            if not bool(r._data if isinstance(r, Tensor) else r):
+                break
+            out = body_fn(*vals)
+            vals = list(out) if isinstance(out, (tuple, list)) else [out]
+        return vals
+
+    init = _unwrap_tree(loop_vars)
+
+    def c(carry):
+        r = cond_fn(*_wrap_tree(carry))
+        return jnp.asarray(r._data if isinstance(r, Tensor) else r
+                           ).reshape(())
+
+    def b(carry):
+        out = body_fn(*_wrap_tree(carry))
+        out = list(out) if isinstance(out, (tuple, list)) else [out]
+        return _unwrap_tree(out)
+
+    final = jax.lax.while_loop(c, b, init)
+    return _wrap_tree(final)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: control_flow.py case — first true predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+
+    def build(pairs):
+        (p, fn) = pairs[0]
+        if len(pairs) == 1:
+            if default is not None:
+                return cond(p, fn, default)
+            return cond(p, fn, fn)  # last branch mandatory like reference
+        return cond(p, fn, lambda: build(pairs[1:]))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: control_flow.py switch_case → lax.switch when traced."""
+    idx = branch_index._data if isinstance(branch_index, Tensor) \
+        else branch_index
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+    else:
+        pairs = list(branch_fns)
+        if pairs and isinstance(pairs[0], (tuple, list)):
+            keys = [k for k, _ in pairs]
+            fns = [f for _, f in pairs]
+        else:
+            keys = list(range(len(pairs)))
+            fns = pairs
+    if not _is_traced(branch_index):
+        i = int(idx)
+        if i in keys:
+            return fns[keys.index(i)]()
+        if default is not None:
+            return default()
+        # reference semantics: missing default -> LAST branch
+        return fns[-1]()
+    # traced: lax.switch over ONLY the provided branches (sparse keys
+    # stay sparse); unmatched index -> default, else the last branch
+    # (reference: control_flow.py switch_case default handling)
+    branches = list(fns) + [default if default is not None else fns[-1]]
+    fallback = len(branches) - 1
+    sel = jnp.full((), fallback, jnp.int32)
+    iarr = jnp.asarray(idx).reshape(())
+    for pos, k in enumerate(keys):
+        sel = jnp.where(iarr == k, jnp.int32(pos), sel)
+
+    def mk(fn):
+        return lambda _: _unwrap_tree(fn())
+
+    out = jax.lax.switch(sel, [mk(f) for f in branches], None)
+    return _wrap_tree(out)

@@ -1,0 +1,153 @@
+"""CTC loss (vs torch oracle), static control flow, SyncBatchNorm convert.
+
+reference models: unittests/test_warpctc_op.py (CTC numeric),
+unittests/test_cond.py / test_while_loop.py (control flow),
+test_sync_batch_norm_op.py."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _torch_ctc(lp, labels, in_lens, lab_lens, blank=0):
+    import torch
+    t = torch.nn.functional.ctc_loss(
+        torch.tensor(np.asarray(lp)), torch.tensor(labels),
+        torch.tensor(in_lens), torch.tensor(lab_lens), blank=blank,
+        reduction="none", zero_infinity=False)
+    return t.numpy()
+
+
+def test_ctc_loss_matches_torch():
+    rs = np.random.RandomState(0)
+    T, B, C, L = 12, 3, 6, 4
+    logits = rs.randn(T, B, C).astype(np.float32)
+    labels = rs.randint(1, C, (B, L)).astype(np.int32)  # avoid blank=0
+    in_lens = np.asarray([12, 10, 8], np.int32)
+    lab_lens = np.asarray([4, 3, 2], np.int32)
+
+    got = nn.functional.ctc_loss(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+        reduction="none").numpy()
+    lp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    expect = _torch_ctc(lp, labels.astype(np.int64), in_lens, lab_lens)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+    # 'mean' divides by label_length first (torch/paddle semantics)
+    got_mean = float(nn.functional.ctc_loss(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+        reduction="mean").numpy())
+    np.testing.assert_allclose(got_mean, (expect / lab_lens).mean(),
+                               rtol=1e-4)
+
+
+def test_ctc_loss_long_sequence_stable():
+    """Renormalized DP stays finite/correct at speech-scale T."""
+    rs = np.random.RandomState(3)
+    T, B, C, L = 800, 2, 40, 20
+    logits = (rs.randn(T, B, C) * 3).astype(np.float32)
+    labels = rs.randint(1, C, (B, L)).astype(np.int32)
+    in_lens = np.asarray([800, 700], np.int32)
+    lab_lens = np.asarray([20, 15], np.int32)
+    got = nn.functional.ctc_loss(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+        reduction="none").numpy()
+    lp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    expect = _torch_ctc(lp, labels.astype(np.int64), in_lens, lab_lens)
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-2)
+
+
+def test_ctc_loss_grad_and_training():
+    """CTC trains: loss on a fixed target decreases (grads flow through
+    the scan DP)."""
+    rs = np.random.RandomState(1)
+    T, B, C, L = 10, 2, 5, 3
+    x = paddle.to_tensor(rs.randn(T, B, C).astype(np.float32))
+    x.stop_gradient = False
+    labels = paddle.to_tensor(rs.randint(1, C, (B, L)).astype(np.int32))
+    in_lens = paddle.to_tensor(np.asarray([10, 10], np.int32))
+    lab_lens = paddle.to_tensor(np.asarray([3, 3], np.int32))
+    crit = nn.CTCLoss(blank=0)
+    losses = []
+    lr = 0.5
+    for _ in range(20):
+        loss = crit(x, labels, in_lens, lab_lens)
+        loss.backward()
+        x = paddle.to_tensor(x.numpy() - lr * x.grad.numpy())
+        x.stop_gradient = False
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.6
+    assert np.isfinite(losses).all()
+
+
+def test_cond_while_eager_and_traced():
+    from paddle_tpu.static import case, cond, switch_case, while_loop
+
+    x = paddle.to_tensor(np.float32(3.0))
+    assert float(cond(x > 2, lambda: x * 2, lambda: x - 1).numpy()) == 6.0
+    assert float(cond(x > 5, lambda: x * 2, lambda: x - 1).numpy()) == 2.0
+
+    i, s = while_loop(lambda i, s: i < 5, lambda i, s: (i + 1, s + i),
+                      [paddle.to_tensor(0), paddle.to_tensor(0)])
+    assert int(s.numpy()) == 10
+
+    r = case([(x > 5, lambda: x), (x > 2, lambda: x * 10)],
+             default=lambda: x * 100)
+    assert float(r.numpy()) == 30.0
+    r = switch_case(paddle.to_tensor(2), {0: lambda: x, 2: lambda: x + 1})
+    assert float(r.numpy()) == 4.0
+
+    # traced switch_case with SPARSE keys + below-range index -> last branch
+    from paddle_tpu.framework.tensor import Tensor as _T
+
+    def sw(i):
+        t = _T(i, _internal=True)
+        return switch_case(t, [(2, lambda: _T(jnp.float32(20.0),
+                                              _internal=True)),
+                               (100000, lambda: _T(jnp.float32(50.0),
+                                                   _internal=True))])._data
+
+    gsw = jax.jit(sw)
+    assert float(gsw(jnp.int32(2))) == 20.0
+    assert float(gsw(jnp.int32(100000))) == 50.0
+    assert float(gsw(jnp.int32(0))) == 50.0     # unmatched -> last branch
+
+    # traced cond without false_fn raises a clear error
+    with pytest.raises(ValueError, match="false_fn"):
+        jax.jit(lambda a: cond(_T(a, _internal=True) > 0,
+                               lambda: _T(a, _internal=True)))(
+            jnp.float32(1.0))
+
+    # traced into one XLA program (no host branching)
+    from paddle_tpu.framework.tensor import Tensor
+
+    def f(a):
+        t = Tensor(a, _internal=True)
+        r = cond(t > 0, lambda: t * 2, lambda: -t)
+        i, acc = while_loop(
+            lambda i, acc: i < 4, lambda i, acc: (i + 1, acc + r),
+            [Tensor(jnp.int32(0), _internal=True),
+             Tensor(jnp.float32(0), _internal=True)])
+        return acc._data
+
+    g = jax.jit(f)
+    assert float(g(jnp.float32(2.0))) == 16.0
+    assert float(g(jnp.float32(-3.0))) == 12.0
+
+
+def test_sync_batchnorm_convert():
+    net = nn.Sequential(nn.Conv2D(3, 8, 3), nn.BatchNorm2D(8), nn.ReLU())
+    net[1]._mean.set_value(np.full(8, 0.25, np.float32))
+    conv = nn.SyncBatchNorm.convert_sync_batchnorm(net)
+    assert isinstance(conv[1], nn.SyncBatchNorm)
+    np.testing.assert_allclose(conv[1]._mean.numpy(), 0.25)
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(2, 3, 8, 8).astype(np.float32))
+    out = conv(x)
+    assert list(out.shape) == [2, 8, 6, 6]
