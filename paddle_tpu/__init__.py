@@ -60,6 +60,8 @@ from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401
+from . import linalg  # noqa: F401
+from .hapi.flops import flops  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,2 +1,3 @@
 from .model import Model
 from . import callbacks
+from .flops import flops

@@ -1,0 +1,69 @@
+"""FLOPs counter (reference: python/paddle/hapi/dynamic_flops.py —
+paddle.flops over per-layer hooks)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["flops"]
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count MACs-as-FLOPs for Linear/Conv/Norm/Pool layers by running a
+    forward with shape-recording hooks (reference: dynamic_flops.py)."""
+    import paddle_tpu as paddle
+    from ..nn.layer_base import Layer
+
+    custom_ops = custom_ops or {}
+    total = [0]
+    rows = []
+    hooks = []
+
+    def count(layer, ins, out):
+        cls = type(layer).__name__
+        x = ins[0]
+        n = 0
+        if cls in custom_ops:
+            n = custom_ops[cls](layer, ins, out)
+        elif cls == "Linear":
+            n = _prod(x.shape) // x.shape[-1] * layer.in_features \
+                * layer.out_features
+        elif cls.startswith("Conv"):
+            w = layer.weight
+            out_sp = _prod(out.shape[2:]) if len(out.shape) > 2 else 1
+            n = out.shape[0] * out_sp * _prod(w.shape)
+        elif "Norm" in cls:
+            n = 2 * _prod(x.shape)
+        elif "Pool" in cls:
+            n = _prod(out.shape)
+        if n:
+            total[0] += n
+            rows.append((cls, list(x.shape), list(out.shape), n))
+
+    for _, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaves only
+            hooks.append(sub.register_forward_post_hook(count))
+    try:
+        x = paddle.to_tensor(
+            np.zeros(tuple(input_size), np.float32))
+        was_training = net.training
+        net.eval()
+        net(x)
+        if was_training:
+            net.train()
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        for cls, si, so, n in rows:
+            print(f"{cls:16s} {str(si):24s} -> {str(so):24s} {n:,}")
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

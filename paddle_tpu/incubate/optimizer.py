@@ -1,0 +1,171 @@
+"""Incubate optimizers: LookAhead, ModelAverage, GradientMergeOptimizer.
+
+TPU-native equivalents of the reference's incubate optimizers
+(reference: python/paddle/incubate/optimizer/lookahead.py,
+modelaverage.py; gradient merge: fleet/meta_optimizers/
+gradient_merge_optimizer.py + grad_merge_all_reduce_op_handle.cc — here
+realized as an optimizer wrapper accumulating k micro-steps, which under
+the compiled train step gives the same semantics as the reference's
+program rewrite)."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage", "GradientMergeOptimizer"]
+
+
+class _Wrapper:
+    """Delegate unknown attrs to the inner optimizer."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class LookAhead(_Wrapper):
+    """reference: incubate/optimizer/lookahead.py — slow weights pulled
+    toward fast weights every k steps: slow += alpha * (fast - slow)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        super().__init__(inner_optimizer)
+        self.alpha = float(alpha)
+        self.k = int(k)
+        # slow weights start at the CURRENT params (lookahead paper /
+        # reference lookahead.py). COPIES: the jitted update donates the
+        # live param buffers, which would delete retained references.
+        self._slow: Dict[int, jnp.ndarray] = {
+            id(p): jnp.array(p._data, copy=True)
+            for p in inner_optimizer._parameter_list
+            if not p.stop_gradient}
+        self._n = 0
+
+    def step(self):
+        self._inner.step()
+        self._n += 1
+        if self._n % self.k:
+            return
+        for p in self._inner._parameter_list:
+            slow = self._slow.get(id(p))
+            if slow is None:
+                continue
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            # hand the param a SEPARATE buffer: the next jitted update
+            # donates p._data, which must not delete our retained slow copy
+            p._data = jnp.array(slow, copy=True)
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage(_Wrapper):
+    """reference: incubate/optimizer/modelaverage.py — running average of
+    params; apply()/restore() swap averaged weights in for evaluation."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None, inner_optimizer=None):
+        super().__init__(inner_optimizer)
+        self._params = parameters or (
+            inner_optimizer._parameter_list if inner_optimizer else [])
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._cnt = 0
+        self._backup: Optional[Dict[int, jnp.ndarray]] = None
+
+    def step(self):
+        if self._inner is not None:
+            self._inner.step()
+        for p in self._params:
+            if p.stop_gradient:
+                continue
+            s = self._sum.get(id(p))
+            cur = jnp.array(p._data, copy=True)  # buffer-donation safe
+            self._sum[id(p)] = cur if s is None else s + cur
+        self._cnt += 1
+
+    def clear_grad(self):
+        if self._inner is not None:
+            self._inner.clear_grad()
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged params (context-manager friendly)."""
+        if not self._cnt:
+            return self
+        self._backup = {id(p): jnp.array(p._data, copy=True)
+                        for p in self._params}
+        for p in self._params:
+            s = self._sum.get(id(p))
+            if s is not None:
+                p._data = s / self._cnt
+        return self
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._params:
+                if id(p) in self._backup:
+                    p._data = self._backup[id(p)]
+            self._backup = None
+
+    def __enter__(self):
+        return self.apply()
+
+    def __exit__(self, *exc):
+        self.restore()
+        return False
+
+
+class GradientMergeOptimizer(_Wrapper):
+    """reference: fleet/meta_optimizers/gradient_merge_optimizer.py —
+    accumulate grads over k_steps micro-batches, apply once with the
+    average (avg=True) or the sum."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        super().__init__(inner_optimizer)
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._acc: Dict[int, jnp.ndarray] = {}
+        self._n = 0
+
+    def step(self):
+        self._n += 1
+        params = self._inner._parameter_list
+        for p in params:
+            if p._grad is None:
+                continue
+            a = self._acc.get(id(p))
+            g = p._grad._data
+            self._acc[id(p)] = g if a is None else a + g
+        if self._n % self.k_steps:
+            # not yet: drop this micro-batch's grads, keep accumulating
+            for p in params:
+                p._grad = None
+            return
+        scale = 1.0 / self.k_steps if self.avg else 1.0
+        for p in params:
+            a = self._acc.pop(id(p), None)
+            p._grad = None if a is None else Tensor(a * scale,
+                                                   _internal=True)
+        self._inner.step()
+        for p in params:
+            p._grad = None
+
+    def clear_grad(self):
+        # grads are managed inside step(); explicit clear also resets acc
+        for p in self._inner._parameter_list:
+            p._grad = None
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()

@@ -1,0 +1,14 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py — re-exports
+of tensor.linalg). The ops live in ops/linalg.py as registered primitives;
+this module provides the public namespace."""
+from .tensor import (cholesky, cholesky_solve, cond, det, eig, eigh,  # noqa: F401
+                     eigvals, eigvalsh, inverse, lstsq, lu, matrix_power,
+                     matrix_rank, multi_dot, norm, pinv, qr, slogdet, solve,
+                     svd, triangular_solve)
+
+__all__ = ["cholesky", "cholesky_solve", "cond", "det", "eig", "eigh",
+           "eigvals", "eigvalsh", "inv", "inverse", "lstsq", "lu",
+           "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv",
+           "qr", "slogdet", "solve", "svd", "triangular_solve"]
+
+inv = inverse

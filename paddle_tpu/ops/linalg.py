@@ -214,3 +214,22 @@ def corrcoef(x, *, rowvar=True):
 @primitive("cov_op")
 def cov(x, *, rowvar=True, ddof=True):
     return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0)
+
+
+@primitive("cond_number_op")
+def cond_number(x, *, p=None):
+    """Condition number (reference: linalg.py cond over svd/norm ops)."""
+    if p is None or p == 2:
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return s[..., 0] / s[..., -1]
+    if p == -2:
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return s[..., -1] / s[..., 0]
+    if p == "fro":
+        nx = jnp.sqrt(jnp.sum(jnp.square(x), axis=(-2, -1)))
+        ni = jnp.sqrt(jnp.sum(jnp.square(jnp.linalg.inv(x)),
+                              axis=(-2, -1)))
+        return nx * ni
+    if p in (1, -1, jnp.inf, -jnp.inf, "nuc"):
+        return jnp.linalg.cond(x, p)
+    raise ValueError(f"unsupported p={p!r} for cond")

@@ -345,6 +345,12 @@ solve = _la.solve
 triangular_solve = _la.triangular_solve
 lstsq = _la.lstsq
 multi_dot = _la.multi_dot
+
+
+def cond(x, p=None, name=None):
+    return _la.cond_number(x, p=p)
+
+
 histogram = _la.histogram
 bincount = _la.bincount
 trace = _la.trace

@@ -448,3 +448,96 @@ def test_text_datasets():
             paddle.text.WMT14.EOS
     finally:
         del os.environ["PADDLE_TPU_SYNTH_SAMPLES"]
+
+
+# ---------------------------------------------------- linalg / flops / misc
+def test_linalg_namespace():
+    rs = np.random.RandomState(11)
+    a = rs.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    t = paddle.to_tensor(spd)
+    L = paddle.linalg.cholesky(t).numpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        paddle.linalg.inv(t).numpy() @ spd, np.eye(4), atol=1e-4)
+    c = float(paddle.linalg.cond(paddle.to_tensor(
+        np.diag([4.0, 1.0]).astype(np.float32))).numpy())
+    np.testing.assert_allclose(c, 4.0, rtol=1e-5)
+
+
+def test_flops_counter():
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    n = paddle.flops(net, (2, 16))
+    assert n == 2 * (16 * 32 + 32 * 8)
+
+
+def test_lookahead_and_model_average():
+    from paddle_tpu.incubate import LookAhead, ModelAverage
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                 learning_rate=0.1)
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 2, (8,)))
+    loss_fn = nn.CrossEntropyLoss()
+    w0 = net.weight.numpy().copy()
+    losses = []
+    for _ in range(6):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert not np.allclose(net.weight.numpy(), w0)
+
+    ma = ModelAverage(parameters=net.parameters(),
+                      inner_optimizer=paddle.optimizer.SGD(
+                          parameters=net.parameters(), learning_rate=0.1))
+    for _ in range(3):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        ma.step()
+        ma.clear_grad()
+    live = net.weight.numpy().copy()
+    with ma:
+        avg = net.weight.numpy().copy()
+    np.testing.assert_allclose(net.weight.numpy(), live)  # restored
+    assert not np.allclose(avg, live)
+
+
+def test_gradient_merge_optimizer():
+    from paddle_tpu.incubate import GradientMergeOptimizer
+
+    def run(merge):
+        paddle.seed(7)
+        net = nn.Linear(4, 2)
+        inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                     learning_rate=0.1)
+        x1 = paddle.to_tensor(np.random.RandomState(0).randn(4, 4)
+                              .astype(np.float32))
+        x2 = paddle.to_tensor(np.random.RandomState(1).randn(4, 4)
+                              .astype(np.float32))
+        y1 = paddle.to_tensor(np.random.RandomState(2).randint(0, 2, (4,)))
+        y2 = paddle.to_tensor(np.random.RandomState(3).randint(0, 2, (4,)))
+        loss_fn = nn.CrossEntropyLoss()
+        if merge:
+            opt = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+            for xb, yb in ((x1, y1), (x2, y2)):
+                loss = loss_fn(net(xb), yb)
+                loss.backward()
+                opt.step()
+        else:
+            # big-batch equivalent
+            import paddle_tpu.tensor as T
+            xb = paddle.concat([x1, x2], axis=0)
+            yb = paddle.concat([y1, y2], axis=0)
+            loss = loss_fn(net(xb), yb)
+            loss.backward()
+            inner.step()
+        return net.weight.numpy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
