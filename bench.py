@@ -45,13 +45,95 @@ def bench_lenet_fit():
     return ips
 
 
+_METRIC = "lenet_mnist_dygraph_fit_images_per_sec_per_chip"
+
+
+def _child_main():
+    """Runs the actual bench; prints exactly one JSON line."""
+    try:
+        if os.environ.get("_PT_BENCH_FORCE_CPU") == "1":
+            from paddle_tpu.framework.platform import pin_host_platform
+
+            pin_host_platform(1)
+        import jax
+
+        platform = jax.devices()[0].platform
+        ips = bench_lenet_fit()
+        print(json.dumps({
+            "metric": _METRIC,
+            "value": round(float(ips), 1),
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,
+            "platform": platform,
+        }), flush=True)
+    except Exception as e:
+        print(json.dumps({
+            "metric": _METRIC, "value": 0.0, "unit": "images/sec/chip",
+            "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}",
+        }), flush=True)
+
+
+def _last_json_line(text: str):
+    """Last stdout line that parses as THIS bench's metric JSON (stray
+    structured log lines from backend teardown must not be mistaken for the
+    result)."""
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                if json.loads(line).get("metric") == _METRIC:
+                    return line
+            except ValueError:
+                continue
+    return None
+
+
 def main():
-    ips = bench_lenet_fit()
+    """Watchdog wrapper: a wedged TPU tunnel makes the first jax device use
+    hang forever inside make_c_api_client — no in-process handling can
+    recover (round-1 bench emitted no output at all this way). So the bench
+    body runs in a timed CHILD process; if it hangs or dies without output,
+    retry once pinned to CPU; always end with one parseable JSON line."""
+    import subprocess
+    import sys
+
+    if os.environ.get("_PT_BENCH_CHILD") == "1":
+        _child_main()
+        return
+
+    attempts = [{}, {"_PT_BENCH_FORCE_CPU": "1"}]
+    last_err = "no output"
+    for i, extra in enumerate(attempts):
+        env = dict(os.environ, _PT_BENCH_CHILD="1", **extra)
+        line = None
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=900.0)
+            line = _last_json_line(out.stdout)
+            if line is None:
+                last_err = (f"child rc={out.returncode}, no JSON; stderr "
+                            "tail: " + out.stderr[-300:].replace("\n", " "))
+        except subprocess.TimeoutExpired as e:
+            # the bench may have printed its result before hanging in
+            # backend teardown — salvage captured stdout (bytes even in
+            # text mode on some CPython versions)
+            captured = e.stdout or ""
+            if isinstance(captured, bytes):
+                captured = captured.decode("utf-8", "replace")
+            line = _last_json_line(captured)
+            if line is None:
+                last_err = "child timed out (backend hang?)"
+        if line is not None:
+            # a child error JSON is only final on the last attempt: a fast
+            # TPU-side failure should still fall through to the CPU retry
+            if "error" not in json.loads(line) or i == len(attempts) - 1:
+                print(line)
+                return
+            last_err = json.loads(line)["error"]
     print(json.dumps({
-        "metric": "lenet_mnist_dygraph_fit_images_per_sec_per_chip",
-        "value": round(float(ips), 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": 0.0,
+        "metric": _METRIC, "value": 0.0, "unit": "images/sec/chip",
+        "vs_baseline": 0.0, "error": last_err,
     }))
 
 

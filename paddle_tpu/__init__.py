@@ -6,12 +6,23 @@ The top-level namespace mirrors the reference's `paddle` package so user
 code ports by changing the import."""
 from __future__ import annotations
 
+import os as _os
+
 import jax as _jax
 
 # int64 is the reference's default index/label dtype; enable 64-bit types
 # so the API surface matches (floats stay explicitly float32/bfloat16 —
 # TPU-first code never emits f64 unless the user asks).
 _jax.config.update("jax_enable_x64", True)
+
+# Launcher-spawned workers must stay off the TPU tunnel even though this
+# image's sitecustomize overrides the JAX_PLATFORMS env var (see
+# framework/platform.py). distributed/launch.py sets this for multi-process
+# single-host runs; honoring it here pins the platform before the worker's
+# first device use.
+_forced = _os.environ.get("PADDLE_TPU_FORCE_PLATFORM")
+if _forced:
+    _jax.config.update("jax_platforms", _forced)
 
 # dtypes
 from .framework.dtype import (bool_ as bool, uint8, int8, int16, int32,  # noqa: A004

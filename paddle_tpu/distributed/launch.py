@@ -73,8 +73,17 @@ def launch_collective(args) -> int:
         if world > 1:
             env["PADDLE_COORDINATOR_ADDRESS"] = master
         if nprocs > 1:
-            # several controllers on one host: give each a CPU device set
+            # Several controllers on one host: give each a CPU device set.
+            # JAX_PLATFORMS alone is overridden by sitecustomize's axon
+            # plugin registration, so also set PADDLE_TPU_FORCE_PLATFORM,
+            # which paddle_tpu/__init__ turns into a config update before
+            # the worker's first device use (framework/platform.py).
+            from ..framework.platform import with_host_device_count
             env.setdefault("JAX_PLATFORMS", "cpu")
+            # honor a user-set JAX_PLATFORMS rather than forcing cpu over it
+            env.setdefault("PADDLE_TPU_FORCE_PLATFORM", env["JAX_PLATFORMS"])
+            env["XLA_FLAGS"] = with_host_device_count(
+                env.get("XLA_FLAGS", ""), 1)
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
         out = (open(os.path.join(log_dir, f"workerlog.{rank}"), "w")

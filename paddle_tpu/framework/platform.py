@@ -1,0 +1,52 @@
+"""Host-platform pinning.
+
+This image's sitecustomize registers the 'axon' TPU plugin and overrides
+jax_platforms, so `JAX_PLATFORMS=cpu` in the environment alone does NOT keep
+jax off the TPU tunnel — a CPU-mesh run would load libtpu and hang or die on
+a version mismatch. The counter-recipe (used by tests/conftest.py, the
+driver dryrun in __graft_entry__.py, and bench.py's fallback) is: set the
+env vars, import jax, then force the config back to cpu before any backend
+initializes.
+
+Must be called in a process that has NOT yet initialized a jax backend
+(backend platform and XLA_FLAGS are frozen at first device use).
+"""
+from __future__ import annotations
+
+import os
+import re
+
+
+def with_host_device_count(flags: str, n_devices: int) -> str:
+    """Return `flags` with --xla_force_host_platform_device_count set to
+    exactly `n_devices`, replacing any existing value."""
+    want = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        return re.sub(r"--xla_force_host_platform_device_count=\d+",
+                      want, flags)
+    return (flags + " " + want).strip()
+
+
+def pin_host_platform(n_devices: int = 8):
+    """Force jax onto the host (CPU) platform with `n_devices` virtual
+    devices. Returns the imported jax module. Raises RuntimeError if the
+    platform config can no longer be changed (backend already initialized —
+    run in a fresh process)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = with_host_device_count(
+        os.environ.get("XLA_FLAGS", ""), n_devices)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # config.update is a silent no-op once a backend is up, so verify: if a
+    # backend already initialized on another platform, devices() returns it
+    # immediately (no tunnel touch) and we must fail loudly rather than let
+    # the caller run a "CPU" workload over the TPU tunnel.
+    devs = jax.devices()
+    if any(d.platform != "cpu" for d in devs) or len(devs) < n_devices:
+        raise RuntimeError(
+            f"pin_host_platform: wanted {n_devices} cpu devices but the "
+            f"backend has {devs}; it must run before any jax backend "
+            f"initializes — start a fresh process")
+    return jax

@@ -15,13 +15,28 @@ import numpy as np
 
 
 class GlobalRNG:
+    """Lazily materializes the root PRNG key: building a PRNGKey touches the
+    jax backend, and `import paddle_tpu` must never initialize one (the axon
+    TPU plugin can be slow/broken while the CPU path is fine — see
+    tests/conftest.py)."""
+
     def __init__(self, seed: int = 0):
         self._seed = seed
-        self.key = jax.random.PRNGKey(seed)
+        self._key = None
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        return self._key
+
+    @key.setter
+    def key(self, value):
+        self._key = value
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self.key = jax.random.PRNGKey(self._seed)
+        self._key = jax.random.PRNGKey(self._seed)
 
     def next_key(self):
         self.key, sub = jax.random.split(self.key)

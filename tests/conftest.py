@@ -3,14 +3,8 @@ tests run without real multi-chip hardware (see build instructions).
 
 The axon TPU plugin registers itself via sitecustomize and forces
 jax_platforms='axon,cpu'; tests must not touch the TPU tunnel, so we force
-the config back to cpu BEFORE any backend initializes."""
-import os
+the config back to cpu BEFORE any backend initializes (shared recipe in
+paddle_tpu/framework/platform.py)."""
+from paddle_tpu.framework.platform import pin_host_platform
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+pin_host_platform(8)
