@@ -1,0 +1,149 @@
+"""Micro-benchmarks: Pallas fused kernels vs their XLA fallbacks on TPU.
+
+Run on a TPU host:  python benchmarks/fused_kernels_bench.py
+Prints one JSON line per kernel with pallas/xla times and speedup.
+Shapes follow the GPT-2/ERNIE configs in BASELINE.md."""
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=30, warmup=5):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_flash_attention(B=8, H=12, T=1024, D=64, dtype=jnp.bfloat16):
+    from paddle_tpu.ops.pallas_kernels import _flash, _xla_attention
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    k = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    v = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    interp = jax.default_backend() != "tpu"
+
+    @jax.jit
+    def pallas_step(q, k, v):
+        loss, grads = jax.value_and_grad(
+            lambda q, k, v: _flash(q, k, v, True, interp).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        return grads
+
+    @jax.jit
+    def xla_step(q, k, v):
+        loss, grads = jax.value_and_grad(
+            lambda q, k, v: _xla_attention(q, k, v, True).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        return grads
+
+    tp = timeit(pallas_step, q, k, v)
+    tx = timeit(xla_step, q, k, v)
+    return {"kernel": "flash_attention_fwd_bwd",
+            "shape": [B, H, T, D], "dtype": str(dtype.__name__),
+            "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
+            "speedup": round(tx / tp, 2)}
+
+
+def bench_fused_ln(N=8192, Hdim=768, p=0.1, dtype=jnp.bfloat16):
+    from paddle_tpu.ops.pallas_kernels import (
+        fused_bias_dropout_residual_ln_arrays)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(N, Hdim), dtype)
+    res = jnp.asarray(rs.randn(N, Hdim), dtype)
+    bias = jnp.asarray(rs.randn(Hdim), dtype)
+    gamma = jnp.ones((Hdim,), dtype)
+    beta = jnp.zeros((Hdim,), dtype)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def fused(x, res, key):
+        return jax.grad(lambda x: fused_bias_dropout_residual_ln_arrays(
+            x, res, bias, gamma, beta, key, p, 1e-5, True,
+            "upscale_in_train")[0].sum())(x)
+
+    @jax.jit
+    def unfused(x, res, key):
+        def f(x):
+            keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+            z = res + jnp.where(keep, (x + bias) / (1.0 - p), 0)
+            mean = z.mean(-1, keepdims=True)
+            var = ((z - mean) ** 2).mean(-1, keepdims=True)
+            return ((z - mean) * jax.lax.rsqrt(var + 1e-5) * gamma
+                    + beta).sum()
+        return jax.grad(f)(x)
+
+    tp = timeit(fused, x, res, key)
+    tx = timeit(unfused, x, res, key)
+    return {"kernel": "fused_bias_dropout_residual_ln_fwd_bwd",
+            "shape": [N, Hdim], "dtype": str(dtype.__name__),
+            "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
+            "speedup": round(tx / tp, 2)}
+
+
+def bench_fused_adamw(numel=768 * 3072, dtype=jnp.float32):
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.ops.pallas_kernels import fused_adamw_or_none
+    rs = np.random.RandomState(0)
+    shape = (numel // 128, 128)
+    p = jnp.asarray(rs.randn(*shape), dtype)
+    g = jnp.asarray(rs.randn(*shape), dtype)
+    m1 = jnp.zeros(shape, jnp.float32)
+    m2 = jnp.zeros(shape, jnp.float32)
+    lr, t = jnp.float32(1e-3), jnp.int32(2)
+    interp = jax.default_backend() != "tpu"
+
+    pallas_fn = jax.jit(functools.partial(
+        fused_adamw_or_none, beta1=0.9, beta2=0.999, epsilon=1e-8,
+        coeff=0.01, interpret=interp))
+    sa = (0.9, 0.999, 1e-8, 0.01)
+    xla_fn = jax.jit(lambda p, g, lr, t, m1, m2:
+                     AdamW._update_rule(sa, p, g, lr, t, m1, m2))
+
+    tp = timeit(pallas_fn, p, g, lr, t, m1, m2)
+    tx = timeit(xla_fn, p, g, lr, t, m1, m2)
+    return {"kernel": "fused_adamw_update",
+            "shape": list(shape), "dtype": str(np.dtype(dtype).name),
+            "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
+            "speedup": round(tx / tp, 2)}
+
+
+def main():
+    tpu = jax.default_backend() == "tpu"
+    print(json.dumps({"backend": jax.default_backend(),
+                      "note": None if tpu else
+                      "non-TPU smoke run: tiny shapes, interpret-mode "
+                      "pallas — timings not meaningful"}))
+    if tpu:
+        benches = [bench_flash_attention, bench_fused_ln, bench_fused_adamw]
+    else:
+        benches = [
+            functools.partial(bench_flash_attention, B=1, H=2, T=64, D=16,
+                              dtype=jnp.float32),
+            functools.partial(bench_fused_ln, N=64, Hdim=128,
+                              dtype=jnp.float32),
+            functools.partial(bench_fused_adamw, numel=128 * 16),
+        ]
+    for fn in benches:
+        try:
+            print(json.dumps(fn()), flush=True)
+        except Exception as e:
+            name = getattr(fn, "__name__", getattr(
+                getattr(fn, "func", None), "__name__", "bench"))
+            print(json.dumps({"kernel": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

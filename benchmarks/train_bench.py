@@ -1,0 +1,191 @@
+"""Training benchmarks with MFU: BASELINE.md configs 2 (ResNet-50 static)
+and 5-family (GPT-2 small train step).
+
+Run:  python benchmarks/train_bench.py [resnet50|gpt2|all]
+Prints one JSON line per config:
+  {"config": ..., "throughput": ..., "unit": ..., "step_ms": ..., "mfu": ...}
+
+MFU = analytic_train_flops_per_step / (step_time * chip peak FLOPs/s).
+Peak FLOPs table is bf16/fp16; override with PADDLE_TPU_PEAK_FLOPS.
+Analytic FLOPs follow the standard conventions (6·N·tokens + attention for
+transformers; 3× forward GFLOPs for convnets) so numbers are comparable to
+published MFU figures."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+_PEAK_FLOPS = {
+    # device_kind substring (lowercase) -> peak dense FLOPs/s (bf16)
+    "v6": 918e12,
+    "v5p": 459e12,
+    "v5": 197e12,   # v5e / "v5 lite"
+    "v4": 275e12,
+}
+
+
+def peak_flops():
+    import jax
+    env = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, val in _PEAK_FLOPS.items():
+        if sub in kind:
+            return val
+    return None
+
+
+def _mfu(flops_per_step, step_s):
+    pk = peak_flops()
+    if pk is None:
+        return None
+    return round(flops_per_step / step_s / pk, 4)
+
+
+def bench_gpt2(on_tpu):
+    """GPT-2 small dygraph compiled train step (AdamW), synthetic token
+    stream fed through the DataLoader machinery (worker thread + batching +
+    host->device transfer included in the measured step loop)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+    from paddle_tpu.jit.engine import make_train_step
+    from paddle_tpu.models import GPTPretrainingCriterion, gpt2_small, gpt_tiny
+
+    if on_tpu:
+        B, T, steps, warmup = 8, 512, 30, 3
+        net = gpt2_small()
+    else:  # smoke shapes: exercises the same code path, timing meaningless
+        B, T, steps, warmup = 2, 64, 3, 1
+        net = gpt_tiny(vocab_size=1024, hidden_size=64, num_layers=2,
+                       num_heads=4, intermediate_size=128,
+                       max_position_embeddings=T + 1)
+    paddle.seed(0)
+    cfg = net.config if hasattr(net, "config") else {}
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 learning_rate=1e-4, weight_decay=0.01)
+    step = make_train_step(net, lambda o, l: crit(o, l), opt)
+
+    vocab = net.embeddings.word_embeddings.weight.shape[0] \
+        if hasattr(net, "embeddings") else 1024
+
+    class TokenStream(Dataset):
+        def __len__(self):
+            return 100000
+
+        def __getitem__(self, i):
+            rs = np.random.RandomState(i)
+            return rs.randint(0, vocab, (T + 1,)).astype(np.int64)
+
+    loader = DataLoader(TokenStream(), batch_size=B, num_workers=1,
+                        shuffle=False)
+    it = iter(loader)
+
+    def one_step():
+        batch = next(it)
+        ids = batch if not isinstance(batch, (list, tuple)) else batch[0]
+        x = ids[:, :-1]
+        y = ids[:, 1:]
+        loss, _ = step([x], [y])
+        return loss
+
+    for _ in range(warmup):
+        loss = one_step()
+    float(loss.numpy())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = one_step()
+    float(loss.numpy())  # block
+    dt = (time.perf_counter() - t0) / steps
+
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    # standard transformer train FLOPs: 6·N per token (fwd 2N + bwd 4N)
+    # + attention 12·L·T·d per token (QKᵀ and PV, fwd+bwd)
+    L = getattr(net, "num_layers", None) or len(getattr(
+        net, "decoder_layers", [])) or 12
+    dmodel = getattr(net, "hidden_size", None) or 768
+    tokens = B * T
+    flops = 6 * n_params * tokens + 12 * L * dmodel * T * tokens
+    return {"config": "gpt2_small_train" if on_tpu else "gpt_tiny_train",
+            "throughput": round(tokens / dt, 1),
+            "unit": "tokens/sec/chip",
+            "step_ms": round(dt * 1e3, 2),
+            "batch": B, "seq_len": T, "params": n_params,
+            "mfu": _mfu(flops, dt)}
+
+
+def bench_resnet50(on_tpu):
+    """ResNet-50 static-graph Executor training (BASELINE config 2)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.vision.models import resnet50
+
+    if on_tpu:
+        B, hw, steps, warmup = 64, 224, 20, 3
+    else:
+        B, hw, steps, warmup = 4, 32, 2, 3  # first TWO runs compile
+
+    paddle.enable_static()
+    try:
+        paddle.seed(0)
+        img = static.data("image", [-1, 3, hw, hw], "float32")
+        label = static.data("label", [-1, 1], "int64")
+        net = resnet50(num_classes=100)
+        logits = net(img)
+        loss = paddle.nn.functional.cross_entropy(logits, label)
+        opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+        opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+
+        rs = np.random.RandomState(0)
+        x = rs.rand(B, 3, hw, hw).astype(np.float32)
+        y = rs.randint(0, 100, (B, 1)).astype(np.int64)
+        for _ in range(warmup):
+            exe.run(feed={"image": x, "label": y}, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (lv,) = exe.run(feed={"image": x, "label": y},
+                            fetch_list=[loss])
+        dt = (time.perf_counter() - t0) / steps
+    finally:
+        paddle.disable_static()
+
+    # ResNet-50 fwd ≈ 4.1 GFLOPs / 224² image (scales with area);
+    # train ≈ 3× fwd
+    fwd = 4.1e9 * (hw * hw) / (224 * 224)
+    flops = 3 * fwd * B
+    return {"config": "resnet50_static_train",
+            "throughput": round(B / dt, 1),
+            "unit": "images/sec/chip",
+            "step_ms": round(dt * 1e3, 2),
+            "batch": B, "image": hw,
+            "mfu": _mfu(flops, dt)}
+
+
+def main():
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device_kind": jax.devices()[0].device_kind}))
+    benches = {"gpt2": bench_gpt2, "resnet50": bench_resnet50}
+    for name, fn in benches.items():
+        if which not in ("all", name):
+            continue
+        try:
+            print(json.dumps(fn(on_tpu)), flush=True)
+        except Exception as e:
+            print(json.dumps({"config": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -71,3 +71,9 @@ define_flag("tpu_matmul_precision", "default",
 define_flag("use_flash_attention", True,
             "route F.scaled_dot_product_attention to the Pallas flash "
             "kernel when shapes/backend allow")
+define_flag("use_fused_optimizer", True,
+            "route Adam/AdamW updates to the Pallas fused kernel on TPU "
+            "(single HBM pass, in-place via buffer aliasing)")
+define_flag("use_fused_dropout_ln", True,
+            "route fused bias+dropout+residual+layernorm to the Pallas "
+            "kernel when shapes/backend allow")

@@ -1,4 +1,6 @@
-from .fused_transformer import (fused_feedforward,  # noqa: F401
+from .fused_transformer import (fused_bias_dropout_residual_layer_norm,  # noqa: F401
+                                fused_feedforward,
                                 fused_multi_head_attention)
 
-__all__ = ["fused_feedforward", "fused_multi_head_attention"]
+__all__ = ["fused_bias_dropout_residual_layer_norm", "fused_feedforward",
+           "fused_multi_head_attention"]

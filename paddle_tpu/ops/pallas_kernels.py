@@ -3,10 +3,12 @@
 Flash attention (online-softmax, O(T) memory) — the TPU-native counterpart of
 the reference's fused CUDA attention (operators/fused/fused_attention_op.cu,
 operators/fused/multihead_matmul_op.cu). Forward is a Pallas kernel tiled for
-the MXU (q blocks × k blocks, f32 accumulators, bf16-friendly); backward is a
-custom_vjp that recomputes attention with plain XLA ops (flash-style remat:
-no T×T tensor is ever materialised in the forward, and XLA fuses the
-recomputation into the backward matmuls).
+the MXU (q blocks × k blocks, f32 accumulators, bf16-friendly); backward is
+a pair of Pallas kernels (FlashAttention-2 style: a dq kernel streaming K/V
+blocks and a dk/dv kernel streaming Q/dO blocks) driven by the forward's
+saved logsumexp — no T×T tensor is ever materialised in either direction.
+Training forwards additionally save lse (q-row logsumexp, broadcast over a
+128-lane minor dim for TPU tiling); inference forwards skip it.
 
 On CPU (tests) the kernel runs in interpret mode on tiny shapes; dispatch is
 gated by `flash_attention_or_none` which returns None when the plain XLA path
@@ -32,8 +34,14 @@ except Exception:  # pragma: no cover
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_k,
-                      causal, q_block, shift):
+# Per-row scalars (LSE) are stored broadcast over a 128-lane minor dim so
+# their blocks satisfy TPU lane alignment (same layout jax's own TPU flash
+# attention uses for its l/m residuals).
+_LANES = 128
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
+                      block_k, causal, q_block, shift):
     """One (batch·head, q-block) program: stream K/V blocks, online softmax.
 
     `shift` = Tk - Tq implements bottom-right-aligned causal masking (cached
@@ -78,10 +86,22 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_k,
         nblk_eff = nblk
     acc, m_i, l_i = jax.lax.fori_loop(0, nblk_eff, body, (acc, m_i, l_i))
     o_ref[...] = (acc / l_i[:, None]).astype(o_ref.dtype)
+    if lse_ref is not None:
+        # logsumexp of the SCALED scores, for the backward kernels
+        lse = m_i + jnp.log(l_i)
+        lse_ref[...] = jax.lax.broadcast_in_dim(lse, (bq, _LANES), (0,))
 
 
-def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False):
-    """q/k/v: [B, H, Tq|Tk, D] → out [B, H, Tq, D]."""
+def _nolse_kernel(kern, q_ref, k_ref, v_ref, o_ref):
+    kern(q_ref, k_ref, v_ref, o_ref, None)
+
+
+def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False,
+               need_lse=True):
+    """q/k/v: [B, H, Tq|Tk, D] → (out [B, H, Tq, D], lse [B*H, Tq, 128]).
+
+    `need_lse=False` (inference) skips the lse output entirely — no extra
+    HBM write; returns (out, None)."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     sm_scale = float(D) ** -0.5
@@ -93,7 +113,19 @@ def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False):
     kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
                                block_k=block_k, causal=causal,
                                q_block=block_q, shift=Tk - Tq)
-    out = pl.pallas_call(
+    o_spec = pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0))
+    o_shape = jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)
+    if need_lse:
+        out_specs = [o_spec,
+                     pl.BlockSpec((None, block_q, _LANES),
+                                  lambda b, i: (b, i, 0))]
+        out_shape = [o_shape,
+                     jax.ShapeDtypeStruct((B * H, Tq, _LANES), jnp.float32)]
+    else:
+        kernel = functools.partial(_nolse_kernel, kernel)
+        out_specs = [o_spec]
+        out_shape = [o_shape]
+    outs = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // block_q),
         in_specs=[
@@ -101,11 +133,179 @@ def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False):
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(qr, kr, vr)
+    out = outs[0].reshape(B, H, Tq, D)
+    return out, (outs[1] if need_lse else None)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+                         *, sm_scale, block_k, causal, q_block, shift):
+    """dq for one (batch·head, q-block): stream K/V blocks.
+
+    FlashAttention-2 backward: p = exp(s·scale − lse), dp = do·vᵀ,
+    ds = p·(dp − Δ)·scale with Δ = rowsum(do∘o) (recomputed here — cheaper
+    than a broadcast residual array), dq = Σ_j ds·k."""
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)                    # [bq, d]
+    do = do_ref[...].astype(jnp.float32)
+    o = o_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, :1]                             # [bq, 1]
+    delta = jnp.sum(do * o, axis=1, keepdims=True)        # [bq, 1]
+    bq, d = q.shape
+    kt = k_ref.shape[0]
+    nblk = kt // block_k
+
+    def body(j, dq_acc):
+        k = k_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        if causal:
+            q_pos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos + shift >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                              # masked → 0
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        return dq_acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        upper = (qi + 1) * q_block + shift
+        nblk_eff = jax.lax.min(
+            jnp.int32(nblk), (upper + block_k - 1) // block_k)
+    else:
+        nblk_eff = nblk
+    dq = jax.lax.fori_loop(0, nblk_eff, body,
+                           jnp.zeros((bq, d), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                          dk_ref, dv_ref, *, sm_scale, block_q, causal,
+                          k_block, shift):
+    """dk/dv for one (batch·head, k-block): stream Q/dO blocks.
+
+    dv = Σ_i pᵀ·do, dk = Σ_i dsᵀ·q; under causal masking q-blocks strictly
+    above the shifted diagonal are skipped via the loop lower bound."""
+    ki = pl.program_id(1)
+    k = k_ref[...].astype(jnp.float32)                    # [bk, d]
+    v = v_ref[...].astype(jnp.float32)
+    bk, d = k.shape
+    qt = q_ref.shape[0]
+    nblk = qt // block_q
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        o = o_ref[pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.dslice(i * block_q, block_q), :][:, :1]
+        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale                                  # [bq, bk]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = ki * k_block + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos + shift >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    if causal:
+        # first q row that can see this k block: q_pos + shift >= ki·bk
+        start = jax.lax.max(jnp.int32(0),
+                            (ki * k_block - shift) // block_q)
+    else:
+        start = jnp.int32(0)
+    dk, dv = jax.lax.fori_loop(
+        start, nblk, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal, block_q=128, block_k=128,
+               interpret=False):
+    """Pallas flash-attention backward: (dq, dk, dv), O(T) memory — the
+    TPU-native counterpart of the reference's fused attention grad
+    (operators/fused/fused_attention_op.cu backward)."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    sm_scale = float(D) ** -0.5
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    shift = Tk - Tq
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    orr = o.reshape(B * H, Tq, D)
+    dor = do.reshape(B * H, Tq, D)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, sm_scale=sm_scale, block_k=block_k,
+        causal=causal, q_block=block_q, shift=shift)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, i: (b, i, 0)),
+        ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(B, H, Tq, D)
+    )(qr, kr, vr, orr, dor, lse)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, sm_scale=sm_scale, block_q=block_q,
+        causal=causal, k_block=block_k, shift=shift)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, Tq, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, Tq, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, Tq, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, Tq, _LANES), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, orr, dor, lse)
+    return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+            dv.reshape(B, H, Tk, D))
 
 
 def _xla_attention(q, k, v, causal):
@@ -123,25 +323,30 @@ def _xla_attention(q, k, v, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, interpret):
-    return _flash_fwd(q, k, v, causal, interpret=interpret)
+    return _flash_fwd(q, k, v, causal, interpret=interpret,
+                      need_lse=False)[0]
 
 
 def _flash_vjp_fwd(q, k, v, causal, interpret):
-    return _flash_fwd(q, k, v, causal, interpret=interpret), (q, k, v)
+    o, lse = _flash_fwd(q, k, v, causal, interpret=interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, g, causal, interpret=interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def _shapes_ok(q, k, interpret):
+def _shapes_ok(q, k, causal, interpret):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
+    if causal and Tk < Tq:
+        # bottom-right alignment would fully mask the first Tq-Tk rows
+        # (0/0 in the online softmax); no real workload hits this — XLA path
+        return False
     if interpret:  # CPU test path: keep interpret-mode cheap
         return Tq * Tk <= 64 * 64 and D <= 128
 
@@ -158,6 +363,322 @@ def _flash_op(q, k, v, *, causal=False, interpret=False):
     return _flash(q, k, v, causal, interpret)
 
 
+# ---------------------------------------------------------------------------
+# Fused bias + dropout + residual (+ layernorm)
+#
+# TPU-native counterpart of the reference's fused dropout chain
+# (/root/reference/paddle/fluid/operators/fused/fused_dropout_helper.h — the
+# LaunchResidualDropoutBias / LaunchLayernormResidualDropoutBias kernels used
+# by fused_attention_op.cu and fused_feedforward_op.cu). One Pallas program
+# computes z = residual + dropout(x + bias) and y = LN(z) in a single HBM
+# pass; the backward recomputes LN statistics from the saved z (cheaper than
+# storing mean/rstd) and regenerates the dropout mask from the same per-
+# program seed (hardware PRNG on TPU — the mask never touches HBM).
+# On CPU/interpret the mask bits are generated outside (threefry) and passed
+# in, exercising identical keep/scale logic.
+# ---------------------------------------------------------------------------
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+
+def _dropout_keep(bits, h, p, scale):
+    """Shared keep/scale decision: keep iff bits >= p·2³² (P = 1-p)."""
+    threshold = jnp.uint32(min(int(p * (2.0 ** 32)), 2 ** 32 - 1))
+    keep = bits >= threshold
+    return jnp.where(keep, h * scale, 0.0)
+
+
+def _fbdrln_rng_bits(rng_ref, shape, has_rng):
+    if has_rng:
+        pltpu.prng_seed(rng_ref[0] + pl.program_id(0))
+        return pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    return rng_ref[...].astype(jnp.uint32)
+
+
+def _fbdrln_fwd_kernel(rng_ref, x_ref, res_ref, bias_ref, gamma_ref,
+                       beta_ref, y_ref, z_ref, *, p, scale, eps, has_rng,
+                       with_ln):
+    x = x_ref[...].astype(jnp.float32)                    # [bn, H]
+    res = res_ref[...].astype(jnp.float32)
+    h = x + bias_ref[...].astype(jnp.float32)             # bias [1, H]
+    if p > 0.0:
+        bits = _fbdrln_rng_bits(rng_ref, h.shape, has_rng)
+        h = _dropout_keep(bits, h, p, scale)
+    z = res + h
+    z_ref[...] = z.astype(z_ref.dtype)
+    if with_ln:
+        mean = jnp.mean(z, axis=1, keepdims=True)
+        var = jnp.mean((z - mean) ** 2, axis=1, keepdims=True)
+        rstd = jax.lax.rsqrt(var + eps)
+        y = ((z - mean) * rstd * gamma_ref[...].astype(jnp.float32)
+             + beta_ref[...].astype(jnp.float32))
+        y_ref[...] = y.astype(y_ref.dtype)
+    else:
+        y_ref[...] = z.astype(y_ref.dtype)
+
+
+def _fbdrln_bwd_kernel(rng_ref, z_ref, dy_ref, dz_extra_ref, gamma_ref,
+                       dx_ref, dres_ref, *, p, scale, eps, has_rng, with_ln):
+    z = z_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    if with_ln:
+        mean = jnp.mean(z, axis=1, keepdims=True)
+        var = jnp.mean((z - mean) ** 2, axis=1, keepdims=True)
+        rstd = jax.lax.rsqrt(var + eps)
+        xhat = (z - mean) * rstd
+        a = dy * gamma_ref[...].astype(jnp.float32)
+        dz = rstd * (a - jnp.mean(a, axis=1, keepdims=True)
+                     - xhat * jnp.mean(a * xhat, axis=1, keepdims=True))
+    else:
+        dz = dy
+    dz = dz + dz_extra_ref[...].astype(jnp.float32)
+    dres_ref[...] = dz.astype(dres_ref.dtype)
+    if p > 0.0:
+        bits = _fbdrln_rng_bits(rng_ref, dz.shape, has_rng)
+        dx = _dropout_keep(bits, dz, p, scale)
+    else:
+        dx = dz
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _fbdrln_block_n(n, hdim):
+    """Largest power-of-two row block dividing n whose f32 footprint stays
+    ~2 MB per array — the kernels hold ~6 such blocks, comfortably inside
+    the ~16 MB/core VMEM even at hdim=16384."""
+    cap = max(1, (2 << 20) // (4 * hdim))
+    for bn in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if bn <= cap and n % bn == 0:
+            return bn
+    return 1
+
+
+def _fbdrln_call(kernel, n_out, rng, arrs, out_dtypes, *, p, scale, eps,
+                 has_rng, with_ln, interpret):
+    n, hdim = arrs[0].shape
+    bn = _fbdrln_block_n(n, hdim)
+    row_spec = pl.BlockSpec((bn, hdim), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, hdim), lambda i: (0, 0))
+    if has_rng:
+        rng_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    else:
+        rng_spec = row_spec  # precomputed mask bits, blocked like the rows
+    in_specs = [rng_spec] + [row_spec if a.shape == (n, hdim) else vec_spec
+                             for a in arrs]
+    kern = functools.partial(kernel, p=p, scale=scale, eps=eps,
+                             has_rng=has_rng, with_ln=with_ln)
+    return pl.pallas_call(
+        kern,
+        grid=(n // bn,),
+        in_specs=in_specs,
+        out_specs=[row_spec] * n_out,
+        out_shape=[jax.ShapeDtypeStruct((n, hdim), dt) for dt in out_dtypes],
+        interpret=interpret,
+    )(rng, *arrs)
+
+
+def _fbdrln_make_rng(key, x2d, p, has_rng):
+    """TPU: int32 seed scalar (drives the in-kernel hardware PRNG —
+    the mask never touches HBM). CPU/interpret: threefry bits of the row
+    shape (identical keep/scale logic, exercised by tests)."""
+    if p <= 0.0:
+        return (jnp.zeros((1,), jnp.int32) if has_rng
+                else jnp.zeros(x2d.shape, jnp.uint32))
+    if has_rng:
+        return jax.random.bits(key, (1,), jnp.uint32).astype(jnp.int32)
+    return jax.random.bits(key, x2d.shape, jnp.uint32)
+
+
+def _fbdrln_vjp_fwd(x2d, res2d, bias, gamma, beta, key, p, scale, eps,
+                    has_rng, interpret):
+    rng = _fbdrln_make_rng(key, x2d, p, has_rng)
+    with_ln = gamma is not None
+    g2 = gamma if with_ln else jnp.ones((1, 1), x2d.dtype)
+    b2 = beta if with_ln else jnp.zeros((1, 1), x2d.dtype)
+    y, z = _fbdrln_call(
+        _fbdrln_fwd_kernel, 2, rng, [x2d, res2d, bias, g2, b2],
+        [x2d.dtype, x2d.dtype], p=p, scale=scale, eps=eps, has_rng=has_rng,
+        with_ln=with_ln, interpret=interpret)
+    return (y, z), (z, gamma, rng, key)
+
+
+def _fbdrln_vjp_bwd(p, scale, eps, has_rng, interpret, resids, gs):
+    z, gamma, rng, key = resids
+    dy, dz_extra = gs
+    with_ln = gamma is not None
+    g2 = gamma if with_ln else jnp.ones((1, 1), z.dtype)
+    dx, dres = _fbdrln_call(
+        _fbdrln_bwd_kernel, 2, rng, [z, dy, dz_extra, g2],
+        [z.dtype, z.dtype], p=p, scale=scale, eps=eps, has_rng=has_rng,
+        with_ln=with_ln, interpret=interpret)
+    dbias = jnp.sum(dx, axis=0, keepdims=True).astype(z.dtype)
+    if with_ln:
+        # LN scale/shift grads: cheap XLA column reductions off saved z
+        zf = z.astype(jnp.float32)
+        mean = jnp.mean(zf, axis=1, keepdims=True)
+        var = jnp.mean((zf - mean) ** 2, axis=1, keepdims=True)
+        xhat = (zf - mean) * jax.lax.rsqrt(var + eps)
+        dyf = dy.astype(jnp.float32)
+        dgamma = jnp.sum(dyf * xhat, axis=0, keepdims=True).astype(z.dtype)
+        dbeta = jnp.sum(dyf, axis=0, keepdims=True).astype(z.dtype)
+    else:
+        dgamma = dbeta = None
+    from jax.dtypes import float0
+    dkey = np.zeros(jnp.shape(key), float0)
+    return dx, dres, dbias, dgamma, dbeta, dkey
+
+
+# Both y and z grads flow in practice (z feeds the next residual chain), so
+# the public entry exposes the (y, z) pair under one custom_vjp.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _fbdrln_pair(x2d, res2d, bias, gamma, beta, key, p, scale, eps,
+                 has_rng, interpret):
+    (y, z), _ = _fbdrln_vjp_fwd(x2d, res2d, bias, gamma, beta, key, p,
+                                scale, eps, has_rng, interpret)
+    return y, z
+
+
+_fbdrln_pair.defvjp(_fbdrln_vjp_fwd, _fbdrln_vjp_bwd)
+
+
+def fused_bias_dropout_residual_ln_arrays(x, residual, bias, gamma, beta,
+                                          key, p, eps, training, mode):
+    """Array-level entry: x/residual [..., H] → (y, z) with
+    z = residual + dropout(x + bias), y = LN(z) (or z when gamma is None).
+
+    Dropout semantics mirror paddle's modes (reference
+    python/paddle/fluid/layers/nn.py dropout): upscale_in_train scales kept
+    values by 1/(1-p) at train time; downscale_in_infer keeps them unscaled
+    at train and scales by (1-p) at eval."""
+    shape = x.shape
+    hdim = shape[-1]
+    n = 1
+    for s in shape[:-1]:
+        n *= s
+    x2d = x.reshape(n, hdim)
+    res2d = residual.reshape(n, hdim)
+    b2 = (bias.reshape(1, hdim) if bias is not None
+          else jnp.zeros((1, hdim), x.dtype))
+    g2 = gamma.reshape(1, hdim) if gamma is not None else None
+    be2 = beta.reshape(1, hdim) if beta is not None else jnp.zeros(
+        (1, hdim), x.dtype) if gamma is not None else None
+    if not training:
+        p_eff = 0.0
+        scale = 1.0
+        if mode == "downscale_in_infer":
+            x2d = x2d * (1.0 - p)
+            b2 = b2 * (1.0 - p)
+    else:
+        p_eff = float(p)
+        if mode == "upscale_in_train":
+            # p>=1 drops everything: threshold clamps to max and scale 0
+            # keeps the arithmetic finite (matches the unfused dropout)
+            scale = 1.0 / (1.0 - p) if p < 1.0 else 0.0
+        else:
+            scale = 1.0
+    has_rng = jax.default_backend() == "tpu"
+    interpret = jax.default_backend() != "tpu"
+    y, z = _fbdrln_pair(x2d, res2d, b2, g2, be2, key, p_eff, scale,
+                        float(eps), has_rng, interpret)
+    return y.reshape(shape), z.reshape(shape)
+
+
+def fused_ln_shapes_ok(x):
+    from ..framework.flags import flag
+    if not flag("use_fused_dropout_ln"):
+        return False
+    hdim = x.shape[-1]
+    if jax.default_backend() != "tpu":
+        n = 1
+        for s in x.shape[:-1]:
+            n *= s
+        return n * hdim <= 64 * 1024  # keep interpret mode cheap
+    return hdim % 128 == 0 and hdim <= 16384
+
+
+# ---------------------------------------------------------------------------
+# Fused AdamW update
+#
+# TPU-native counterpart of the reference's fused optimizer kernels
+# (/root/reference/paddle/fluid/operators/optimizers/adam_op.cu AdamKernelMEM
+# and operators/fused/ fused patterns): one Pallas program updates param +
+# both moments in a single HBM pass with f32 master arithmetic, in-place via
+# input_output_aliases (param/moment buffers are donated, never copied).
+# ---------------------------------------------------------------------------
+
+
+def _adamw_kernel(lr_ref, t_ref, p_ref, g_ref, m1_ref, m2_ref,
+                  po_ref, m1o_ref, m2o_ref, *, b1, b2, eps, coeff):
+    lr = lr_ref[0].astype(jnp.float32)
+    tf = t_ref[0].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    if coeff:
+        p = p * (1.0 - lr * coeff)  # decoupled decay (AdamW)
+    m1 = b1 * m1_ref[...] + (1.0 - b1) * g
+    m2 = b2 * m2_ref[...] + (1.0 - b2) * g * g
+    c1 = 1.0 - jnp.power(jnp.float32(b1), tf)
+    c2 = 1.0 - jnp.power(jnp.float32(b2), tf)
+    step = lr * (m1 / c1) / (jnp.sqrt(m2 / c2) + eps)
+    po_ref[...] = (p - step).astype(po_ref.dtype)
+    m1o_ref[...] = m1
+    m2o_ref[...] = m2
+
+
+def _adamw_rows_ok(numel):
+    return numel % _LANES == 0
+
+
+def fused_adamw_or_none(param, grad, lr, t, m1, m2, *, beta1, beta2,
+                        epsilon, coeff, interpret=False):
+    """Pallas fused Adam/AdamW step, or None for the jnp fallback.
+
+    Used on TPU for lane-aligned params outside a GSPMD mesh step (inside a
+    sharded step XLA owns layout/collectives; its fused elementwise update
+    is already optimal there). `interpret=True` is the CPU test path."""
+    if not _HAS_PALLAS or pltpu is None:
+        return None
+    from ..framework import state
+    from ..framework.flags import flag
+    if not flag("use_fused_optimizer") or state.current_mesh() is not None:
+        return None
+    if jax.default_backend() != "tpu" and not interpret:
+        return None
+    numel = 1
+    for s in param.shape:
+        numel *= s
+    if numel < _LANES or not _adamw_rows_ok(numel):
+        return None
+
+    rows = numel // _LANES
+    bn = _fbdrln_block_n(rows, _LANES)
+    shape2d = (rows, _LANES)
+    row_spec = pl.BlockSpec((bn, _LANES), lambda i: (i, 0))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    kern = functools.partial(_adamw_kernel, b1=beta1, b2=beta2,
+                             eps=epsilon, coeff=coeff)
+    po, m1o, m2o = pl.pallas_call(
+        kern,
+        grid=(rows // bn,),
+        in_specs=[smem, smem, row_spec, row_spec, row_spec, row_spec],
+        out_specs=[row_spec] * 3,
+        out_shape=[
+            jax.ShapeDtypeStruct(shape2d, param.dtype),
+            jax.ShapeDtypeStruct(shape2d, jnp.float32),
+            jax.ShapeDtypeStruct(shape2d, jnp.float32),
+        ],
+        input_output_aliases={2: 0, 4: 1, 5: 2},
+        interpret=interpret,
+    )(jnp.reshape(lr, (1,)).astype(jnp.float32),
+      jnp.reshape(t, (1,)).astype(jnp.int32),
+      param.reshape(shape2d), grad.astype(jnp.float32).reshape(shape2d),
+      m1.reshape(shape2d), m2.reshape(shape2d))
+    return (po.reshape(param.shape), m1o.reshape(param.shape),
+            m2o.reshape(param.shape))
+
+
 def flash_attention_or_none(query, key, value, attn_mask, is_causal):
     """Tensor-level gate: return flash-attention output, or None to signal
     the caller to take the plain XLA sdpa path."""
@@ -170,7 +691,7 @@ def flash_attention_or_none(query, key, value, attn_mask, is_causal):
         return None
     backend = jax.default_backend()
     interpret = backend != "tpu"
-    if not _shapes_ok(q, k, interpret):
+    if not _shapes_ok(q, k, bool(is_causal), interpret):
         return None
     return _flash_op(query, key, value, causal=bool(is_causal),
                      interpret=interpret)

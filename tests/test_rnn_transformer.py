@@ -144,8 +144,8 @@ class TestAttention:
         k = np.random.RandomState(1).randn(1, 2, 32, 16).astype(np.float32)
         v = np.random.RandomState(2).randn(1, 2, 32, 16).astype(np.float32)
         ref = pk._xla_attention(q, k, v, causal=True)
-        out = pk._flash_fwd(q, k, v, causal=True, block_q=16, block_k=16,
-                            interpret=True)
+        out, _ = pk._flash_fwd(q, k, v, causal=True, block_q=16,
+                               block_k=16, interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-3, rtol=2e-3)
 
@@ -159,17 +159,21 @@ class TestAttention:
         k = r.randn(1, 1, 48, 8).astype(np.float32)
         v = r.randn(1, 1, 48, 8).astype(np.float32)
         ref = pk._xla_attention(q, k, v, causal=True)
-        out = pk._flash_fwd(q, k, v, causal=True, block_q=8, block_k=16,
-                            interpret=True)
+        out, _ = pk._flash_fwd(q, k, v, causal=True, block_q=8,
+                               block_k=16, interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-3, rtol=2e-3)
 
     def test_shapes_gate_rejects_misaligned(self):
         from paddle_tpu.ops import pallas_kernels as pk
         q = np.zeros((1, 1, 136, 64), np.float32)
-        assert not pk._shapes_ok(q, q, interpret=False)
+        assert not pk._shapes_ok(q, q, causal=False, interpret=False)
         q2 = np.zeros((1, 1, 256, 64), np.float32)
-        assert pk._shapes_ok(q2, q2, interpret=False)
+        assert pk._shapes_ok(q2, q2, causal=False, interpret=False)
+        # causal with Tk < Tq would fully mask leading rows -> XLA path
+        qs = np.zeros((1, 1, 256, 64), np.float32)
+        ks = np.zeros((1, 1, 128, 64), np.float32)
+        assert not pk._shapes_ok(qs, ks, causal=True, interpret=False)
 
     def test_sdpa_causal(self):
         paddle.seed(0)
