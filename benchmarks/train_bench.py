@@ -66,14 +66,14 @@ def bench_gpt2(on_tpu):
                        num_heads=4, intermediate_size=128,
                        max_position_embeddings=T + 1)
     paddle.seed(0)
-    cfg = net.config if hasattr(net, "config") else {}
     crit = GPTPretrainingCriterion()
     opt = paddle.optimizer.AdamW(parameters=net.parameters(),
                                  learning_rate=1e-4, weight_decay=0.01)
     step = make_train_step(net, lambda o, l: crit(o, l), opt)
 
-    vocab = net.embeddings.word_embeddings.weight.shape[0] \
-        if hasattr(net, "embeddings") else 1024
+    # gpt2_small()/gpt_tiny() return GPTForPretraining wrapping .gpt
+    core = getattr(net, "gpt", net)
+    vocab = core.embeddings.word_embeddings.weight.shape[0]
 
     class TokenStream(Dataset):
         def __len__(self):
@@ -107,9 +107,8 @@ def bench_gpt2(on_tpu):
     n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
     # standard transformer train FLOPs: 6·N per token (fwd 2N + bwd 4N)
     # + attention 12·L·T·d per token (QKᵀ and PV, fwd+bwd)
-    L = getattr(net, "num_layers", None) or len(getattr(
-        net, "decoder_layers", [])) or 12
-    dmodel = getattr(net, "hidden_size", None) or 768
+    L = len(core.layers)
+    dmodel = core.hidden_size
     tokens = B * T
     flops = 6 * n_params * tokens + 12 * L * dmodel * T * tokens
     return {"config": "gpt2_small_train" if on_tpu else "gpt_tiny_train",

@@ -28,7 +28,8 @@ from .strategy import DistributedStrategy
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        get_hybrid_communicate_group, _set_hcg)
 from .dygraph_optimizer import (HybridParallelOptimizer,
-                                DygraphShardingOptimizer)
+                                DygraphShardingOptimizer,
+                                LocalSGDOptimizer)
 from . import meta_parallel
 from .meta_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,
                             TensorParallel, ShardingParallel,
@@ -42,7 +43,7 @@ __all__ = [
     "get_hybrid_communicate_group", "worker_num", "worker_index",
     "is_first_worker", "worker_endpoints", "barrier_worker", "recompute",
     "meta_parallel", "HybridParallelOptimizer", "DygraphShardingOptimizer",
-    "QueueDataset", "InMemoryDataset",
+    "LocalSGDOptimizer", "QueueDataset", "InMemoryDataset",
 ]
 
 
@@ -154,17 +155,60 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """reference: fleet_base.py:830."""
+    """reference: fleet_base.py:830 + the meta-optimizer pass
+    (fleet/meta_optimizers/: lars_optimizer.py, localsgd_optimizer.py) —
+    strategy switches rewrite/wrap the user optimizer here."""
     if strategy is not None:
         _state.strategy = strategy
     _require_init()
     hcg = _state.hcg
+    strat = _state.strategy
+
+    import paddle_tpu.optimizer as opt_mod
+    if strat.lars:
+        # reference swaps Momentum -> LarsMomentum (lars_optimizer.py:_can_apply)
+        if not isinstance(optimizer, opt_mod.Momentum):
+            raise TypeError(
+                "strategy.lars applies to Momentum optimizers "
+                f"(got {type(optimizer).__name__})")
+        cfg = strat.lars_configs
+        optimizer = opt_mod.Lars(
+            learning_rate=optimizer._lr,
+            momentum=optimizer._momentum,
+            lars_coeff=cfg["lars_coeff"],
+            lars_weight_decay=cfg["lars_weight_decay"],
+            epsilon=cfg["epsilon"],
+            exclude_from_weight_decay=cfg["exclude_from_weight_decay"],
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip)
+    if strat.lamb:
+        if not isinstance(optimizer, opt_mod.Adam):
+            raise TypeError(
+                "strategy.lamb applies to Adam optimizers "
+                f"(got {type(optimizer).__name__})")
+        cfg = strat.lamb_configs
+        exclude = tuple(cfg.get("exclude_from_weight_decay") or ())
+        optimizer = opt_mod.Lamb(
+            learning_rate=optimizer._lr,
+            lamb_weight_decay=cfg["lamb_weight_decay"],
+            beta1=optimizer._beta1, beta2=optimizer._beta2,
+            epsilon=optimizer._epsilon,
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip,
+            exclude_from_weight_decay_fn=(
+                (lambda p: any(tag in (getattr(p, "name", "") or "")
+                               for tag in exclude))
+                if exclude else None))
+
     if hcg.get_sharding_parallel_world_size() > 1:
-        return HybridParallelOptimizer(
-            DygraphShardingOptimizer(optimizer=optimizer, hcg=hcg),
-            hcg=hcg, strategy=_state.strategy)
-    return HybridParallelOptimizer(optimizer, hcg=hcg,
-                                   strategy=_state.strategy)
+        optimizer = DygraphShardingOptimizer(optimizer=optimizer, hcg=hcg)
+    wrapped = HybridParallelOptimizer(optimizer, hcg=hcg, strategy=strat)
+    if strat.localsgd:
+        cfg = strat.localsgd_configs
+        wrapped = LocalSGDOptimizer(wrapped, hcg=hcg,
+                                    k_steps=cfg["k_steps"],
+                                    begin_step=cfg["begin_step"])
+    return wrapped
 
 
 def worker_num():

@@ -55,6 +55,76 @@ class HybridParallelOptimizer:
         return self._inner_opt
 
 
+class LocalSGDOptimizer:
+    """LocalSGD — replicas take k local optimizer steps, then parameters are
+    averaged across the data-parallel group.
+
+    reference: fleet/meta_optimizers/localsgd_optimizer.py (enabled by
+    `strategy.localsgd`, configs {k_steps, begin_step}). On the
+    single-controller GSPMD path sync is a documented no-op (grads are
+    already globally averaged inside the compiled step, so replicas cannot
+    diverge); under the multi-process launcher each process steps locally
+    and the periodic cross-process parameter mean
+    (multihost_utils.process_allgather) is the only cross-replica traffic —
+    the communication-saving regime LocalSGD exists for. Pure-dp
+    multi-process topologies only."""
+
+    def __init__(self, optimizer, hcg=None, k_steps=1, begin_step=1):
+        self._inner_opt = optimizer
+        self._hcg = hcg or _topo.get_hybrid_communicate_group()
+        self._k_steps = max(1, int(k_steps))
+        self._begin_step = max(1, int(begin_step))
+        self._local_step = 0
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        out = self._inner_opt.step()
+        self._after_step()
+        return out
+
+    def _after_step(self):
+        self._local_step += 1
+        if (self._local_step >= self._begin_step
+                and (self._local_step - self._begin_step)
+                % self._k_steps == 0):
+            self._sync_params()
+
+    def _sync_params(self):
+        import jax
+
+        if jax.process_count() <= 1:
+            # single-controller GSPMD: the compiled step already averages
+            # grads globally each step, so replicas cannot diverge and
+            # there is nothing to synchronize
+            return
+        world = jax.process_count()
+        dp = (self._hcg.get_data_parallel_world_size()
+              if self._hcg is not None else world)
+        if dp != world:
+            raise NotImplementedError(
+                "localsgd requires the dp group to span all processes; "
+                "hybrid mp/pp multi-process topologies are not supported")
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        for p in self._inner_opt._parameter_list:
+            gathered = multihost_utils.process_allgather(
+                np.asarray(p._data))
+            p._data = jnp.asarray(np.mean(gathered, axis=0,
+                                          dtype=np.float32).astype(
+                np.asarray(p._data).dtype))
+
+    def clear_grad(self, set_to_zero=True):
+        return self._inner_opt.clear_grad(set_to_zero=set_to_zero)
+
+    def minimize(self, *a, **kw):
+        out = self._inner_opt.minimize(*a, **kw)
+        self._after_step()  # minimize performs a step too
+        return out
+
+
 class DygraphShardingOptimizer:
     """reference: dygraph_sharding_optimizer.py — ZeRO stage 1."""
 

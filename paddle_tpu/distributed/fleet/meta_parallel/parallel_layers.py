@@ -23,6 +23,16 @@ class _MeshWrapper(Layer):
         if self._hcg is not None:
             layers._pt_mesh = self._hcg.global_mesh
             self._pt_mesh = self._hcg.global_mesh
+        if strategy is not None:
+            # ZeRO stage (1: state only, 2: +grads, 3: +params) and host
+            # offload of optimizer state — read by jit/engine.make_train_step
+            # (reference: fleet/meta_optimizers/sharding_optimizer.py:89-114,
+            # sharding/offload_helper.py)
+            cfg = strategy.sharding_configs
+            layers._pt_sharding_stage = int(cfg.get("stage", 1))
+            layers._pt_offload = bool(cfg.get("optimize_offload", False))
+            self._pt_sharding_stage = layers._pt_sharding_stage
+            self._pt_offload = layers._pt_offload
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

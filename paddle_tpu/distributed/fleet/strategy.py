@@ -90,6 +90,24 @@ _CONFIG_DEFAULTS = {
 }
 
 
+# Switches whose feature is deliberately NOT implemented in the TPU build
+# (formal cuts — README "Scope cuts"). Setting them True raises instead of
+# silently training without the feature (the reference's meta-optimizer
+# `_can_apply` would at least have logged a fallback).
+_UNIMPLEMENTED = {
+    "dgc": "DGC sparsified allreduce targets slow Ethernet; dense psum over "
+           "ICI is faster (README: Scope cuts)",
+    "adaptive_localsgd": "use strategy.localsgd with explicit "
+                         "localsgd_configs instead",
+    "fp16_allreduce": "XLA already reduces in bf16 where safe under AMP "
+                      "(README: Scope cuts)",
+    "a_sync": "parameter-server family is out of scope; shard embeddings "
+              "over the mesh instead (README: Scope cuts)",
+    "heter_ccl_mode": "GPU+CPU heterogeneous rings have no TPU meaning "
+                      "(README: Scope cuts)",
+}
+
+
 class DistributedStrategy:
     def __init__(self):
         self.__dict__["_values"] = copy.deepcopy(_DEFAULTS)
@@ -104,6 +122,10 @@ class DistributedStrategy:
 
     def __setattr__(self, name, value):
         if name in self._values:
+            if value and name in _UNIMPLEMENTED:
+                raise NotImplementedError(
+                    f"DistributedStrategy.{name} is not implemented in the "
+                    f"TPU build: {_UNIMPLEMENTED[name]}")
             self._values[name] = value
         elif name in self._configs:
             if not isinstance(value, dict):

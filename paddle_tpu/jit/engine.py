@@ -30,19 +30,27 @@ from ..framework.random import RNG
 from ..framework.tensor import Tensor
 
 
-def _param_spec(p, mesh):
+def _param_spec(p, mesh, zero3=False):
     """PartitionSpec for a parameter: its layer-declared sharding_spec
     (TP layers in distributed/fleet/meta_parallel/mp_layers.py) when every
-    named axis exists in the mesh, else replicated."""
+    named axis exists in the mesh, else replicated — unless ZeRO-3, where
+    replicated params are instead sharded over the "sharding" axis on dim 0
+    (XLA all-gathers them at use sites; weights live partitioned in HBM.
+    reference: sharding_optimizer.py stage-3 parameter partitioning)."""
     from jax.sharding import PartitionSpec as P
     spec = getattr(p, "sharding_spec", None)
-    if spec is None:
-        return P()
-    names = [n for el in spec if el is not None
-             for n in (el if isinstance(el, tuple) else (el,))]
-    if not all(n in mesh.shape for n in names):
-        return P()
-    return spec
+    if spec is not None:
+        names = [n for el in spec if el is not None
+                 for n in (el if isinstance(el, tuple) else (el,))]
+        if all(n in mesh.shape for n in names):
+            return spec
+        spec = None
+    if zero3:
+        deg = mesh.shape.get("sharding", 1)
+        shape = p._data.shape
+        if deg > 1 and len(shape) >= 1 and shape[0] % deg == 0:
+            return P("sharding", *([None] * (len(shape) - 1)))
+    return P()
 
 
 def _acc_spec(p, pspec, mesh):
@@ -106,9 +114,27 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
     imperative/reducer.h:130 and mp_layers' hand-inserted c_* ops)."""
     if mesh is None:
         mesh = getattr(network, "_pt_mesh", None)
+    # ZeRO stage over the "sharding" axis: 1 = optimizer state only,
+    # 2 = +gradients (reduce-scatter instead of all-reduce),
+    # 3 = +parameters (gather-on-use). reference:
+    # fleet/meta_optimizers/sharding_optimizer.py:89-114,815
+    stage = int(getattr(network, "_pt_sharding_stage", 1) or 1)
+    offload = bool(getattr(network, "_pt_offload", False))
+    if mesh is None or mesh.shape.get("sharding", 1) <= 1:
+        stage = 1
+        offload = False
     params, frozen, buffers, accs = _collect_train_state(network, optimizer)
     acc_names = optimizer._accumulator_names
     mutable = params + frozen + buffers  # tensors whose _data we swap
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        _pspecs = [_param_spec(p, mesh, zero3=stage >= 3) for p in params]
+        _acc_specs = [_acc_spec(p, s, mesh)
+                      for p, s in zip(params, _pspecs)]
+        _grad_sh = [NamedSharding(mesh, s) for s in _acc_specs]
+    else:
+        _grad_sh = None
 
     def step_fn(param_arrs, frozen_arrs, buf_arrs, acc_arrs, key, t, lr,
                 in_arrs, lab_arrs):
@@ -144,6 +170,13 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
             RNG.key = saved_key
         out_arrs, new_bufs, new_key = aux
 
+        if stage >= 2 and _grad_sh is not None:
+            # ZeRO-2: pin each grad to the sharding axis — GSPMD lowers the
+            # dp/sharding reduction to reduce-scatter and keeps grads (and
+            # everything downstream: clip, update) partitioned
+            grads = [jax.lax.with_sharding_constraint(g, sh)
+                     for g, sh in zip(grads, _grad_sh)]
+
         # regularization + clip on traced grads (mirrors Optimizer.step)
         gs = []
         for p, arr, g in zip(params, param_arrs, grads):
@@ -157,24 +190,28 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
             gs = [g for _, g in optimizer._grad_clip(pairs)]
 
         new_params, new_accs = [], []
-        for p, arr, g, acc in zip(params, param_arrs, gs, acc_arrs):
-            sargs = optimizer._per_param_static_args(p)
-            rule = optimizer._rule_cls(p)._update_rule
-            plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
-            out = rule(sargs, arr, g, plr, t, *acc)
-            new_params.append(out[0])
-            new_accs.append(list(out[1:]))
+        # mesh_guard so mesh-aware gates (e.g. fused_adamw_or_none, which
+        # must NOT embed an opaque pallas_call in a GSPMD-sharded step) see
+        # the mesh at trace time — the update loop traces outside
+        # run_forward's guard
+        with state.mesh_guard(mesh):
+            for p, arr, g, acc in zip(params, param_arrs, gs, acc_arrs):
+                sargs = optimizer._per_param_static_args(p)
+                rule = optimizer._rule_cls(p)._update_rule
+                plr = lr * getattr(p, "optimize_attr",
+                                   {}).get("learning_rate", 1.0)
+                out = rule(sargs, arr, g, plr, t, *acc)
+                new_params.append(out[0])
+                new_accs.append(list(out[1:]))
         return loss, out_arrs, new_bufs, new_key, new_params, new_accs
 
     jitted = jax.jit(step_fn, donate_argnums=(0, 3))
 
     if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        _pspecs = [_param_spec(p, mesh) for p in params]
         _param_sh = [NamedSharding(mesh, s) for s in _pspecs]
         _repl_sh = NamedSharding(mesh, P())
-        _acc_sh = [NamedSharding(mesh, _acc_spec(p, s, mesh))
-                   for p, s in zip(params, _pspecs)]
+        _acc_sh = _grad_sh
+        _host = jax.devices("cpu")[0] if offload else None
 
     def _place_state():
         """Commit train state onto the mesh (idempotent)."""
@@ -213,7 +250,12 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
             b._data = a
         for acc, new in zip(accs, new_accs):
             for n, a in zip(acc_names, new):
-                acc[n] = a
+                # optimizer-state host offload: state lives in host RAM
+                # between steps, staged back in by _place_state (reference:
+                # sharding/offload_helper.py). Costs a D2H+H2D per step in
+                # exchange for freeing the state's HBM footprint.
+                acc[n] = jax.device_put(a, _host) if (
+                    mesh is not None and _host is not None) else a
         RNG.key = new_key
         return (Tensor(loss, _internal=True),
                 [Tensor(o, _internal=True) for o in out_arrs])

@@ -149,12 +149,15 @@ def chunk(x, chunks, axis=0, name=None):
 
 @primitive("slice_op")
 def _slice(x, *, axes, starts, ends):
-    idx = [slice(None)] * x.ndim
+    # builtins.slice: the paddle-parity `slice` API below shadows the
+    # builtin in this module's globals at call time
+    import builtins
+    idx = [builtins.slice(None)] * x.ndim
     for a, s, e in zip(axes, starts, ends):
         dim = x.shape[a]
         s2 = max(s + dim, 0) if s < 0 else min(s, dim)
         e2 = max(e + dim, 0) if e < 0 else min(e, dim)
-        idx[a] = slice(s2, e2)
+        idx[a] = builtins.slice(s2, e2)
     return x[tuple(idx)]
 
 
@@ -165,9 +168,10 @@ def slice(x, axes, starts, ends):  # noqa: A001
 
 @primitive("strided_slice_op")
 def _strided_slice(x, *, axes, starts, ends, strides):
-    idx = [slice(None)] * x.ndim
+    import builtins
+    idx = [builtins.slice(None)] * x.ndim
     for a, s, e, st in zip(axes, starts, ends, strides):
-        idx[a] = slice(s, e, st)
+        idx[a] = builtins.slice(s, e, st)
     return x[tuple(idx)]
 
 

@@ -301,6 +301,51 @@ class Momentum(Optimizer):
         return new_p, v
 
 
+class Lars(Optimizer):
+    """LARS — layer-wise adaptive rate scaling over momentum.
+
+    reference: fluid LarsMomentumOptimizer
+    (paddle/fluid/operators/optimizers/lars_momentum_op.cc; enabled by the
+    fleet meta switch `strategy.lars`,
+    fleet/meta_optimizers/lars_optimizer.py). local_lr scales the step by
+    ||w|| / (||g|| + wd·||w|| + eps) per layer so large-batch SGD keeps
+    per-layer update magnitudes balanced."""
+
+    _accumulator_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=0.0, parameters=None,
+                 exclude_from_weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = float(momentum)
+        self._coeff = float(lars_coeff)
+        self._wd = float(lars_weight_decay)
+        self._eps = float(epsilon)
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _per_param_static_args(self, p):
+        wd = self._wd
+        name = getattr(p, "name", "") or ""
+        if any(tag in name for tag in self._exclude):
+            wd = 0.0
+        return (self._momentum, self._coeff, wd, self._eps)
+
+    def _static_args(self):
+        return (self._momentum, self._coeff, self._wd, self._eps)
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, velocity):
+        mu, coeff, wd, eps = static_args
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        ratio = coeff * w_norm / (g_norm + wd * w_norm + eps + 1e-12)
+        local_lr = lr * jnp.where((w_norm > 0) & (g_norm > 0), ratio, 1.0)
+        v = mu * velocity + local_lr * (g + wd * p32)
+        return (p32 - v).astype(param.dtype), v
+
+
 class Adam(Optimizer):
     _accumulator_names = ["moment1", "moment2"]
 

@@ -1,0 +1,130 @@
+"""Meta-optimizer switches: LARS, LAMB, LocalSGD, and strategy honesty
+(reference: fleet/meta_optimizers/lars_optimizer.py,
+localsgd_optimizer.py; fleet_base.py:830 distributed_optimizer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def _reset_fleet():
+    dist.fleet._state.initialized = False
+    from paddle_tpu.distributed import collective
+    collective.destroy_process_group()
+
+
+class TestLars:
+    def test_update_math(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 3)
+        opt = paddle.optimizer.Lars(learning_rate=0.1, momentum=0.9,
+                                    lars_coeff=0.001,
+                                    lars_weight_decay=0.0005,
+                                    parameters=lin.parameters())
+        w0 = lin.weight.numpy().copy()
+        x = paddle.randn([8, 4])
+        loss = lin(x).sum()
+        loss.backward()
+        g = lin.weight.grad.numpy().copy()
+        opt.step()
+        w1 = lin.weight.numpy()
+        # replicate LARS: local_lr = lr*coeff*||w||/(||g||+wd*||w||+eps)
+        w_n = np.linalg.norm(w0)
+        g_n = np.linalg.norm(g)
+        local_lr = 0.1 * 0.001 * w_n / (g_n + 0.0005 * w_n + 1e-12)
+        v = local_lr * (g + 0.0005 * w0)
+        np.testing.assert_allclose(w1, w0 - v, rtol=1e-4, atol=1e-6)
+
+    def test_lr_scaling_balances_layers(self):
+        """Layers with very different weight scales get comparable relative
+        updates — the property LARS exists for."""
+        paddle.seed(1)
+        big = paddle.nn.Linear(4, 4)
+        small = paddle.nn.Linear(4, 4)
+        big.weight.set_value(big.weight.numpy() * 100.0)
+        opt = paddle.optimizer.Lars(learning_rate=0.1,
+                                    parameters=[big.weight, small.weight])
+        x = paddle.randn([8, 4])
+        (big(x).sum() + small(x).sum()).backward()
+        b0, s0 = big.weight.numpy().copy(), small.weight.numpy().copy()
+        opt.step()
+        rel_big = np.linalg.norm(big.weight.numpy() - b0) / np.linalg.norm(b0)
+        rel_small = (np.linalg.norm(small.weight.numpy() - s0)
+                     / np.linalg.norm(s0))
+        assert 0.1 < rel_big / rel_small < 10.0
+
+
+class TestFleetMetaOptimizers:
+    def test_lars_switch_swaps_momentum(self):
+        _reset_fleet()
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.lars = True
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        lin = paddle.nn.Linear(4, 3)
+        mom = paddle.optimizer.Momentum(learning_rate=0.1,
+                                        parameters=lin.parameters())
+        wrapped = dist.fleet.distributed_optimizer(mom)
+        assert isinstance(wrapped.inner_opt, paddle.optimizer.Lars)
+
+    def test_lars_switch_rejects_adam(self):
+        _reset_fleet()
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.lars = True
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        lin = paddle.nn.Linear(4, 3)
+        adam = paddle.optimizer.Adam(parameters=lin.parameters())
+        with pytest.raises(TypeError):
+            dist.fleet.distributed_optimizer(adam)
+
+    def test_lamb_switch_swaps_adam(self):
+        _reset_fleet()
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.lamb = True
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        lin = paddle.nn.Linear(4, 3)
+        adam = paddle.optimizer.Adam(parameters=lin.parameters())
+        wrapped = dist.fleet.distributed_optimizer(adam)
+        assert isinstance(wrapped.inner_opt, paddle.optimizer.Lamb)
+
+    def test_localsgd_wrapper_steps(self):
+        _reset_fleet()
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.localsgd = True
+        strategy.localsgd_configs = {"k_steps": 2, "begin_step": 1}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 3)
+        opt = dist.fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters()))
+        from paddle_tpu.distributed.fleet import LocalSGDOptimizer
+        assert isinstance(opt, LocalSGDOptimizer)
+        x = paddle.randn([4, 4])
+        for _ in range(3):
+            lin(x).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        assert np.isfinite(lin.weight.numpy()).all()
+
+
+class TestStrategyHonesty:
+    @pytest.mark.parametrize("switch", ["dgc", "adaptive_localsgd",
+                                        "fp16_allreduce", "a_sync",
+                                        "heter_ccl_mode"])
+    def test_unimplemented_switches_raise(self, switch):
+        strategy = dist.fleet.DistributedStrategy()
+        with pytest.raises(NotImplementedError):
+            setattr(strategy, switch, True)
+
+    def test_setting_false_is_fine(self):
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.dgc = False
+        assert strategy.dgc is False
+
+    def test_implemented_switches_accepted(self):
+        strategy = dist.fleet.DistributedStrategy()
+        for s in ["localsgd", "lars", "lamb", "recompute", "sharding",
+                  "gradient_merge", "amp"]:
+            setattr(strategy, s, True)
+            assert getattr(strategy, s) is True

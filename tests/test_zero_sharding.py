@@ -1,0 +1,91 @@
+"""ZeRO stages 2/3 + optimizer-state offload over the "sharding" mesh axis
+(reference: fleet/meta_optimizers/sharding_optimizer.py:89-114,815 parameter
+partitioning, sharding/offload_helper.py). Runs on the 8-virtual-device CPU
+mesh: dp=2 x sharding=4."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def _fleet_init(stage, offload=False):
+    dist.fleet._state.initialized = False
+    from paddle_tpu.distributed import collective
+    collective.destroy_process_group()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 4}
+    strategy.sharding_configs = {"stage": stage,
+                                 "optimize_offload": offload}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+def _train(stage, steps=3, offload=False):
+    from paddle_tpu.jit.engine import make_train_step
+    _fleet_init(stage, offload)
+    paddle.seed(0)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 8))
+    model = dist.fleet.distributed_model(net)
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 learning_rate=1e-2, weight_decay=0.01)
+    step = make_train_step(model, lambda o, l: ((o - l) ** 2).mean(), opt)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+    y = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+    losses = [float(step([x], [y])[0].numpy()) for _ in range(steps)]
+    return losses, net, opt, model
+
+
+class TestZeroStages:
+    def test_stage_parity(self):
+        """Stages 1/2/3 express the SAME math with different shardings."""
+        l1, n1, _, _ = _train(1)
+        l2, n2, _, _ = _train(2)
+        l3, n3, _, _ = _train(3)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(l1, l3, rtol=1e-5, atol=1e-6)
+        for p1, p3 in zip(n1.parameters(), n3.parameters()):
+            np.testing.assert_allclose(p1.numpy(), p3.numpy(), rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_stage3_params_partitioned(self):
+        """ZeRO-3: parameters live sharded over the sharding axis — each
+        device holds 1/4 of dim 0; stage 1 keeps them replicated."""
+        _, net3, opt3, _ = _train(3)
+        for p in net3.parameters():
+            spec = p._data.sharding.spec
+            assert spec and spec[0] == "sharding", (p.name, spec)
+            shard0 = p._data.sharding.shard_shape(p._data.shape)[0]
+            assert shard0 == p._data.shape[0] // 4, (p.name, shard0)
+            for acc in opt3._get_accumulators(p).values():
+                aspec = acc.sharding.spec
+                assert aspec and aspec[0] == "sharding", (p.name, aspec)
+
+        # (stage 1 params are INPUT-replicated; XLA may still emit the
+        # updated params sharded since the state they derive from is — so
+        # no negative assertion on stage-1 output shardings here.)
+
+    def test_stage1_accumulators_partitioned(self):
+        """ZeRO-1 baseline: optimizer state sharded even though params are
+        replicated."""
+        _, net, opt, _ = _train(1)
+        for p in net.parameters():
+            for acc in opt._get_accumulators(p).values():
+                aspec = acc.sharding.spec
+                assert aspec and aspec[0] == "sharding", (p.name, aspec)
+
+    def test_offload_state_on_host(self):
+        """With optimize_offload the state lands on ONE host device between
+        steps (vs spread over the 4-way sharding axis)."""
+        _, net, opt, _ = _train(3, offload=True)
+        for p in net.parameters():
+            for acc in opt._get_accumulators(p).values():
+                assert len(acc.devices()) == 1, p.name
+
+    def test_offload_parity(self):
+        l3, _, _, _ = _train(3)
+        lo, _, _, _ = _train(3, offload=True)
+        np.testing.assert_allclose(l3, lo, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,82 @@
+"""Ops exempt from the auto-generated OpTest sweep (tests/test_op_auto.py),
+each with a reason. Modeled on the reference's white_list mechanism
+(reference: python/paddle/fluid/tests/unittests/white_list/
+op_threshold_white_list.py, check_shape_white_list.py) — an op may only
+skip the sweep by appearing here, so new primitives cannot silently dodge
+testing.
+
+Categories:
+  rng      — consumes a PRNG key input; randomness-semantics covered by
+             dedicated tests (test_tensor/test_nn/test_pallas_fused).
+  dynamic  — data-dependent output shape; cannot run under the traced path.
+  list     — takes a list-of-tensors argument the generic harness does not
+             wrap; covered by dedicated functional tests.
+  complex  — complex dtypes need split real/imag finite differences;
+             covered by test_api_breadth fft/complex tests.
+  factory  — no tensor inputs (pure factories).
+  ste      — straight-through estimator: analytic grad deliberately differs
+             from the numeric grad of the staircase forward.
+  dedicated— intricate input contract; has its own dedicated test file.
+"""
+
+WHITE_LIST = {
+    # rng
+    "alpha_dropout_op": "rng",
+    "bernoulli_op": "rng",
+    "dropout_op": "rng",
+    "exponential_op": "rng",
+    "gaussian_random": "rng",
+    "gumbel_softmax_op": "rng",
+    "multinomial_op": "rng",
+    "poisson_op": "rng",
+    "randint_op": "rng",
+    "randperm_op": "rng",
+    "uniform_random": "rng",
+    "scaled_dot_product_attention": "rng (dropout key); flash/sdpa parity in test_rnn_transformer + test_pallas_fused",
+    "fused_bias_dropout_residual_layer_norm": "rng; dedicated coverage in test_pallas_fused",
+    "rnn": "rng (dropout key) + list weights; parity in test_rnn_transformer",
+    # dynamic shapes
+    "masked_select": "dynamic",
+    "bincount_op": "dynamic (output length = max value); covered in test_tensor",
+    "nonzero": "dynamic",
+    "unique": "dynamic",
+    "unique_consecutive_op": "dynamic",
+    "roi_align": "dynamic (boxes_num); dedicated test in test_api_breadth",
+    "getitem_dyn": "dynamic (tensor indices); covered by tensor indexing tests",
+    # list-of-tensors inputs
+    "broadcast_tensors_op": "list",
+    "concat_op": "list; covered in test_tensor",
+    "einsum_op": "list; covered in test_api_breadth",
+    "meshgrid_op": "list",
+    "multi_dot_op": "list",
+    "multiplex": "list",
+    "stack_op": "list; covered in test_tensor",
+    # complex dtypes
+    "as_complex_op": "complex",
+    "as_real_op": "complex",
+    "complex_op": "complex",
+    "conj": "complex",
+    "angle": "complex",
+    "fft": "complex", "fft2": "complex", "fftn": "complex",
+    "ifft": "complex", "ifft2": "complex", "ifftn": "complex",
+    "rfft": "complex", "rfft2": "complex", "rfftn": "complex",
+    "irfft": "complex", "irfft2": "complex", "irfftn": "complex",
+    "hfft": "complex", "ihfft": "complex",
+    "fftshift": "complex", "ifftshift": "complex",
+    # factories (no tensor inputs)
+    "arange": "factory",
+    "eye_op": "factory",
+    "fill_constant": "factory",
+    "linspace": "factory",
+    "logspace": "factory",
+    # straight-through estimators
+    "fake_channel_wise_quantize_dequantize_abs_max": "ste",
+    "fake_quantize_dequantize_abs_max": "ste",
+    "fake_quantize_dequantize_fixed_scale": "ste",
+    # intricate contracts with dedicated tests
+    "warpctc": "dedicated: CTC parity vs torch in test_nn_extras",
+    "deform_conv2d": "dedicated: offset-layout test in test_api_breadth",
+    "flash_attention": "dedicated: test_pallas_fused grad parity",
+    "masked_sdpa": "dedicated: sparse_attention tests in test_api_breadth",
+    "batch_norm_train_stats": "dedicated: running-stats semantics in test_nn; y independent of run_mean/var inputs",
+}
