@@ -83,7 +83,10 @@ def bench_gpt2(on_tpu):
             rs = np.random.RandomState(i)
             return rs.randint(0, vocab, (T + 1,)).astype(np.int64)
 
-    loader = DataLoader(TokenStream(), batch_size=B, num_workers=1,
+    # thread prefetch path: forking workers AFTER TPU backend init is
+    # unsafe (libtpu threads); the mp loader has its own benchmark
+    # (benchmarks/dataloader_bench.py)
+    loader = DataLoader(TokenStream(), batch_size=B, num_workers=0,
                         shuffle=False)
     it = iter(loader)
 

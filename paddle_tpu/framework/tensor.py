@@ -203,6 +203,15 @@ class Tensor:
         return prefix + np.array2string(self.numpy(), prefix="       ") + ")"
 
     def __bool__(self):
+        if isinstance(self._data, jax.core.Tracer):
+            raise RuntimeError(
+                "bool() on a traced Tensor: python `if`/`while` on tensor "
+                "values cannot be staged into the compiled program. Use "
+                "paddle.static.nn.cond / paddle.static.nn.while_loop, or "
+                "let @paddle.jit.to_static auto-convert the branch (its "
+                "AST pass rewrites tensor if/while; unsupported shapes — "
+                "e.g. `return` inside the branch — fall back to this "
+                "error). reference: dygraph_to_static/convert_operators.py")
         return bool(self.numpy())
 
     def __int__(self):

@@ -232,25 +232,62 @@ class DistributedBatchSampler(BatchSampler):
         self.epoch = epoch
 
 
-def default_collate_fn(batch):
+def _collate(batch, wrap):
+    """Shared stacking recursion; `wrap` converts the stacked numpy leaf
+    (Tensor for the in-process path, identity for multiprocess workers —
+    one recursion so the two paths' leaf handling cannot diverge)."""
     sample = batch[0]
     if isinstance(sample, (list, tuple)):
-        return tuple(default_collate_fn([b[i] for b in batch])
+        return tuple(_collate([b[i] for b in batch], wrap)
                      for i in range(len(sample)))
     if isinstance(sample, dict):
-        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+        return {k: _collate([b[k] for b in batch], wrap) for k in sample}
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([s.numpy() for s in batch]))
+        return wrap(np.stack([s.numpy() for s in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return wrap(np.stack(batch))
     if isinstance(sample, (int, np.integer)):
-        return Tensor(np.asarray(batch, np.int64))
+        return wrap(np.asarray(batch, np.int64))
     if isinstance(sample, (float, np.floating)):
-        return Tensor(np.asarray(batch, np.float32))
+        return wrap(np.asarray(batch, np.float32))
     return batch
 
 
+def default_collate_fn(batch):
+    return _collate(batch, Tensor)
+
+
+def _np_collate(batch):
+    """Worker-side collate for the multiprocess path: numpy leaves —
+    forked workers must never touch the jax backend; the consumer wraps."""
+    return _collate(batch, lambda a: a)
+
+
+def _np_tree_to_tensor(obj):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_np_tree_to_tensor(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _np_tree_to_tensor(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    return obj
+
+
 _worker_info = None
+
+
+class WorkerInfo:
+    def __init__(self, wid, num_workers, dataset, seed):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def _set_worker_info(wid, num_workers, dataset, seed):
+    """Called inside multiprocess workers (io/multiprocess.py)."""
+    global _worker_info
+    _worker_info = WorkerInfo(wid, num_workers, dataset, seed)
 
 
 def get_worker_info():
@@ -262,13 +299,23 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=False, timeout=0, worker_init_fn=None,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch = use_buffer_reader
         self.prefetch_factor = max(2, prefetch_factor)
+        self.num_workers = max(0, int(num_workers))
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        if persistent_workers:
+            import warnings
+            warnings.warn(
+                "persistent_workers=True is accepted for API parity but "
+                "not implemented: the worker pool is re-created per epoch",
+                RuntimeWarning)
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if self._iterable_ds:
             self.batch_sampler = None
@@ -290,6 +337,36 @@ class DataLoader:
             return len(self.dataset)
         return len(self.batch_sampler)
 
+    def _mp_dataset_ok(self):
+        """Probe one sample in the PARENT: datasets whose __getitem__
+        produces (or computes with) framework Tensors would run jax ops
+        inside the forked child — observed to deadlock (inherited backend
+        locks). Such datasets fall back to the thread path with a
+        warning."""
+        def has_tensor(obj):
+            if isinstance(obj, Tensor):
+                return True
+            if isinstance(obj, (list, tuple)):
+                return any(has_tensor(o) for o in obj)
+            if isinstance(obj, dict):
+                return any(has_tensor(v) for v in obj.values())
+            return False
+
+        try:
+            probe = self.dataset[0]
+        except Exception:
+            return True  # let the worker surface the real error
+        if has_tensor(probe):
+            import warnings
+            warnings.warn(
+                "DataLoader(num_workers>0): dataset __getitem__ returns "
+                "framework Tensors; jax must not run inside forked "
+                "workers — falling back to the thread prefetch path. "
+                "Return numpy arrays from the dataset for multiprocess "
+                "loading.", RuntimeWarning)
+            return False
+        return True
+
     def _raw_iter(self):
         if self._iterable_ds:
             it = iter(self.dataset)
@@ -308,6 +385,30 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        # process workers + shared-memory transport (reference:
+        # fluid/dataloader/dataloader_iter.py:320 multiprocess path +
+        # memory/allocation/mmap_allocator.cc). GIL-free decode; iterable
+        # datasets keep the thread path.
+        if (self.num_workers > 0 and not self._iterable_ds
+                and self.batch_sampler is not None
+                and self._mp_dataset_ok()):
+            from .multiprocess import MultiprocessIter
+            user_collate = self.collate_fn is not default_collate_fn
+            worker_collate = self.collate_fn if user_collate else _np_collate
+            it = MultiprocessIter(
+                self.dataset, worker_collate, iter(self.batch_sampler),
+                num_workers=self.num_workers,
+                prefetch_factor=self.prefetch_factor,
+                worker_init_fn=self.worker_init_fn,
+                timeout=self.timeout,
+                seed=int(np.random.randint(0, 2 ** 31)),
+                use_shared_memory=self.use_shared_memory)
+            try:
+                for batch in it:
+                    yield batch if user_collate else _np_tree_to_tensor(batch)
+            finally:
+                it.close()
+            return
         if not self.prefetch:
             yield from self._raw_iter()
             return

@@ -15,13 +15,29 @@ from .engine import TracedLayer, make_eval_step, make_train_step  # noqa: F401
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               property=False):  # noqa: A002
+    """Stage a dygraph function/Layer (reference: jit.py to_static over the
+    dygraph_to_static transpiler). Tensor `if`/`while` are AST-converted to
+    cond/while_loop by jit/dy2static.py; out-of-scope shapes keep the
+    original code and fail at trace time with a guided error."""
     def deco(fn):
+        import inspect as _inspect
+        import types
+
         from ..nn.layer_base import Layer
+        from .dy2static import ast_transform
+
         if isinstance(fn, Layer):
-            traced = TracedLayer(fn.forward, layer=fn)
+            fwd = fn.forward
+            target = fwd.__func__ if _inspect.ismethod(fwd) else fwd
+            conv = ast_transform(target)
+            if conv is not None:
+                fwd = (types.MethodType(conv, fn)
+                       if _inspect.ismethod(fn.forward) else conv)
+            traced = TracedLayer(fwd, layer=fn)
             fn.forward = traced
             return fn
-        wrapper = TracedLayer(fn)
+        conv = ast_transform(fn)
+        wrapper = TracedLayer(conv if conv is not None else fn)
         functools.update_wrapper(wrapper, fn, updated=())
         return wrapper
 

@@ -64,15 +64,20 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
     """reference: fluid/layers/control_flow.py while_loop → lax.while_loop
     when traced (body must keep shapes/dtypes fixed, the XLA contract)."""
     loop_vars = list(loop_vars)
-    if not _is_traced(*[v for v in loop_vars if isinstance(v, Tensor)]):
+    # dispatch on the loop vars AND the first test result: the test may
+    # close over traced tensors even when every loop var is a python scalar
+    first = cond_fn(*loop_vars)
+    if not _is_traced(first,
+                      *[v for v in loop_vars if isinstance(v, Tensor)]):
         # eager: python loop over concrete values
         vals = loop_vars
+        r = first
         while True:
-            r = cond_fn(*vals)
             if not bool(r._data if isinstance(r, Tensor) else r):
                 break
             out = body_fn(*vals)
             vals = list(out) if isinstance(out, (tuple, list)) else [out]
+            r = cond_fn(*vals)
         return vals
 
     init = _unwrap_tree(loop_vars)
