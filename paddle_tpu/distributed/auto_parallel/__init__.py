@@ -24,7 +24,7 @@ from ...framework import state
 from ...framework.tensor import Tensor
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "get_default_mesh",
-           "set_default_mesh"]
+           "set_default_mesh", "reshard", "reshard_state_dict"]
 
 _default_mesh: Optional["ProcessMesh"] = None
 
@@ -143,3 +143,6 @@ def shard_op(op_fn, process_mesh: Optional[ProcessMesh] = None,
         return outs_t[0] if single else tuple(outs_t)
 
     return wrapped
+
+
+from .reshard import reshard, reshard_state_dict  # noqa: E402,F401

@@ -1,15 +1,27 @@
 """Model zoo (reference: python/paddle/vision/models/__init__.py)."""
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .densenet import (DenseNet, densenet121, densenet161,  # noqa: F401
+                       densenet169, densenet201)
+from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .lenet import LeNet  # noqa: F401
 from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,  # noqa: F401
                         mobilenet_v2)
 from .resnet import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
                      resnet101, resnet152, wide_resnet50_2,
                      wide_resnet101_2)
+from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_5,  # noqa: F401
+                           shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                           shufflenet_v2_x2_0)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 
 __all__ = [
-    "LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-    "resnet152", "wide_resnet50_2", "wide_resnet101_2", "VGG", "vgg11",
-    "vgg13", "vgg16", "vgg19", "MobileNetV1", "MobileNetV2",
-    "mobilenet_v1", "mobilenet_v2",
+    "AlexNet", "alexnet", "DenseNet", "densenet121", "densenet161",
+    "densenet169", "densenet201", "GoogLeNet", "googlenet", "LeNet",
+    "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
+    "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "wide_resnet50_2", "wide_resnet101_2", "ShuffleNetV2",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
 ]

@@ -1,0 +1,85 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py —
+densenet121/161/169/201)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import concat
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201"]
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, cin, growth, bn_size, drop_rate):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(cin)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(cin, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.drop = nn.Dropout(drop_rate) if drop_rate > 0 else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.drop is not None:
+            out = self.drop(out)
+        return concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(cin)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(cin, cout, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=(6, 12, 24, 16), growth=32, init_ch=64,
+                 bn_size=4, dropout=0.0, num_classes=1000):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_ch, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_ch), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        ch = init_ch
+        blocks = []
+        for i, n in enumerate(layers):
+            for _ in range(n):
+                blocks.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if i != len(layers) - 1:
+                blocks.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.norm = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.norm(self.blocks(self.stem(x))))
+        x = self.pool(x).reshape((x.shape[0], -1))
+        return self.fc(x)
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet((6, 12, 24, 16), 32, 64, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet((6, 12, 36, 24), 48, 96, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet((6, 12, 32, 32), 32, 64, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet((6, 12, 48, 32), 32, 64, **kw)

@@ -1,0 +1,73 @@
+"""GoogLeNet / Inception-v1 (reference:
+python/paddle/vision/models/googlenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import concat
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                  bias_attr=False),
+        nn.BatchNorm2D(cout), nn.ReLU())
+
+
+class Inception(nn.Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = _conv_bn(cin, c1, 1)
+        self.b3 = nn.Sequential(_conv_bn(cin, c3r, 1),
+                                _conv_bn(c3r, c3, 3, padding=1))
+        self.b5 = nn.Sequential(_conv_bn(cin, c5r, 1),
+                                _conv_bn(c5r, c5, 5, padding=2))
+        self.bp = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _conv_bn(cin, pp, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)],
+                      axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.stem = nn.Sequential(
+            _conv_bn(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _conv_bn(64, 64, 1),
+            _conv_bn(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc3 = nn.Sequential(
+            Inception(192, 64, 96, 128, 16, 32, 32),
+            Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc4 = nn.Sequential(
+            Inception(480, 192, 96, 208, 16, 48, 64),
+            Inception(512, 160, 112, 224, 24, 64, 64),
+            Inception(512, 128, 128, 256, 24, 64, 64),
+            Inception(512, 112, 144, 288, 32, 64, 64),
+            Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc5 = nn.Sequential(
+            Inception(832, 256, 160, 320, 32, 128, 128),
+            Inception(832, 384, 192, 384, 48, 128, 128),
+        )
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.2)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        x = self.pool(x).reshape((x.shape[0], -1))
+        return self.fc(self.dropout(x))
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

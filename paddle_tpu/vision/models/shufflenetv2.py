@@ -1,0 +1,92 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import concat, split
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0, groups=1, act=True):
+    layers = [nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(cout)]
+    if act:
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(branch, branch, 1),
+                _conv_bn(branch, branch, 3, stride=1, padding=1,
+                         groups=branch, act=False),
+                _conv_bn(branch, branch, 1))
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(cin, cin, 3, stride=stride, padding=1, groups=cin,
+                         act=False),
+                _conv_bn(cin, branch, 1))
+            self.branch2 = nn.Sequential(
+                _conv_bn(cin, branch, 1),
+                _conv_bn(branch, branch, 3, stride=stride, padding=1,
+                         groups=branch, act=False),
+                _conv_bn(branch, branch, 1))
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+        widths = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+                  1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}
+        c2, c3, c4, c5 = widths[scale]
+        self.stem = nn.Sequential(_conv_bn(3, 24, 3, stride=2, padding=1),
+                                  nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        cin = 24
+        for cout, repeat in ((c2, 4), (c3, 8), (c4, 4)):
+            stages.append(_InvertedResidual(cin, cout, 2))
+            for _ in range(repeat - 1):
+                stages.append(_InvertedResidual(cout, cout, 1))
+            cin = cout
+        self.stages = nn.Sequential(*stages)
+        self.head = _conv_bn(cin, c5, 1)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(c5, num_classes)
+
+    def forward(self, x):
+        x = self.head(self.stages(self.stem(x)))
+        x = self.pool(x).reshape((x.shape[0], -1))
+        return self.fc(x)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=2.0, **kw)

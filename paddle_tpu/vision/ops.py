@@ -13,7 +13,8 @@ from ..framework.dispatch import primitive, raw
 from ..framework.tensor import Tensor
 
 __all__ = ["yolo_box", "roi_align", "nms", "deform_conv2d", "RoIAlign",
-           "DeformConv2D"]
+           "DeformConv2D", "prior_box", "box_coder", "multiclass_nms",
+           "generate_proposals"]
 
 
 @primitive("roi_align", dynamic=True)
@@ -150,27 +151,11 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     cats = (np.asarray(raw(category_idxs)) if category_idxs is not None
             else np.zeros(len(b), np.int64))
 
-    def iou(a, rest):
-        xx1 = np.maximum(a[0], rest[:, 0])
-        yy1 = np.maximum(a[1], rest[:, 1])
-        xx2 = np.minimum(a[2], rest[:, 2])
-        yy2 = np.minimum(a[3], rest[:, 3])
-        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
-        area_a = (a[2] - a[0]) * (a[3] - a[1])
-        area_r = (rest[:, 2] - rest[:, 0]) * (rest[:, 3] - rest[:, 1])
-        return inter / np.maximum(area_a + area_r - inter, 1e-9)
-
     keep = []
     for c in np.unique(cats):
         idx = np.where(cats == c)[0]
-        order = idx[np.argsort(-s[idx])]
-        while len(order):
-            i = order[0]
-            keep.append(i)
-            if len(order) == 1:
-                break
-            rest = order[1:]
-            order = rest[iou(b[i], b[rest]) <= iou_threshold]
+        kept = _np_nms(b[idx], s[idx], iou_threshold)
+        keep.extend(idx[kept].tolist())
     keep = np.asarray(sorted(keep, key=lambda i: -s[i]), np.int64)
     if top_k is not None:
         keep = keep[:top_k]
@@ -263,3 +248,270 @@ class DeformConv2D:
     def __init__(self, *a, **kw):
         raise NotImplementedError(
             "use paddle_tpu.vision.ops.deform_conv2d functional form")
+
+
+# ---------------------------------------------------------------------------
+# detection op core (reference: paddle/fluid/operators/detection/)
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """reference: prior_box_op.h:34 ExpandAspectRatios — 1.0 first, dedup,
+    optional reciprocal."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+@primitive("prior_box", nondiff=True)
+def _prior_box(input, image, *, min_sizes, max_sizes, aspect_ratios,
+               variances, flip, clip, steps, offset):
+    """SSD prior boxes (reference: detection/prior_box_op.h:67-170).
+    input [N,C,H,W] feature map, image [N,C,IH,IW]; returns
+    (boxes [H,W,P,4] normalized xyxy, vars [H,W,P,4])."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_h = steps[1] if steps[1] > 0 else ih / fh
+    step_w = steps[0] if steps[0] > 0 else iw / fw
+    ars = _expand_aspect_ratios(aspect_ratios, flip)
+
+    whs = []  # per-prior (half_w, half_h), reference ordering
+    for s, m in enumerate(min_sizes):
+        for ar in ars:
+            whs.append((m * np.sqrt(ar) / 2.0, m / np.sqrt(ar) / 2.0))
+        if max_sizes:
+            sq = np.sqrt(m * max_sizes[s]) / 2.0
+            whs.append((sq, sq))
+    whs = jnp.asarray(whs, jnp.float32)              # [P, 2]
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                  # [H, W]
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]     # [H, W, 1, 2]
+    half = whs[None, None, :, :]                     # [1, 1, P, 2]
+    mins = c - half
+    maxs = c + half
+    scale = jnp.asarray([iw, ih], jnp.float32)
+    boxes = jnp.concatenate([mins / scale, maxs / scale], -1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    return boxes, var
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    if min_max_aspect_ratios_order:
+        raise NotImplementedError(
+            "min_max_aspect_ratios_order=True ordering is not implemented")
+    return _prior_box(
+        input, image, min_sizes=tuple(float(m) for m in min_sizes),
+        max_sizes=tuple(float(m) for m in (max_sizes or ())),
+        aspect_ratios=tuple(float(a) for a in aspect_ratios),
+        variances=tuple(float(v) for v in variance), flip=bool(flip),
+        clip=bool(clip), steps=(float(steps[0]), float(steps[1])),
+        offset=float(offset))
+
+
+@primitive("box_coder")
+def _box_coder(prior_box_, target_box, prior_box_var, *, code_type,
+               box_normalized, axis):
+    """reference: detection/box_coder_op.h — encode_center_size produces
+    the PAIRWISE [N, M, 4] encoding (every target against every prior);
+    decode_center_size takes [N, M, 4] deltas with `axis` choosing which
+    dim the priors run along (axis=0: priors along dim 1, i.e.
+    prior_box_offset = j·len; axis=1: priors along dim 0)."""
+    norm = 0.0 if box_normalized else 1.0
+    pb = prior_box_.astype(jnp.float32)
+    pw = pb[..., 2] - pb[..., 0] + norm                   # [M]
+    ph = pb[..., 3] - pb[..., 1] + norm
+    pcx = pb[..., 0] + pw * 0.5
+    pcy = pb[..., 1] + ph * 0.5
+    tb = target_box.astype(jnp.float32)
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm                   # [N]
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        t = lambda v: v[:, None]                          # [N, 1]
+        p = lambda v: v[None, :]                          # [1, M]
+        out = jnp.stack([(t(tcx) - p(pcx)) / p(pw),
+                         (t(tcy) - p(pcy)) / p(ph),
+                         jnp.log(t(tw) / p(pw)),
+                         jnp.log(t(th) / p(ph))], -1)     # [N, M, 4]
+        if prior_box_var is not None:
+            out = out / prior_box_var.astype(jnp.float32)
+        return out
+    # decode_center_size
+    d = tb
+    if prior_box_var is not None:
+        var = prior_box_var.astype(jnp.float32)
+        if d.ndim == 3 and var.ndim == 2 and axis == 1:
+            var = var[:, None, :]  # per-prior var along dim 0
+        d = d * var
+    if d.ndim == 3:
+        if axis == 0:   # priors run along dim 1 (box_coder_op.h j·len)
+            pw, ph, pcx, pcy = (v[None, :] for v in (pw, ph, pcx, pcy))
+        else:           # axis == 1: priors along dim 0
+            pw, ph, pcx, pcy = (v[:, None] for v in (pw, ph, pcx, pcy))
+    cx = d[..., 0] * pw + pcx
+    cy = d[..., 1] * ph + pcy
+    w = jnp.exp(d[..., 2]) * pw
+    h = jnp.exp(d[..., 3]) * ph
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - norm, cy + h * 0.5 - norm], -1)
+
+
+def box_coder(prior_box_, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    pv = prior_box_var
+    if pv is not None and not isinstance(pv, Tensor):
+        pv = Tensor(np.broadcast_to(
+            np.asarray(pv, np.float32), (4,)).copy())
+    return _box_coder(prior_box_, target_box, pv, code_type=str(code_type),
+                      box_normalized=bool(box_normalized), axis=int(axis))
+
+
+def _np_iou(a, rest, norm=0.0):
+    """IoU of box `a` against rows of `rest`; norm=1.0 applies the +1
+    pixel-coordinate offset (reference kernels' normalized=false mode)."""
+    xx1 = np.maximum(a[0], rest[:, 0])
+    yy1 = np.maximum(a[1], rest[:, 1])
+    xx2 = np.minimum(a[2], rest[:, 2])
+    yy2 = np.minimum(a[3], rest[:, 3])
+    inter = (np.maximum(0, xx2 - xx1 + norm)
+             * np.maximum(0, yy2 - yy1 + norm))
+    a_i = (a[2] - a[0] + norm) * (a[3] - a[1] + norm)
+    a_r = (rest[:, 2] - rest[:, 0] + norm) * (rest[:, 3] - rest[:, 1] + norm)
+    return inter / np.maximum(a_i + a_r - inter, 1e-9)
+
+
+def _np_nms(boxes, scores, thresh, top_k=None, norm=0.0, eta=1.0):
+    """Greedy suppression (shared by nms/multiclass_nms/
+    generate_proposals). top_k truncates BEFORE suppression (the
+    reference's nms_top_k); eta < 1 adaptively shrinks the threshold
+    (multiclass_nms_op.cc adaptive NMS)."""
+    order = np.argsort(-scores)
+    if top_k is not None:
+        order = order[:top_k]
+    keep = []
+    t = thresh
+    while len(order):
+        i = order[0]
+        keep.append(i)
+        if len(order) == 1:
+            break
+        rest = order[1:]
+        order = rest[_np_iou(boxes[i], boxes[rest], norm) <= t]
+        if eta < 1.0 and t > 0.5:
+            t *= eta
+    return np.asarray(keep, np.int64)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, return_index=False,
+                   rois_num=None, name=None):
+    """Host-side multiclass NMS (reference:
+    detection/multiclass_nms_op.cc:90 — dynamic output, per class NMS then
+    global keep_top_k). bboxes [N,M,4]; scores [N,C,M].
+    Returns (out [K,6] rows [label, score, x1,y1,x2,y2], rois_num [N])
+    (+ kept indices when return_index)."""
+    b = np.asarray(raw(bboxes))
+    s = np.asarray(raw(scores))
+    if s.ndim != 3:
+        raise NotImplementedError(
+            "multiclass_nms: 2-D LoD score input (rois_num path) is not "
+            "implemented; pass scores as [N, C, M]")
+    norm = 0.0 if normalized else 1.0
+    n, c, m = s.shape
+    all_rows, all_idx, counts = [], [], []
+    for i in range(n):
+        rows, idxs = [], []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            sc = s[i, cls]
+            mask = sc > score_threshold
+            if not mask.any():
+                continue
+            cand = np.where(mask)[0]
+            keep = _np_nms(b[i][cand], sc[cand], nms_threshold,
+                           top_k=nms_top_k if nms_top_k > 0 else None,
+                           norm=norm, eta=nms_eta)
+            for k in cand[keep]:
+                rows.append([float(cls), float(sc[k]), *b[i][k].tolist()])
+                idxs.append(i * m + k)
+        if rows and keep_top_k > 0 and len(rows) > keep_top_k:
+            order = np.argsort([-r[1] for r in rows])[:keep_top_k]
+            rows = [rows[j] for j in order]
+            idxs = [idxs[j] for j in order]
+        counts.append(len(rows))
+        all_rows.extend(rows)
+        all_idx.extend(idxs)
+    out = (Tensor(np.asarray(all_rows, np.float32).reshape(-1, 6))
+           if all_rows else Tensor(np.zeros((0, 6), np.float32)))
+    nums = Tensor(np.asarray(counts, np.int32))
+    if return_index:
+        return out, nums, Tensor(np.asarray(all_idx, np.int64))
+    return out, nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation on host (reference:
+    detection/generate_proposals_v2_op.cc — decode anchors by deltas, clip
+    to image, filter small, top-k, NMS). scores [N,A,H,W];
+    bbox_deltas [N,4A,H,W]; anchors/variances [H,W,A,4]; img_size [N,2]."""
+    sc = np.asarray(raw(scores))
+    dl = np.asarray(raw(bbox_deltas))
+    im = np.asarray(raw(img_size))
+    an = np.asarray(raw(anchors)).reshape(-1, 4)
+    va = np.asarray(raw(variances)).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    rois, roi_scores, counts = [], [], []
+    for i in range(n):
+        s_i = sc[i].transpose(1, 2, 0).reshape(-1)        # H*W*A
+        d_i = dl[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        d_i = d_i * va
+        aw = an[:, 2] - an[:, 0] + 1.0
+        ah = an[:, 3] - an[:, 1] + 1.0
+        acx = an[:, 0] + aw * 0.5
+        acy = an[:, 1] + ah * 0.5
+        cx = d_i[:, 0] * aw + acx
+        cy = d_i[:, 1] * ah + acy
+        bw = np.exp(np.minimum(d_i[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(d_i[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - 1.0, cy + bh * 0.5 - 1.0], -1)
+        ih, iw = float(im[i, 0]), float(im[i, 1])
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, iw - 1)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, ih - 1)
+        # reference clamps: min_size = max(min_size, 1.0)
+        ms = max(float(min_size), 1.0)
+        keep = np.where((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+                        & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))[0]
+        boxes, s_k = boxes[keep], s_i[keep]
+        order = np.argsort(-s_k)[:pre_nms_top_n]
+        boxes, s_k = boxes[order], s_k[order]
+        # pixel-coordinate (+1) IoU like generate_proposals_v2
+        kept = _np_nms(boxes, s_k, nms_thresh, norm=1.0,
+                       eta=eta)[:post_nms_top_n]
+        rois.append(boxes[kept])
+        roi_scores.append(s_k[kept])
+        counts.append(len(kept))
+    out = Tensor(np.concatenate(rois, 0).astype(np.float32))
+    out_s = Tensor(np.concatenate(roi_scores, 0).astype(np.float32))
+    if return_rois_num:
+        return out, out_s, Tensor(np.asarray(counts, np.int32))
+    return out, out_s

@@ -224,6 +224,21 @@ SPECS = {
     "lu_op": dict(in_=[WELL()], grad=False, bf16=False),
     "cov_op": dict(in_=[U(-1, 1, (3, 6))], tol=2e-2),
     "corrcoef_op": dict(in_=[U(-1, 1, (3, 6))], tol=5e-2),
+    # detection
+    "prior_box": dict(in_=[U(-1, 1, (1, 2, 4, 4)),
+                           U(-1, 1, (1, 3, 32, 32))],
+                      attrs=dict(min_sizes=(8.0,), max_sizes=(),
+                                 aspect_ratios=(1.0,),
+                                 variances=(0.1, 0.1, 0.2, 0.2),
+                                 flip=False, clip=False, steps=(0.0, 0.0),
+                                 offset=0.5)),
+    "box_coder": dict(in_=[lambda rs: np.cumsum(
+        rs.rand(5, 4).astype(np.float32) + 0.2, axis=1),
+        lambda rs: np.cumsum(rs.rand(5, 4).astype(np.float32) + 0.2,
+                             axis=1),
+        lambda rs: np.full((4,), 0.5, np.float32)],
+        attrs=dict(code_type="encode_center_size", box_normalized=True,
+                   axis=0)),
     # signal (real)
     "frame": dict(in_=[U(-1, 1, (16,))],
                   attrs=dict(frame_length=8, hop_length=4)),

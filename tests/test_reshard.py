@@ -1,0 +1,96 @@
+"""Auto-parallel reshard (reference: distributed/auto_parallel/reshard.py
+Resharder): dp×mp → mp×dp layout changes, pipeline-stage sub-mesh handoff,
+checkpoint-load resharding — on the 8-virtual-device CPU mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_parallel import (ProcessMesh, reshard,
+                                                  reshard_state_dict,
+                                                  shard_tensor)
+
+
+def _dev_ids(arr):
+    return sorted(d.id for d in arr.devices())
+
+
+class TestReshard:
+    def test_layout_change_dpmp_to_mpdp(self):
+        mesh_a = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        mesh_b = ProcessMesh(np.arange(8).reshape(4, 2), ["mp", "dp"])
+        x = paddle.to_tensor(np.arange(64, dtype=np.float32).reshape(8, 8))
+        ref = x.numpy().copy()
+
+        a = reshard(x, mesh_a, ["dp", "mp"])
+        assert a._data.sharding.shard_shape(a._data.shape) == (4, 2)
+        b = reshard(a, mesh_b, ["mp", "dp"])
+        assert b._data.sharding.shard_shape(b._data.shape) == (2, 4)
+        np.testing.assert_array_equal(np.asarray(b._data), ref)
+        assert b.process_mesh is mesh_b
+
+    def test_pp_stage_submesh_handoff(self):
+        stage0 = ProcessMesh(np.arange(0, 4).reshape(4), ["mp"])
+        stage1 = ProcessMesh(np.arange(4, 8).reshape(4), ["mp"])
+        act = paddle.to_tensor(np.random.RandomState(0).randn(8, 16)
+                               .astype(np.float32))
+        ref = act.numpy().copy()
+        on0 = reshard(act, stage0, ["mp", None])
+        assert _dev_ids(on0._data) == [0, 1, 2, 3]
+        on1 = reshard(on0, stage1, ["mp", None])
+        assert _dev_ids(on1._data) == [4, 5, 6, 7]
+        np.testing.assert_array_equal(np.asarray(on1._data), ref)
+
+    def test_shard_to_replicated_and_back(self):
+        mesh = ProcessMesh(np.arange(8).reshape(8), ["x"])
+        t = paddle.to_tensor(np.random.RandomState(1).randn(16, 4)
+                             .astype(np.float32))
+        ref = t.numpy().copy()
+        sharded = reshard(t, mesh, ["x", None])
+        assert sharded._data.sharding.shard_shape((16, 4)) == (2, 4)
+        repl = reshard(sharded, mesh, None)
+        assert repl._data.sharding.shard_shape((16, 4)) == (16, 4)
+        np.testing.assert_array_equal(np.asarray(repl._data), ref)
+
+    def test_checkpoint_state_dict_reshard(self):
+        """Save under one topology, load under another: every entry lands
+        on the new mesh with the requested spec, values unchanged."""
+        mesh_old = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        mesh_new = ProcessMesh(np.arange(8).reshape(4, 2), ["sh", "mp"])
+        rs = np.random.RandomState(2)
+        w = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+        b = paddle.to_tensor(rs.randn(8).astype(np.float32))
+        sd = {"w": reshard(w, mesh_old, ["mp", None]), "b": b}
+        ref = {k: (v.numpy().copy()) for k, v in sd.items()}
+
+        new = reshard_state_dict(sd, mesh_new,
+                                 {"w": ["sh", None]})
+        assert new["w"]._data.sharding.shard_shape((8, 8)) == (2, 8)
+        for k in sd:
+            np.testing.assert_array_equal(np.asarray(new[k]._data), ref[k])
+
+    def test_traced_same_mesh_is_constraint(self):
+        import jax
+        mesh = ProcessMesh(np.arange(8).reshape(8), ["x"])
+
+        def f(a):
+            t = paddle.Tensor(a, _internal=True)
+            out = reshard(t, mesh, ["x", None])
+            return out._data * 2.0
+
+        with mesh.jax_mesh:
+            y = jax.jit(f)(np.ones((8, 4), np.float32))
+        np.testing.assert_array_equal(np.asarray(y), 2.0)
+
+    def test_traced_cross_mesh_rejected(self):
+        import jax
+        mesh_a = ProcessMesh(np.arange(4).reshape(4), ["x"])
+        mesh_b = ProcessMesh(np.arange(4, 8).reshape(4), ["x"])
+
+        def f(a):
+            t = paddle.Tensor(a, _internal=True)
+            return reshard(t, mesh_b, ["x", None])._data
+
+        from paddle_tpu.framework import state
+        with pytest.raises(ValueError, match="cross-mesh|enclosing"):
+            with state.mesh_guard(mesh_a.jax_mesh):
+                jax.jit(f)(np.ones((8, 4), np.float32))
