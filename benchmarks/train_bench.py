@@ -122,6 +122,61 @@ def bench_gpt2(on_tpu):
             "mfu": _mfu(flops, dt)}
 
 
+def bench_ernie(on_tpu):
+    """ERNIE/BERT-base pretrain step, dygraph + AMP O2 (BASELINE config 3):
+    MLM+NSP loss, bf16 autocast traced into the compiled step."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.engine import make_train_step
+    from paddle_tpu.models import (BertPretrainingCriterion, bert_base,
+                                   bert_tiny)
+
+    if on_tpu:
+        B, T, steps, warmup = 32, 128, 20, 3
+        net = bert_base()
+    else:
+        B, T, steps, warmup = 2, 32, 2, 1
+        net = bert_tiny()
+    paddle.seed(0)
+    crit = BertPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 learning_rate=1e-4, weight_decay=0.01)
+    step = make_train_step(net, lambda lg, nl, y1, y2: crit(lg, nl, y1, y2),
+                           opt)
+    core = getattr(net, "bert", net)
+    vocab = core.embeddings.word_embeddings.weight.shape[0]
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (B, T)).astype(np.int64)
+    labels = ids.copy()
+    labels[:, ::5] = -100
+    nsp = rs.randint(0, 2, (B,)).astype(np.int64)
+    args = ([paddle.to_tensor(ids)],
+            [paddle.to_tensor(labels), paddle.to_tensor(nsp)])
+
+    import paddle_tpu.amp as amp
+    with amp.auto_cast(level="O2"):
+        for _ in range(warmup):
+            loss, _ = step(*args)
+        float(loss.numpy())
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, _ = step(*args)
+        float(loss.numpy())
+    dt = (time.perf_counter() - t0) / steps
+
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    L = len(core.layers)
+    dmodel = core.hidden_size
+    tokens = B * T
+    flops = 6 * n_params * tokens + 12 * L * dmodel * T * tokens
+    return {"config": "ernie_base_amp_o2_train" if on_tpu
+            else "bert_tiny_amp_o2_train",
+            "throughput": round(tokens / dt, 1),
+            "unit": "tokens/sec/chip",
+            "step_ms": round(dt * 1e3, 2),
+            "batch": B, "seq_len": T, "params": n_params,
+            "mfu": _mfu(flops, dt)}
+
+
 def bench_resnet50(on_tpu):
     """ResNet-50 static-graph Executor training (BASELINE config 2)."""
     import paddle_tpu as paddle
@@ -177,7 +232,8 @@ def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     print(json.dumps({"backend": jax.default_backend(),
                       "device_kind": jax.devices()[0].device_kind}))
-    benches = {"gpt2": bench_gpt2, "resnet50": bench_resnet50}
+    benches = {"gpt2": bench_gpt2, "ernie": bench_ernie,
+               "resnet50": bench_resnet50}
     for name, fn in benches.items():
         if which not in ("all", name):
             continue

@@ -7,10 +7,15 @@ fused transformer ops (/root/reference/paddle/fluid/operators/fused/
 fused_attention_op.cu). BASELINE.md config 5 (GPT-3 1.3B dp+mp+pp with
 recompute) is the north-star; this package provides the GPT family those
 configs train."""
+from .bert import (BertForPretraining, BertModel,  # noqa: F401
+                   BertPretrainingCriterion, ErnieModel, bert_base,
+                   bert_tiny, ernie_base)
 from .gpt import (GPT_CONFIGS, GPTDecoderLayer, GPTEmbeddings,
                   GPTForPipeline, GPTForPretraining, GPTModel,
                   GPTPretrainingCriterion, gpt_tiny, gpt2_small, gpt3_1p3b)
 
 __all__ = ["GPTModel", "GPTForPretraining", "GPTForPipeline",
            "GPTDecoderLayer", "GPTEmbeddings", "GPTPretrainingCriterion",
-           "GPT_CONFIGS", "gpt_tiny", "gpt2_small", "gpt3_1p3b"]
+           "GPT_CONFIGS", "gpt_tiny", "gpt2_small", "gpt3_1p3b",
+           "BertModel", "BertForPretraining", "BertPretrainingCriterion",
+           "ErnieModel", "bert_base", "bert_tiny", "ernie_base"]

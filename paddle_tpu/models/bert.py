@@ -1,0 +1,188 @@
+"""BERT / ERNIE family — BASELINE.md config 3 (ERNIE-base pretrain).
+
+TPU-native equivalent of the reference's ERNIE/BERT usage (the reference
+repo ships the transformer building blocks — nn/layer/transformer.py — and
+benchmarks ERNIE-base through the external benchmark repo,
+tools/ci_model_benchmark.sh:52; model structure follows the standard
+bert-base recipe). Encoder-only transformer over this framework's
+TransformerEncoder stack (whose attention core routes to the Pallas flash
+kernel when shapes allow), with MLM + NSP pretraining heads and tied
+decoder weights."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..tensor import matmul
+
+__all__ = ["BertModel", "BertForPretraining", "BertPretrainingCriterion",
+           "bert_base", "bert_tiny", "ernie_base", "ErnieModel"]
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, vocab_size, hidden_size, max_position_embeddings,
+                 type_vocab_size=2, dropout=0.1, initializer_range=0.02):
+        super().__init__()
+        from ..nn import initializer as I
+        init = I.Normal(0.0, initializer_range)
+        self.word_embeddings = nn.Embedding(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(max_position_embeddings,
+                                                hidden_size)
+        self.token_type_embeddings = nn.Embedding(type_vocab_size,
+                                                  hidden_size)
+        for emb, n in ((self.word_embeddings, vocab_size),
+                       (self.position_embeddings, max_position_embeddings),
+                       (self.token_type_embeddings, type_vocab_size)):
+            emb.weight.set_value(init((n, hidden_size), "float32"))
+        self.layer_norm = nn.LayerNorm(hidden_size)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import jax.numpy as jnp
+        T = input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(T, dtype=jnp.int64),
+                                  _internal=True)
+        if token_type_ids is None:
+            token_type_ids = Tensor(
+                jnp.zeros(input_ids.shape, jnp.int64), _internal=True)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size)
+        self.activation = nn.Tanh()
+
+    def forward(self, hidden):
+        return self.activation(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 hidden_dropout_prob=0.1, attention_dropout_prob=0.1):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.embeddings = BertEmbeddings(
+            vocab_size, hidden_size, max_position_embeddings,
+            type_vocab_size, hidden_dropout_prob)
+        layer = nn.TransformerEncoderLayer(
+            hidden_size, num_heads, intermediate_size,
+            dropout=hidden_dropout_prob,
+            attn_dropout=attention_dropout_prob, activation="gelu")
+        self.encoder = nn.TransformerEncoder(layer, num_layers)
+        self.pooler = BertPooler(hidden_size)
+
+    @property
+    def layers(self):
+        return self.encoder.layers
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, T] key padding mask -> additive [B, 1, 1, T]
+            import jax.numpy as jnp
+            m = attention_mask._data.astype(jnp.float32)
+            add = (1.0 - m)[:, None, None, :] * -1e4
+            attention_mask = Tensor(add, _internal=True)
+        seq = self.encoder(x, src_mask=attention_mask)
+        return seq, self.pooler(seq)
+
+
+class BertPretrainingHeads(nn.Layer):
+    def __init__(self, hidden_size, vocab_size, word_embedding_weight):
+        super().__init__()
+        self.transform = nn.Linear(hidden_size, hidden_size)
+        self.layer_norm = nn.LayerNorm(hidden_size)
+        self.decoder_weight = word_embedding_weight  # tied
+        import numpy as _np
+        from ..framework.tensor import Parameter
+        self.decoder_bias = Parameter(
+            _np.zeros((vocab_size,), _np.float32))
+        self.seq_relationship = nn.Linear(hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output):
+        h = self.layer_norm(F.gelu(self.transform(sequence_output)))
+        logits = matmul(h, self.decoder_weight,
+                        transpose_y=True) + self.decoder_bias
+        nsp = self.seq_relationship(pooled_output)
+        return logits, nsp
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, bert: BertModel):
+        super().__init__()
+        self.bert = bert
+        vocab = bert.embeddings.word_embeddings.weight.shape[0]
+        self.cls = BertPretrainingHeads(
+            bert.hidden_size, vocab, bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                attention_mask)
+        return self.cls(seq, pooled)
+
+
+class BertPretrainingCriterion(nn.Layer):
+    """MLM + NSP loss (labels use -100 = ignore, torch/bert convention)."""
+
+    def forward(self, prediction_logits, nsp_logits, mlm_labels,
+                nsp_labels=None):
+        import jax.numpy as jnp
+        logits = prediction_logits._data
+        labels = mlm_labels._data
+        V = logits.shape[-1]
+        logp = F.log_softmax(Tensor(logits, _internal=True), axis=-1)._data
+        valid = labels >= 0
+        safe = jnp.where(valid, labels, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
+        denom = jnp.maximum(valid.sum(), 1)
+        mlm = -(jnp.where(valid, picked, 0.0).sum() / denom)
+        loss = mlm
+        if nsp_labels is not None:
+            nsp = F.cross_entropy(nsp_logits, nsp_labels)
+            loss = loss + nsp._data
+        return Tensor(loss, _internal=True)
+
+
+_CONFIGS = {
+    "bert-tiny": dict(vocab_size=1024, hidden_size=64, num_layers=2,
+                      num_heads=4, intermediate_size=128,
+                      max_position_embeddings=128),
+    "bert-base": dict(vocab_size=30522, hidden_size=768, num_layers=12,
+                      num_heads=12, intermediate_size=3072,
+                      max_position_embeddings=512),
+}
+
+
+def _make(name, pretraining=True, **overrides):
+    cfg = dict(_CONFIGS[name])
+    cfg.update(overrides)
+    bert = BertModel(**cfg)
+    return BertForPretraining(bert) if pretraining else bert
+
+
+def bert_tiny(**kw):
+    return _make("bert-tiny", **kw)
+
+
+def bert_base(**kw):
+    return _make("bert-base", **kw)
+
+
+def ernie_base(**kw):
+    """ERNIE-base shares the bert-base architecture (BASELINE config 3)."""
+    return _make("bert-base", **kw)
+
+
+ErnieModel = BertModel

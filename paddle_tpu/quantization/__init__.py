@@ -22,7 +22,7 @@ from ..nn.layer_base import Layer
 __all__ = ["fake_quantize_dequantize_abs_max",
            "fake_channel_wise_quantize_dequantize_abs_max",
            "QuantizedLinear", "QuantizedConv2D", "ImperativeQuantAware",
-           "PTQ"]
+           "PTQ", "export_quantized_model"]
 
 
 @primitive("fake_quantize_dequantize_abs_max")
@@ -198,3 +198,55 @@ class PTQ:
         return ImperativeQuantAware(
             weight_bits=self.wb, activation_bits=self.ab).quantize(
                 model, act_scales=self._scales)
+
+
+def export_quantized_model(model: Layer, path_prefix: str, input_spec):
+    """Export a quantized model as a LOADABLE quantized program artifact
+    (reference: the slim export pipeline —
+    quantization_pass.py QuantizationFreezePass +
+    static.save_inference_model; the saved __model__ carries the
+    fake_quantize ops with their scales).
+
+    The quantized model (post ImperativeQuantAware.quantize / PTQ.quantize)
+    is STAGED into a static Program — every fake-quant primitive becomes a
+    real serialized op with its bit width / calibrated scale in the attrs —
+    and saved as .pdmodel/.pdiparams, loadable by
+    static.load_inference_model or inference.create_predictor.
+
+    input_spec: list of (shape, dtype) or (shape, dtype, name) tuples
+    (or static.InputSpec-likes with .shape/.dtype/.name)."""
+    from ..framework import state
+    from .. import static as static_mod
+
+    specs = []
+    for i, spec in enumerate(input_spec):
+        if isinstance(spec, (tuple, list)):
+            shape, dtype = spec[0], spec[1]
+            name = spec[2] if len(spec) > 2 else f"x{i}"
+        else:
+            shape, dtype = spec.shape, spec.dtype
+            name = getattr(spec, "name", None) or f"x{i}"
+        specs.append((name, list(shape), dtype))
+
+    import paddle_tpu as _paddle
+    was_static = state.in_static_mode()
+    was_training = getattr(model, "training", False)
+    # trace in EVAL mode: a train-mode trace would serialize dropout ops
+    # whose PRNG feed vars don't exist in the loaded artifact (KeyError at
+    # run) and train-time batch-stats semantics
+    model.eval()
+    if not was_static:
+        _paddle.enable_static()
+    try:
+        with static_mod.program_guard(static_mod.Program(),
+                                      static_mod.Program()):
+            feeds = [static_mod.data(n, s, d) for n, s, d in specs]
+            out = model(*feeds)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            static_mod.save_inference_model(path_prefix, feeds, outs)
+    finally:
+        if not was_static:
+            _paddle.disable_static()
+        if was_training:
+            model.train()
+    return path_prefix

@@ -7,23 +7,122 @@ from .program import (InputSpec, Program, Variable, data,
 from .executor import Executor, Scope, global_scope
 from . import io  # noqa: F401
 from .io import save_inference_model, load_inference_model, save, load  # noqa: F401
+import jax  # noqa: E402
+from . import passes  # noqa: E402,F401
+from .passes import PassManager, apply_pass  # noqa: E402,F401
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None):
-    """reference: fluid/backward.py:1406. In this design gradients are
-    produced by jax.value_and_grad over the compiled program, so
-    append_backward only marks the loss; Executor builds the actual
-    backward when an optimize directive (or grad fetch) is present."""
+    """reference: fluid/backward.py:1406 — stage gradient vars for every
+    trainable parameter; the returned pairs' grad Variables are fetchable
+    through Executor.run. (The optimizer path still fuses its own backward
+    into the train executable; these vars exist for grad inspection and
+    grad-of-subgraph surgery.)"""
     program = loss.program
     program.backward_loss = loss
     params = parameter_list or program.all_parameters()
-    return [(p, None) for p in params]
+    params = [p for p in params
+              if not (no_grad_set and getattr(p, "name", None)
+                      in no_grad_set)]
+    grads = gradients([loss], params)
+    return list(zip(params, grads))
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    raise NotImplementedError(
-        "static.gradients: fetch grads via optimizer directive in v1")
+    """Grad-of-subgraph with custom cotangents (reference:
+    fluid/backward.py:1406 gradients / calc_gradient). Stages ONE backward
+    op whose fn interprets the pruned forward slice under jax.vjp — the
+    whole-program compile then fuses it like any other op."""
+    from ..framework.tensor import Tensor
+    from .program import OpRecord, Variable, prune_ops, _new_var_name
+
+    targets = list(targets) if isinstance(targets, (list, tuple)) \
+        else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    program = targets[0].program
+    target_names = [t.name for t in targets]
+
+    # resolve each input to its env name (Variable name or capture name)
+    def env_name(x):
+        if isinstance(x, Variable):
+            return x.name
+        if isinstance(x, Tensor):  # captured parameter
+            n = program.capture_names.get(id(x))
+            if n is None:
+                raise ValueError(
+                    f"gradients: tensor {getattr(x, 'name', x)} is not part "
+                    "of this program")
+            return n
+        raise TypeError(f"gradients: unsupported input {type(x)}")
+
+    input_names = [env_name(x) for x in inputs]
+
+    sub_ops, needed = prune_ops(program.ops, set(target_names))
+    produced = {n for op in sub_ops for n in op.out_names}
+    ext_names = sorted((needed - produced) | set(input_names))
+
+    ct_names = []
+    if target_gradients is not None:
+        for tg in target_gradients:
+            if tg is not None:
+                ct_names.append(env_name(tg))
+            else:
+                ct_names.append(None)
+
+    all_in = list(ext_names) + [n for n in ct_names if n is not None]
+
+    def grad_fn(*arrays):
+        import jax as _jax
+        import jax.numpy as _jnp
+        ext_arrays = arrays[:len(ext_names)]
+        ct_arrays = list(arrays[len(ext_names):])
+        base_env = dict(zip(ext_names, ext_arrays))
+
+        def f(*in_arrays):
+            env = dict(base_env)
+            env.update(zip(input_names, in_arrays))
+            for op in sub_ops:
+                ins = [ref if kind == "const" else env[ref]
+                       for kind, ref in op.in_refs]
+                outs = op.fn(*ins, **op.attrs)
+                if not isinstance(outs, tuple):
+                    outs = (outs,)
+                env.update(zip(op.out_names, outs))
+                # inputs are CUT POINTS: grads treat them as independent
+                # leaves even when an op in the slice also produces them
+                for n, pr in zip(input_names, in_arrays):
+                    if n in op.out_names:
+                        env[n] = pr
+            return tuple(env[t] for t in target_names)
+
+        primals = [base_env[n] for n in input_names]
+        outs, vjp = _jax.vjp(f, *primals)
+        cts = []
+        it = iter(ct_arrays)
+        for i, o in enumerate(outs):
+            if target_gradients is not None and ct_names[i] is not None:
+                cts.append(next(it))
+            else:
+                cts.append(_jnp.ones_like(o))
+        return tuple(vjp(tuple(cts)))
+
+    out_vars = []
+    out_names = []
+    for x, n in zip(inputs, input_names):
+        gname = _new_var_name(f"{n}@GRAD")
+        shape = tuple(x._data.shape)
+        dtype = x._data.dtype
+        gv = Variable(program, gname,
+                      jax.ShapeDtypeStruct(shape, dtype))
+        program.vars[gname] = gv
+        out_vars.append(gv)
+        out_names.append(gname)
+
+    program.ops.append(OpRecord(
+        "gradients", grad_fn, {},
+        [("var", n) for n in all_in], out_names))
+    return out_vars
 
 
 class CompiledProgram:

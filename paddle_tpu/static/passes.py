@@ -1,0 +1,178 @@
+"""Program-level pass framework: registered rewrites over the staged op
+list.
+
+TPU-native equivalent of the reference's ir::Pass substrate
+(/root/reference/paddle/fluid/framework/ir/pass.h:51 and the 165 passes
+under framework/ir/). The reference rewrites an SSA op-handle graph; here a
+pass rewrites `Program.ops` (the staged OpRecord list) BEFORE the whole
+program is compiled to one XLA module — the right altitude for surgery XLA
+cannot do itself: deleting training-only ops for inference, forcing bf16
+compute on matmul-class ops (static AMP), inserting fake-quant ops for
+quantized export. Fusion passes are deliberately absent: XLA owns fusion.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import jax.numpy as jnp
+
+from .program import OpRecord, Program
+
+PASS_REGISTRY: Dict[str, Callable[[], "PassBase"]] = {}
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        PASS_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+class PassBase:
+    """reference: ir/pass.h:51 Pass::Apply — mutate and return program."""
+
+    name = ""
+
+    def apply(self, program: Program) -> Program:
+        raise NotImplementedError
+
+    def __call__(self, program):
+        return self.apply(program)
+
+
+def apply_pass(program: Program, name: str, **attrs) -> Program:
+    if name not in PASS_REGISTRY:
+        raise KeyError(f"unknown pass {name!r}; registered: "
+                       f"{sorted(PASS_REGISTRY)}")
+    p = PASS_REGISTRY[name](**attrs)
+    out = p.apply(program)
+    program.version += 1
+    return out if out is not None else program
+
+
+class PassManager:
+    """reference: ir/pass.h PassRegistry + build_strategy pass lists."""
+
+    def __init__(self, passes: List):
+        self.passes = list(passes)
+
+    def apply(self, program: Program) -> Program:
+        for p in self.passes:
+            if isinstance(p, str):
+                program = apply_pass(program, p)
+            else:
+                program = p.apply(program) or program
+                # invalidate compiled-executable cache entries keyed on
+                # (id(program), version, ...) — without this a prior
+                # Executor compile silently ignores the rewrite
+                program.version += 1
+        return program
+
+
+def _rewire(ops, mapping):
+    """Replace var references according to {old_name: (kind, ref)}."""
+    for op in ops:
+        op.in_refs = [mapping.get(ref, (kind, ref))
+                      if kind != "const" else (kind, ref)
+                      for kind, ref in op.in_refs]
+
+
+@register_pass("delete_dropout_pass")
+class DeleteDropoutPass(PassBase):
+    """Remove dropout ops for inference programs, rewiring consumers to the
+    dropout input (reference: ir/delete_dropout_op_pass.cc)."""
+
+    _DROPOUT_TYPES = ("dropout_op", "alpha_dropout_op")
+
+    def apply(self, program):
+        mapping = {}
+        kept = []
+        for op in program.ops:
+            if op.op_type in self._DROPOUT_TYPES:
+                # out -> whatever fed the dropout's x
+                mapping[op.out_names[0]] = op.in_refs[0]
+            else:
+                kept.append(op)
+        # chase chains (dropout feeding dropout)
+        for k in list(mapping):
+            kind, ref = mapping[k]
+            while kind != "const" and ref in mapping:
+                kind, ref = mapping[ref]
+            mapping[k] = (kind, ref)
+        program.ops = kept
+        _rewire(program.ops, mapping)
+        # stale rng feed vars are pruned by _CompiledProgram's backward slice
+        return program
+
+
+def _wrap_bf16(fn):
+    def wrapped(*arrays, **attrs):
+        cast = [a.astype(jnp.bfloat16)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                for a in arrays]
+        outs = fn(*cast, **attrs)
+        single = not isinstance(outs, tuple)
+        outs_t = (outs,) if single else outs
+        back = tuple(o.astype(jnp.float32)
+                     if hasattr(o, "dtype") and o.dtype == jnp.bfloat16
+                     else o for o in outs_t)
+        return back[0] if single else back
+    return wrapped
+
+
+@register_pass("amp_bf16_pass")
+class AmpBf16Pass(PassBase):
+    """Static AMP rewrite: matmul-class ops compute in bf16 (MXU-native),
+    outputs cast back to f32 (reference: the static-graph AMP pass,
+    contrib/mixed_precision/fp16_utils.py cast_model_to_fp16 — there an
+    OpDesc rewrite inserting cast ops, here a compute-dtype rewrite)."""
+
+    DEFAULT_LIST = ("matmul_v2", "mul", "bmm", "conv2d_op",
+                    "conv2d_transpose_op")
+
+    def __init__(self, op_types=None):
+        self.op_types = tuple(op_types or self.DEFAULT_LIST)
+
+    def apply(self, program):
+        for op in program.ops:
+            if op.op_type in self.op_types and \
+                    not getattr(op.fn, "_pt_bf16", False):
+                op.fn = _wrap_bf16(op.fn)
+                op.fn._pt_bf16 = True  # idempotent under re-application
+        return program
+
+
+def _wrap_fake_quant(fn, weight_bits=8, activation_bits=8):
+    from ..quantization import _fq_absmax
+
+    def wrapped(*arrays, **attrs):
+        bits = (activation_bits, weight_bits)
+        q = [(_fq_absmax.fn(a, bit_length=bits[i])
+              if i < 2 and hasattr(a, "dtype") and a.dtype == jnp.float32
+              else a)
+             for i, a in enumerate(arrays)]
+        return fn(*q, **attrs)
+    return wrapped
+
+
+@register_pass("quant_insert_pass")
+class QuantInsertPass(PassBase):
+    """Insert fake quant-dequant on the inputs of matmul-class ops —
+    the static half of QAT / the rewrite quantized export runs on
+    (reference: contrib/slim/quantization/quantization_pass.py
+    QuantizationTransformPass)."""
+
+    DEFAULT_LIST = ("matmul_v2", "mul", "bmm", "conv2d_op")
+
+    def __init__(self, op_types=None, weight_bits=8, activation_bits=8):
+        self.op_types = tuple(op_types or self.DEFAULT_LIST)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def apply(self, program):
+        for op in program.ops:
+            if op.op_type in self.op_types:
+                op.fn = _wrap_fake_quant(op.fn, self.weight_bits,
+                                         self.activation_bits)
+        return program

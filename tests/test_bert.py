@@ -1,0 +1,76 @@
+"""BERT/ERNIE family (BASELINE config 3; models/bert.py)."""
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (BertForPretraining, BertPretrainingCriterion,
+                               bert_tiny)
+
+
+def _batch(vocab=1024, B=2, T=16, seed=0):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, vocab, (B, T)).astype(np.int64)
+    labels = ids.copy()
+    labels[:, ::3] = -100
+    nsp = rs.randint(0, 2, (B,)).astype(np.int64)
+    return ids, labels, nsp
+
+
+class TestBert:
+    def test_forward_shapes_and_init_loss(self):
+        paddle.seed(0)
+        net = bert_tiny()
+        assert isinstance(net, BertForPretraining)
+        ids, labels, nsp = _batch()
+        logits, nsp_logits = net(paddle.to_tensor(ids))
+        assert logits.shape == [2, 16, 1024]
+        assert nsp_logits.shape == [2, 2]
+        crit = BertPretrainingCriterion()
+        loss = float(crit(logits, nsp_logits, paddle.to_tensor(labels),
+                          paddle.to_tensor(nsp)).numpy())
+        # untrained: ~ln(V) + ln(2)
+        assert abs(loss - (math.log(1024) + math.log(2))) < 3.0
+
+    def test_ignore_index_semantics(self):
+        paddle.seed(0)
+        net = bert_tiny()
+        net.eval()
+        ids, labels, _ = _batch()
+        logits, nspl = net(paddle.to_tensor(ids))
+        crit = BertPretrainingCriterion()
+        # all-ignored labels -> zero MLM loss
+        allig = np.full_like(labels, -100)
+        l0 = float(crit(logits, nspl, paddle.to_tensor(allig)).numpy())
+        assert l0 == 0.0
+
+    def test_attention_mask_blocks_keys(self):
+        paddle.seed(0)
+        net = bert_tiny(pretraining=False)
+        net.eval()
+        ids, _, _ = _batch()
+        mask = np.ones_like(ids)
+        mask[:, -4:] = 0  # pad the tail
+        seq1, _ = net(paddle.to_tensor(ids),
+                      attention_mask=paddle.to_tensor(mask))
+        ids2 = ids.copy()
+        ids2[:, -4:] = 7  # perturb masked keys
+        seq2, _ = net(paddle.to_tensor(ids2),
+                      attention_mask=paddle.to_tensor(mask))
+        np.testing.assert_allclose(seq1.numpy()[:, :-4],
+                                   seq2.numpy()[:, :-4], atol=1e-4)
+
+    def test_compiled_train_step_learns(self):
+        from paddle_tpu.jit.engine import make_train_step
+        paddle.seed(0)
+        net = bert_tiny()
+        crit = BertPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                     learning_rate=1e-3)
+        step = make_train_step(
+            net, lambda lg, nl, y1, y2: crit(lg, nl, y1, y2), opt)
+        ids, labels, nsp = _batch()
+        args = ([paddle.to_tensor(ids)],
+                [paddle.to_tensor(labels), paddle.to_tensor(nsp)])
+        losses = [float(step(*args)[0].numpy()) for _ in range(5)]
+        assert losses[-1] < losses[0]

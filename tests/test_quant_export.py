@@ -1,0 +1,68 @@
+"""Quantized-program export (reference: contrib/slim/quantization export —
+QuantizationFreezePass + save_inference_model: the artifact carries the
+fake-quant ops and their calibrated scales)."""
+import os
+import pickle
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.quantization import PTQ, ImperativeQuantAware, \
+    export_quantized_model
+
+
+def test_ptq_export_roundtrip(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    calib = [paddle.to_tensor(np.random.RandomState(i).randn(4, 8)
+                              .astype(np.float32)) for i in range(3)]
+    ptq = PTQ()
+    ptq.sample_data(net, calib)
+    qnet = ptq.quantize(net)
+    x = paddle.to_tensor(np.random.RandomState(9).randn(4, 8)
+                         .astype(np.float32))
+    ref = qnet(x).numpy()
+
+    path = export_quantized_model(qnet, str(tmp_path / "qmodel"),
+                                  [((-1, 8), "float32")])
+    meta = pickle.load(open(path + ".pdmodel", "rb"))
+    qops = [o for o in meta["ops"] if "quant" in o["op_type"]]
+    # PTQ bakes FIXED activation scales into the artifact
+    assert any(o["op_type"] == "fake_quantize_dequantize_fixed_scale"
+               and o["attrs"].get("scale", 0) > 0 for o in qops)
+    assert any(o["op_type"]
+               == "fake_channel_wise_quantize_dequantize_abs_max"
+               for o in qops)
+
+    paddle.enable_static()
+    try:
+        prog, feeds, fetches = static.load_inference_model(path)
+        exe = static.Executor()
+        outs = exe.run(prog, feed={feeds[0]: x.numpy()},
+                       fetch_list=fetches)
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_qat_export_conv(tmp_path):
+    paddle.seed(1)
+    net = paddle.nn.Sequential(paddle.nn.Conv2D(2, 4, 3, padding=1),
+                               paddle.nn.ReLU())
+    qnet = ImperativeQuantAware().quantize(net)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(1, 2, 6, 6)
+                         .astype(np.float32))
+    ref = qnet(x).numpy()
+    path = export_quantized_model(qnet, str(tmp_path / "qconv"),
+                                  [((-1, 2, 6, 6), "float32", "img")])
+    paddle.enable_static()
+    try:
+        prog, feeds, fetches = static.load_inference_model(path)
+        assert feeds == ["img"]
+        exe = static.Executor()
+        outs = exe.run(prog, feed={"img": x.numpy()}, fetch_list=fetches)
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)

@@ -1,0 +1,120 @@
+"""Program-pass framework + static gradients (reference:
+paddle/fluid/framework/ir/pass.h:51; fluid/backward.py:1406 gradients)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    static.reset_default_programs()
+    yield
+    paddle.disable_static()
+
+
+class TestGradients:
+    def test_grad_wrt_input_and_param(self):
+        paddle.seed(0)
+        x = static.data("x", [-1, 3], "float32")
+        lin = paddle.nn.Linear(3, 2)
+        y = lin(x)
+        loss = paddle.sum(y * y)
+        gx, gw = static.gradients([loss], [x, lin.weight])
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        a = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        gxv, gwv = exe.run(feed={"x": a}, fetch_list=[gx, gw])
+        W, b = lin.weight.numpy(), lin.bias.numpy()
+        out = a @ W + b
+        np.testing.assert_allclose(gxv, 2 * out @ W.T, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(gwv, 2 * a.T @ out, rtol=1e-5, atol=1e-5)
+
+    def test_target_gradients_cotangent(self):
+        paddle.seed(0)
+        x = static.data("x", [-1, 3], "float32")
+        lin = paddle.nn.Linear(3, 2)
+        y = lin(x)
+        ct = static.data("ct", [-1, 2], "float32")
+        (gy,) = static.gradients([y], [x], target_gradients=[ct])
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        rs = np.random.RandomState(1)
+        a = rs.randn(4, 3).astype(np.float32)
+        c = rs.randn(4, 2).astype(np.float32)
+        (gyv,) = exe.run(feed={"x": a, "ct": c}, fetch_list=[gy])
+        np.testing.assert_allclose(gyv, c @ lin.weight.numpy().T,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_append_backward_returns_fetchable_grads(self):
+        paddle.seed(0)
+        x = static.data("x", [-1, 4], "float32")
+        lin = paddle.nn.Linear(4, 1)
+        loss = paddle.mean(lin(x))
+        pairs = static.append_backward(loss)
+        assert pairs and all(g is not None for _, g in pairs)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        a = np.ones((2, 4), np.float32)
+        vals = exe.run(feed={"x": a}, fetch_list=[g for _, g in pairs])
+        for (p, _), v in zip(pairs, vals):
+            assert v.shape == tuple(p.shape)
+            assert np.isfinite(v).all()
+
+
+class TestPasses:
+    def test_delete_dropout_pass(self):
+        paddle.seed(0)
+        x = static.data("x", [-1, 8], "float32")
+        h = paddle.nn.functional.dropout(x, 0.5, training=True)
+        y = h * 2.0
+        prog = static.default_main_program()
+        assert any(op.op_type == "dropout_op" for op in prog.ops)
+        static.apply_pass(prog, "delete_dropout_pass")
+        assert not any(op.op_type == "dropout_op" for op in prog.ops)
+        exe = static.Executor()
+        a = np.ones((2, 8), np.float32)
+        (out,) = exe.run(feed={"x": a}, fetch_list=[y])
+        np.testing.assert_allclose(out, 2.0)  # dropout gone entirely
+
+    def test_amp_bf16_pass_changes_compute_dtype(self):
+        paddle.seed(0)
+        x = static.data("x", [-1, 16], "float32")
+        lin = paddle.nn.Linear(16, 16)
+        y = lin(x)
+        prog = static.default_main_program()
+        static.apply_pass(prog, "amp_bf16_pass")
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        a = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        (out,) = exe.run(feed={"x": a}, fetch_list=[y])
+        ref = a @ lin.weight.numpy() + lin.bias.numpy()
+        assert out.dtype == np.float32
+        # bf16 compute differs from f32 but only at bf16 precision
+        assert not np.allclose(out, ref, atol=1e-7)
+        np.testing.assert_allclose(out, ref, rtol=3e-2, atol=3e-2)
+
+    def test_quant_insert_pass(self):
+        paddle.seed(0)
+        x = static.data("x", [-1, 8], "float32")
+        lin = paddle.nn.Linear(8, 8)
+        y = lin(x)
+        prog = static.default_main_program()
+        static.apply_pass(prog, "quant_insert_pass", weight_bits=4,
+                          activation_bits=4)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        a = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        (out,) = exe.run(feed={"x": a}, fetch_list=[y])
+        ref = a @ lin.weight.numpy() + lin.bias.numpy()
+        # 4-bit fake-quant visibly perturbs, stays in the ballpark
+        assert not np.allclose(out, ref, atol=1e-4)
+        np.testing.assert_allclose(out, ref, rtol=0.5, atol=0.5)
+
+    def test_pass_manager_and_registry_errors(self):
+        prog = static.default_main_program()
+        static.PassManager(["delete_dropout_pass"]).apply(prog)
+        with pytest.raises(KeyError):
+            static.apply_pass(prog, "no_such_pass")
