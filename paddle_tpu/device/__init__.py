@@ -15,3 +15,93 @@ def get_device_count(device_type=None):
         return len(jax.devices(device_type)) if device_type else len(jax.devices())
     except RuntimeError:
         return 0
+
+
+# ---------------------------------------------------------------------------
+# device memory stats facade (reference: paddle/fluid/memory/stats.h
+# DEVICE_MEMORY_STAT_* + python/paddle/device/cuda/__init__.py
+# memory_allocated/max_memory_allocated/memory_reserved). PJRT owns the
+# device allocator; its per-device stats are surfaced here.
+
+
+def _device_of(device=None):
+    import jax
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str):
+        # "tpu:0" / "cpu:1"
+        kind, _, idx = device.partition(":")
+        devs = jax.devices(kind) if kind else jax.devices()
+        return devs[int(idx) if idx else 0]
+    return device
+
+
+def memory_stats(device=None) -> dict:
+    """Raw PJRT allocator stats for one device ({} when the backend does
+    not expose them, e.g. CPU)."""
+    d = _device_of(device)
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (reference:
+    device/cuda memory_allocated)."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """High-watermark of allocated bytes (reference:
+    device/cuda max_memory_allocated)."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the allocator pool; PJRT backends that expose no
+    pool counter report the allocator bound via bytes_limit (reference:
+    device/cuda memory_reserved)."""
+    s = memory_stats(device)
+    for key in ("bytes_reserved", "pool_bytes", "bytes_limit"):
+        if key in s:
+            return int(s[key])
+    return 0
+
+
+def max_memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    for key in ("peak_bytes_reserved", "peak_pool_bytes", "bytes_limit"):
+        if key in s:
+            return int(s[key])
+    return 0
+
+
+def empty_cache():
+    """API parity (reference: device/cuda empty_cache). XLA/PJRT owns the
+    arena; freeing is driven by buffer lifetime, so this is a no-op."""
+
+
+class cuda:
+    """paddle.device.cuda namespace parity — same stats, TPU devices."""
+
+    memory_stats = staticmethod(memory_stats)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+
+    @staticmethod
+    def device_count():
+        return get_device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        """Block until pending work on THAT device completes (a committed
+        transfer serializes behind the device's queue)."""
+        import jax
+        d = _device_of(device)
+        jax.device_put(0, d).block_until_ready()

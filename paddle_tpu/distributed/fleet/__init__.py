@@ -44,6 +44,7 @@ __all__ = [
     "is_first_worker", "worker_endpoints", "barrier_worker", "recompute",
     "meta_parallel", "HybridParallelOptimizer", "DygraphShardingOptimizer",
     "LocalSGDOptimizer", "QueueDataset", "InMemoryDataset",
+    "DataGenerator", "MultiSlotDataGenerator", "UtilBase", "util",
 ]
 
 
@@ -101,7 +102,13 @@ _state = _FleetState()
 
 
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
+from .data_generator import (DataGenerator,  # noqa: F401,E402
+                             MultiSlotDataGenerator)
+from .util import UtilBase  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402
+
+#: reference: fleet.util (util_factory._create_util)
+util = UtilBase()
 
 
 def init(role_maker=None, is_collective=True, strategy=None):

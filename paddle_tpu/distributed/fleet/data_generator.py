@@ -1,0 +1,82 @@
+"""Fleet data_generator protocol.
+
+TPU-native equivalent of the reference's
+python/paddle/distributed/fleet/data_generator/data_generator.py:
+users subclass DataGenerator/MultiSlotDataGenerator, implement
+generate_sample(line) (and optionally generate_batch), and the generator
+emits the MultiSlot text protocol ("<count> v1 ... vn" per slot, one
+sample per line) that QueueDataset/InMemoryDataset (and the native
+datafeed, native/src/datafeed.cc) parse. run_from_stdin is the
+pipe_command entry; run_from_memory feeds in-process records."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 1
+        self._line_str = "\n"
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    # -- user protocol ------------------------------------------------------
+    def generate_sample(self, line):
+        """Return an ITERATOR over samples; each sample is a list of
+        (slot_name, [values]) pairs (reference: data_generator.py:153)."""
+        raise NotImplementedError(
+            "subclass DataGenerator and implement generate_sample")
+
+    def generate_batch(self, samples):
+        """Optional batch-level hook: receives the buffered samples of one
+        batch; defaults to yielding them unchanged."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- serialization ------------------------------------------------------
+    def _gen_str(self, sample):
+        """One sample -> one MultiSlot protocol line."""
+        parts = []
+        for _, values in sample:
+            vs = values if isinstance(values, (list, tuple)) else [values]
+            parts.append(str(len(vs)))
+            parts.extend(str(v) for v in vs)
+        return " ".join(parts) + self._line_str
+
+    # -- drivers ------------------------------------------------------------
+    def _emit(self, sample_iters, out):
+        buffered = []
+        for it in sample_iters:
+            if it is None:
+                continue
+            for sample in it():
+                buffered.append(sample)
+                if len(buffered) == self.batch_size_:
+                    for s in self.generate_batch(buffered)():
+                        out.write(self._gen_str(s))
+                    buffered = []
+        if buffered:
+            for s in self.generate_batch(buffered)():
+                out.write(self._gen_str(s))
+
+    def run_from_stdin(self):
+        """pipe_command entry: lines in, protocol lines out
+        (reference: data_generator.py:96)."""
+        self._emit((self.generate_sample(line) for line in sys.stdin),
+                   sys.stdout)
+
+    def run_from_memory(self, records=None, output=None):
+        """Feed in-process records (reference: run_from_memory, stdin-free
+        variant; `records` replaces the memory queue)."""
+        out = output or sys.stdout
+        self._emit((self.generate_sample(r) for r in (records or [])), out)
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """reference: MultiSlotDataGenerator — same protocol; the reference
+    adds proto-level output, which the text protocol subsumes here."""

@@ -15,13 +15,11 @@ from typing import List, Sequence
 
 
 def _shard_files(files: Sequence[str]) -> List[str]:
-    """Round-robin shard of the roster by host process (reference:
-    fleet/base/util_factory.py get_file_shard)."""
-    import jax
-    n, i = jax.process_count(), jax.process_index()
-    if n <= 1:
-        return list(files)
-    return [f for k, f in enumerate(files) if k % n == i]
+    """File-roster sharding is OWNED BY THE CALLER (the reference idiom:
+    ds.set_filelist(fleet.util.get_file_shard(files)) —
+    fleet/base/util_factory.py). The dataset must not re-shard, or a
+    pre-sharded roster would be sharded twice and silently drop files."""
+    return list(files)
 
 
 class QueueDataset:

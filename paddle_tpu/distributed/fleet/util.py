@@ -1,0 +1,77 @@
+"""fleet.util — cross-worker utilities.
+
+TPU-native equivalent of the reference's UtilBase
+(/root/reference/python/paddle/distributed/fleet/base/util_factory.py:45 —
+all_reduce/barrier/all_gather over the worker comm world, get_file_shard).
+Multi-process worlds go through jax's multihost utilities; the
+single-controller world (one process driving all chips) is the identity,
+matching the reference's single-trainer behavior."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["UtilBase"]
+
+
+class UtilBase:
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+
+    def _world(self):
+        import jax
+        return jax.process_count()
+
+    # -- collectives over the worker world ----------------------------------
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        """reference: util_factory.py:61 — numpy in, numpy out."""
+        arr = np.asarray(input)
+        if self._world() <= 1:
+            return arr
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(arr)
+        if mode == "sum":
+            return np.sum(gathered, axis=0)
+        if mode == "max":
+            return np.max(gathered, axis=0)
+        if mode == "min":
+            return np.min(gathered, axis=0)
+        raise ValueError(f"unsupported all_reduce mode {mode!r}")
+
+    def all_gather(self, input, comm_world="worker"):
+        """reference: util_factory.py:151 — returns the list of every
+        worker's value."""
+        if self._world() <= 1:
+            return [input]
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(np.asarray(input))
+        return [gathered[i] for i in range(gathered.shape[0])]
+
+    def barrier(self, comm_world="worker"):
+        """reference: util_factory.py:110."""
+        if self._world() <= 1:
+            return
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("fleet_util_barrier")
+
+    # -- file sharding -------------------------------------------------------
+    def get_file_shard(self, files: Sequence[str]) -> List[str]:
+        """reference: util_factory.py get_file_shard — contiguous split
+        with the first `len % n` workers taking one extra file. Sharding
+        is per host PROCESS (a single controller drives all its chips and
+        reads every file). The datasets do NOT re-shard: pass the result
+        to set_filelist and it is read as-is."""
+        import jax
+        files = list(files)
+        n = max(jax.process_count(), 1)
+        rank = jax.process_index()
+        base, extra = divmod(len(files), n)
+        start = rank * base + min(rank, extra)
+        count = base + (1 if rank < extra else 0)
+        return files[start:start + count]
+
+    def print_on_rank(self, message, rank_id=0):
+        from ..env import get_rank
+        if get_rank() == rank_id:
+            print(message)

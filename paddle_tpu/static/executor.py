@@ -230,5 +230,72 @@ class Executor:
             return [np.asarray(r) for r in results]
         return [Tensor(r, _internal=True) for r in results]
 
+    # -- dataset trainer loop (reference: fluid/executor.py
+    # train_from_dataset:1769 / infer_from_dataset over TrainerDesc +
+    # DeviceWorker RunFromDataset; here the "device worker" is the cached
+    # compiled program and the loop feeds dataset batches) ------------------
+    def _dataset_feed(self, dataset, batch):
+        feed = {}
+        for name, (offs, vals) in zip(dataset.slots(), batch):
+            offs = np.asarray(offs)
+            lens = np.diff(offs)
+            if lens.size and (lens == lens[0]).all():
+                k = int(lens[0])
+                arr = np.asarray(vals).reshape(len(lens), k)
+            else:
+                raise NotImplementedError(
+                    f"slot {name!r} is ragged across the batch; dense "
+                    "slots only — express variable length via padding + "
+                    "mask (SURVEY §7 LoD translation)")
+            feed[name] = arr
+        return feed
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """reference: executor.py:1769 — iterate the dataset, run the
+        program's fused train step per batch."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        program = program if program is not None else default_main_program()
+        if program.optimize_directive is None:
+            raise ValueError(
+                "train_from_dataset: program has no optimizer; call "
+                "optimizer.minimize(loss) first")
+        fetch_list = fetch_list or []
+        names = fetch_info or [getattr(f, "name", str(f))
+                               for f in fetch_list]
+        for step, batch in enumerate(dataset):
+            # fetch (device->host sync) only on print steps — the fused
+            # train step otherwise runs without materializing values
+            # (reference: trainer only prints fetches each print_period)
+            want = (fetch_list if debug and fetch_list
+                    and step % print_period == 0 else [])
+            vals = self.run(program, feed=self._dataset_feed(dataset, batch),
+                            fetch_list=want)
+            if want:
+                msg = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
+                                for n, v in zip(names, vals))
+                print(f"[train_from_dataset] step {step}: {msg}")
+        return None
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """reference: executor.py infer_from_dataset — same loop, no
+        optimizer step (the program must not carry an optimize
+        directive)."""
+        if dataset is None:
+            raise ValueError("infer_from_dataset needs a dataset")
+        program = program if program is not None else default_main_program()
+        if program.optimize_directive is not None:
+            program = program.clone(for_test=True)
+        outs = []
+        for batch in dataset:
+            outs.append(self.run(
+                program, feed=self._dataset_feed(dataset, batch),
+                fetch_list=fetch_list))
+        return outs
+
     def close(self):
         self._cache.clear()

@@ -63,8 +63,10 @@ def _compile(name: str, sources: Sequence[str], extra_args) -> str:
     if os.path.exists(lib):
         return lib
     tmp = lib + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", *extra_args,
-           *srcs, "-o", tmp]
+    inc = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "include")  # ships pt_op.h (the PD_BUILD_OP ABI)
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", f"-I{inc}",
+           *extra_args, *srcs, "-o", tmp]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(

@@ -1,0 +1,40 @@
+// pt_op.h — custom-op C ABI for paddle_tpu's cpp_extension toolchain.
+//
+// TPU-native counterpart of the reference's PD_BUILD_OP header ABI
+// (/root/reference/paddle/fluid/extension/include/ext_op_meta_info.h:501).
+// The reference registers C++ functors through a macro into its op
+// registry; here the contract is a plain extern-C symbol contract that
+// paddle_tpu.utils.cpp_extension.load() binds via ctypes and exposes
+// through jax.pure_callback (works eagerly and inside jit; device-resident
+// kernels belong in Pallas instead).
+//
+// Usage:
+//
+//   #include <pt_op.h>
+//
+//   PT_OP_FLOAT_UNARY(my_square) {
+//     for (int64_t i = 0; i < n; ++i) out[i] = x[i] * x[i];
+//   }
+//
+//   PT_OP_FLOAT_UNARY_GRAD(my_square) {  // optional: makes it trainable
+//     for (int64_t i = 0; i < n; ++i) dx[i] = 2.0f * x[i] * dy[i];
+//   }
+//
+// Then in python:  ops = paddle.utils.cpp_extension.load("my_square",
+//                                                        ["my_square.cc"])
+//                  y = ops.my_square(x)
+#ifndef PT_OP_H_
+#define PT_OP_H_
+
+#include <cstdint>
+
+// Elementwise float op: exported symbol <name>(x, out, n).
+#define PT_OP_FLOAT_UNARY(name)                                    \
+  extern "C" void name(const float* x, float* out, int64_t n)
+
+// Backward of the op: exported symbol <name>_grad(x, dy, dx, n).
+#define PT_OP_FLOAT_UNARY_GRAD(name)                               \
+  extern "C" void name##_grad(const float* x, const float* dy,     \
+                              float* dx, int64_t n)
+
+#endif  // PT_OP_H_
