@@ -10,7 +10,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "CallbackList"]
+           "EarlyStopping", "CallbackList", "VisualDL"]
 
 
 class Callback:
@@ -209,3 +209,48 @@ class EarlyStopping(Callback):
                 self.stop_training = True
                 if self.model is not None:
                     self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference: hapi/callbacks.py VisualDL over
+    the visualdl LogWriter). The visualdl package is not a dependency;
+    records are appended as JSON lines ({"tag", "step", "value"}) under
+    `log_dir/vdlrecords.jsonl` — the same scalars, a grep-able format, and
+    a drop-in spot to route to a real LogWriter when present."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+        self._train_step = 0
+
+    def _write(self, tag, step, value):
+        import json
+        if self._f is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._f = open(os.path.join(self.log_dir, "vdlrecords.jsonl"),
+                           "a")
+        self._f.write(json.dumps({"tag": tag, "step": int(step),
+                                  "value": float(value)}) + "\n")
+        self._f.flush()
+
+    def _log_dict(self, prefix, step, logs):
+        for k, v in (logs or {}).items():
+            try:
+                arr = np.asarray(v, dtype=np.float64).ravel()
+            except (TypeError, ValueError):
+                continue
+            if arr.size:
+                self._write(f"{prefix}/{k}", step, arr[0])
+
+    def on_train_batch_end(self, step, logs=None):
+        self._train_step += 1
+        self._log_dict("train", self._train_step, logs)
+
+    def on_eval_end(self, logs=None):
+        self._log_dict("eval", self._train_step, logs)
+
+    def on_train_end(self, logs=None):
+        if self._f is not None:
+            self._f.close()
+            self._f = None

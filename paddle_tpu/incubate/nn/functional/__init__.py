@@ -1,6 +1,8 @@
-from .fused_transformer import (fused_bias_dropout_residual_layer_norm,  # noqa: F401
+from .fused_transformer import (fused_bias_dropout_residual,  # noqa: F401
+                                fused_bias_dropout_residual_layer_norm,
                                 fused_feedforward,
                                 fused_multi_head_attention)
 
-__all__ = ["fused_bias_dropout_residual_layer_norm", "fused_feedforward",
+__all__ = ["fused_bias_dropout_residual",
+           "fused_bias_dropout_residual_layer_norm", "fused_feedforward",
            "fused_multi_head_attention"]

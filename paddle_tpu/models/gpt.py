@@ -104,7 +104,6 @@ class GPTAttention(Layer):
             hidden_size, 3 * hidden_size, gather_output=False)
         self.out_proj = RowParallelLinear(
             hidden_size, hidden_size, input_is_parallel=True)
-        self.dropout = Dropout(hidden_dropout_prob)
 
     def forward(self, x, cache=None):
         from ..ops import manipulation as mp
@@ -132,7 +131,8 @@ class GPTAttention(Layer):
                 q, k, v, is_causal=causal,
                 dropout_p=self.attn_dropout_prob, training=self.training)
         out = out.transpose((0, 2, 1, 3)).reshape((B, T, self.hidden_size))
-        out = self.dropout(self.out_proj(out))
+        # dropout + residual-add are fused by the caller (GPTDecoderLayer)
+        out = self.out_proj(out)
         return out if cache is None else (out, cache)
 
 
@@ -144,10 +144,10 @@ class GPTMLP(Layer):
                                         gather_output=False)
         self.fc2 = RowParallelLinear(intermediate_size, hidden_size,
                                      input_is_parallel=True)
-        self.dropout = Dropout(hidden_dropout_prob)
 
     def forward(self, x):
-        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+        # dropout + residual-add are fused by the caller (GPTDecoderLayer)
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
 
 
 class GPTDecoderLayer(Layer):
@@ -163,14 +163,28 @@ class GPTDecoderLayer(Layer):
                                  hidden_dropout_prob)
         self.ln_2 = LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
         self.mlp = GPTMLP(hidden_size, inter, hidden_dropout_prob)
+        self.dropout = Dropout(hidden_dropout_prob)
+
+    def _residual_dropout(self, h, residual):
+        """Pre-LN residual tail: residual + dropout(h), one fused Pallas
+        pass off-mesh (reference: fused_dropout_helper.h
+        LaunchResidualDropoutBias); composed ops under GSPMD meshes (the
+        sharded step lets XLA own layout) and for gate-rejected shapes."""
+        from ..framework import state
+        if state.current_mesh() is None:
+            from ..incubate.nn.functional import fused_bias_dropout_residual
+            return fused_bias_dropout_residual(
+                h, residual, None, self.dropout.p, training=self.training,
+                mode=self.dropout.mode)
+        return residual + self.dropout(h)
 
     def forward(self, x, cache=None):
         if cache is None:
-            x = x + self.attn(self.ln_1(x))
+            x = self._residual_dropout(self.attn(self.ln_1(x)), x)
         else:
             a, cache = self.attn(self.ln_1(x), cache)
-            x = x + a
-        x = x + self.mlp(self.ln_2(x))
+            x = self._residual_dropout(a, x)
+        x = self._residual_dropout(self.mlp(self.ln_2(x)), x)
         x = constrain(x, _seq_spec())
         return x if cache is None else (x, cache)
 

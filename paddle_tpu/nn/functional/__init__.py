@@ -739,3 +739,7 @@ def scaled_dot_product_attention(query, key=None, value=None, attn_mask=None,
                       dropout_p=float(dropout_p) if training else 0.0,
                       causal=bool(is_causal), return_weights=True)
     return out, w
+
+
+from .sequence import (sequence_expand, sequence_pad, sequence_pool,  # noqa: E402,F401
+                       sequence_reverse, sequence_softmax, sequence_unpad)

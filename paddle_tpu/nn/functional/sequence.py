@@ -1,0 +1,127 @@
+"""Sequence ops over the (padded values, lengths) idiom.
+
+TPU-native equivalent of the reference's LoD sequence operators
+(/root/reference/paddle/fluid/operators/sequence_ops/ — sequence_pad_op,
+sequence_unpad_op, sequence_reverse_op, sequence_softmax_op,
+sequence_pool_op, sequence_expand_op). The reference threads ragged LoD
+tensors; here ragged data is PADDED DENSE + a lengths vector (the
+SURVEY §7 LoD translation: static shapes for XLA, masks for semantics).
+Ops with inherently data-dependent output shapes (unpad, expand) run on
+host eagerly, like detection post-processing."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.dispatch import primitive, raw
+from ...framework.tensor import Tensor
+
+__all__ = ["sequence_pad", "sequence_unpad", "sequence_reverse",
+           "sequence_softmax", "sequence_pool", "sequence_expand"]
+
+
+def _mask(lengths, maxlen):
+    return (jnp.arange(maxlen)[None, :]
+            < jnp.asarray(lengths)[:, None])
+
+
+@primitive("sequence_reverse_op")
+def _seq_reverse(x, lengths):
+    """Reverse the first `len` steps of each row, padding stays in place
+    (reference: sequence_reverse_op.h)."""
+    T = x.shape[1]
+    idx = jnp.arange(T)[None, :]
+    ln = jnp.asarray(lengths)[:, None]
+    rev = jnp.where(idx < ln, ln - 1 - idx, idx)
+    return jnp.take_along_axis(
+        x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1)
+
+
+@primitive("sequence_softmax_op")
+def _seq_softmax(x, lengths):
+    """Masked softmax over the time dim (reference:
+    sequence_softmax_op.h) — padded steps get probability 0."""
+    m = _mask(lengths, x.shape[1])
+    s = jnp.where(m, x, -1e30)
+    out = jax.nn.softmax(s, axis=1)
+    return jnp.where(m, out, 0.0)
+
+
+@primitive("sequence_pool_op")
+def _seq_pool(x, lengths, *, pool_type):
+    """Masked pooling over time (reference: sequence_pool_op.h — SUM /
+    AVERAGE / SQRT / MAX / FIRST / LAST)."""
+    T = x.shape[1]
+    m = _mask(lengths, T)
+    me = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    ln = jnp.maximum(jnp.asarray(lengths), 1).astype(x.dtype)
+    le = ln.reshape(ln.shape + (1,) * (x.ndim - 2))
+    pt = pool_type.lower()
+    if pt == "sum":
+        return jnp.where(me, x, 0).sum(axis=1)
+    if pt == "average":
+        return jnp.where(me, x, 0).sum(axis=1) / le
+    if pt == "sqrt":
+        return jnp.where(me, x, 0).sum(axis=1) / jnp.sqrt(le)
+    if pt == "max":
+        return jnp.where(me, x, -jnp.inf).max(axis=1)
+    if pt == "first":
+        return x[:, 0]
+    if pt == "last":
+        idx = (jnp.maximum(jnp.asarray(lengths), 1) - 1).astype(jnp.int32)
+        return jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 1)), axis=1
+        ).squeeze(1)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_pad(x, pad_value, maxlen=None, lengths=None, name=None):
+    """(flat values [sum(len), ...], lengths [B]) → (padded [B, T, ...],
+    lengths). reference: sequence_pad_op (LoD in → padded out); here the
+    ragged input is the concatenation of rows + lengths."""
+    if lengths is None:
+        raise ValueError("sequence_pad needs `lengths` (the LoD split)")
+    vals = np.asarray(raw(x))
+    lens = np.asarray(raw(lengths)).astype(np.int64)
+    T = int(maxlen) if maxlen is not None else int(lens.max(initial=0))
+    pv = np.asarray(raw(pad_value))
+    tail = vals.shape[1:]
+    out = np.broadcast_to(pv, (len(lens), T) + tail).copy()
+    off = 0
+    for i, n in enumerate(lens):
+        n = min(int(n), T)
+        out[i, :n] = vals[off:off + int(lens[i])][:n]
+        off += int(lens[i])
+    return Tensor(out.astype(vals.dtype)), Tensor(lens)
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [B, T, ...] + lengths → flat [sum(len), ...] (reference:
+    sequence_unpad_op). Dynamic output — host-side eager."""
+    vals = np.asarray(raw(x))
+    lens = np.asarray(raw(length)).astype(np.int64)
+    parts = [vals[i, :int(n)] for i, n in enumerate(lens)]
+    return Tensor(np.concatenate(parts, axis=0) if parts
+                  else vals[:0, 0])
+
+
+def sequence_reverse(x, lengths, name=None):
+    return _seq_reverse(x, lengths)
+
+
+def sequence_softmax(x, lengths, name=None):
+    return _seq_softmax(x, lengths)
+
+
+def sequence_pool(x, pool_type, lengths, name=None):
+    return _seq_pool(x, lengths, pool_type=str(pool_type))
+
+
+def sequence_expand(x, ref_lengths, name=None):
+    """Repeat row i of x ref_lengths[i] times (reference:
+    sequence_expand_op). Dynamic output — host-side eager."""
+    vals = np.asarray(raw(x))
+    lens = np.asarray(raw(ref_lengths)).astype(np.int64)
+    return Tensor(np.repeat(vals, lens, axis=0))

@@ -115,6 +115,31 @@ class MultiHeadAttention(Layer):
         return out if len(outs) == 1 else tuple(outs)
 
 
+def _residual_tail(layer, h, residual, drop, norm):
+    """Shared residual tail for encoder/decoder layers. Post-LN
+    (normalize_before=False) fuses dropout+residual+layernorm into one
+    Pallas pass off-mesh (reference: fused_dropout_helper.h
+    LaunchLayernormResidualDropoutBias); pre-LN fuses dropout+residual.
+    Under a GSPMD mesh, composed ops (XLA owns layout there). The
+    Dropout's own mode is forwarded so downscale_in_infer layers keep
+    their scaling."""
+    from ..framework import state
+    if state.current_mesh() is None:
+        from ..incubate.nn.functional import (
+            fused_bias_dropout_residual,
+            fused_bias_dropout_residual_layer_norm)
+        mode = getattr(drop, "mode", "upscale_in_train")
+        if layer.normalize_before:
+            return fused_bias_dropout_residual(
+                h, residual, None, drop.p, training=layer.training,
+                mode=mode)
+        return fused_bias_dropout_residual_layer_norm(
+            h, residual, None, norm.weight, norm.bias, drop.p,
+            norm._epsilon, training=layer.training, mode=mode)
+    out = residual + drop(h)
+    return out if layer.normalize_before else norm(out)
+
+
 class TransformerEncoderLayer(Layer):
     """reference: nn/layer/transformer.py:437-621."""
 
@@ -144,6 +169,9 @@ class TransformerEncoderLayer(Layer):
         self.dropout2 = Dropout(dropout, mode="upscale_in_train")
         self.activation = getattr(F, activation)
 
+    def _tail(self, h, residual, drop, norm):
+        return _residual_tail(self, h, residual, drop, norm)
+
     def forward(self, src, src_mask=None, cache=None):
         src_mask = _convert_attention_mask(src_mask, src.dtype)
         residual = src
@@ -154,16 +182,12 @@ class TransformerEncoderLayer(Layer):
         else:
             src, incremental_cache = self.self_attn(src, src, src, src_mask,
                                                     cache)
-        src = residual + self.dropout1(src)
-        if not self.normalize_before:
-            src = self.norm1(src)
+        src = self._tail(src, residual, self.dropout1, self.norm1)
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
         src = self.linear2(self.dropout(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
-        if not self.normalize_before:
-            src = self.norm2(src)
+        src = self._tail(src, residual, self.dropout2, self.norm2)
         return src if cache is None else (src, incremental_cache)
 
     def gen_cache(self, src):
@@ -249,9 +273,8 @@ class TransformerDecoderLayer(Layer):
         else:
             tgt, incremental_cache = self.self_attn(tgt, tgt, tgt, tgt_mask,
                                                     cache[0])
-        tgt = residual + self.dropout1(tgt)
-        if not self.normalize_before:
-            tgt = self.norm1(tgt)
+        tgt = _residual_tail(self, tgt, residual, self.dropout1,
+                             self.norm1)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
@@ -260,16 +283,14 @@ class TransformerDecoderLayer(Layer):
         else:
             tgt, static_cache = self.cross_attn(tgt, memory, memory,
                                                 memory_mask, cache[1])
-        tgt = residual + self.dropout2(tgt)
-        if not self.normalize_before:
-            tgt = self.norm2(tgt)
+        tgt = _residual_tail(self, tgt, residual, self.dropout2,
+                             self.norm2)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm3(tgt)
         tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
-        tgt = residual + self.dropout3(tgt)
-        if not self.normalize_before:
-            tgt = self.norm3(tgt)
+        tgt = _residual_tail(self, tgt, residual, self.dropout3,
+                             self.norm3)
         return tgt if cache is None else (tgt, (incremental_cache,
                                                 static_cache))
 

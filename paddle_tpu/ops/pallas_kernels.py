@@ -401,6 +401,8 @@ def _fbdrln_rng_bits(rng_ref, shape, has_rng):
 def _fbdrln_fwd_kernel(rng_ref, x_ref, res_ref, bias_ref, gamma_ref,
                        beta_ref, y_ref, z_ref, *, p, scale, eps, has_rng,
                        with_ln):
+    """with_ln=False passes z_ref=None: the no-LN tail has ONE output (z);
+    writing a duplicate y would double the HBM write traffic."""
     x = x_ref[...].astype(jnp.float32)                    # [bn, H]
     res = res_ref[...].astype(jnp.float32)
     h = x + bias_ref[...].astype(jnp.float32)             # bias [1, H]
@@ -408,16 +410,24 @@ def _fbdrln_fwd_kernel(rng_ref, x_ref, res_ref, bias_ref, gamma_ref,
         bits = _fbdrln_rng_bits(rng_ref, h.shape, has_rng)
         h = _dropout_keep(bits, h, p, scale)
     z = res + h
-    z_ref[...] = z.astype(z_ref.dtype)
-    if with_ln:
-        mean = jnp.mean(z, axis=1, keepdims=True)
-        var = jnp.mean((z - mean) ** 2, axis=1, keepdims=True)
-        rstd = jax.lax.rsqrt(var + eps)
-        y = ((z - mean) * rstd * gamma_ref[...].astype(jnp.float32)
-             + beta_ref[...].astype(jnp.float32))
-        y_ref[...] = y.astype(y_ref.dtype)
-    else:
+    if not with_ln:
         y_ref[...] = z.astype(y_ref.dtype)
+        return
+    z_ref[...] = z.astype(z_ref.dtype)
+    mean = jnp.mean(z, axis=1, keepdims=True)
+    var = jnp.mean((z - mean) ** 2, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = ((z - mean) * rstd * gamma_ref[...].astype(jnp.float32)
+         + beta_ref[...].astype(jnp.float32))
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _fbdrln_fwd_noln_kernel(rng_ref, x_ref, res_ref, bias_ref, gamma_ref,
+                            beta_ref, out_ref, *, p, scale, eps, has_rng,
+                            with_ln):
+    _fbdrln_fwd_kernel(rng_ref, x_ref, res_ref, bias_ref, gamma_ref,
+                       beta_ref, out_ref, None, p=p, scale=scale, eps=eps,
+                       has_rng=has_rng, with_ln=False)
 
 
 def _fbdrln_bwd_kernel(rng_ref, z_ref, dy_ref, dz_extra_ref, gamma_ref,
@@ -497,10 +507,18 @@ def _fbdrln_vjp_fwd(x2d, res2d, bias, gamma, beta, key, p, scale, eps,
     with_ln = gamma is not None
     g2 = gamma if with_ln else jnp.ones((1, 1), x2d.dtype)
     b2 = beta if with_ln else jnp.zeros((1, 1), x2d.dtype)
-    y, z = _fbdrln_call(
-        _fbdrln_fwd_kernel, 2, rng, [x2d, res2d, bias, g2, b2],
-        [x2d.dtype, x2d.dtype], p=p, scale=scale, eps=eps, has_rng=has_rng,
-        with_ln=with_ln, interpret=interpret)
+    if with_ln:
+        y, z = _fbdrln_call(
+            _fbdrln_fwd_kernel, 2, rng, [x2d, res2d, bias, g2, b2],
+            [x2d.dtype, x2d.dtype], p=p, scale=scale, eps=eps,
+            has_rng=has_rng, with_ln=True, interpret=interpret)
+    else:
+        # no-LN: y IS z — single kernel output, half the HBM writes
+        (z,) = _fbdrln_call(
+            _fbdrln_fwd_noln_kernel, 1, rng, [x2d, res2d, bias, g2, b2],
+            [x2d.dtype], p=p, scale=scale, eps=eps, has_rng=has_rng,
+            with_ln=False, interpret=interpret)
+        y = z
     return (y, z), (z, gamma, rng, key)
 
 

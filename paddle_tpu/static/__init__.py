@@ -180,3 +180,11 @@ class nn:  # namespace mirror of paddle.static.nn (reference: static/nn/)
     while_loop = staticmethod(while_loop)
     case = staticmethod(case)
     switch_case = staticmethod(switch_case)
+
+
+from ..nn import functional as _F  # noqa: E402
+
+for _sname in ("sequence_pad", "sequence_unpad", "sequence_reverse",
+               "sequence_softmax", "sequence_pool", "sequence_expand"):
+    setattr(nn, _sname, staticmethod(getattr(_F, _sname)))
+del _sname

@@ -239,6 +239,19 @@ SPECS = {
         lambda rs: np.full((4,), 0.5, np.float32)],
         attrs=dict(code_type="encode_center_size", box_normalized=True,
                    axis=0)),
+    # sequence ops: (padded values, lengths) idiom
+    "sequence_reverse_op": dict(in_=[U(-1, 1, (3, 4)),
+                                     lambda rs: np.array([3, 1, 4],
+                                                         np.int64)],
+                                grad=[0]),
+    "sequence_softmax_op": dict(in_=[U(-1, 1, (3, 4)),
+                                     lambda rs: np.array([3, 1, 4],
+                                                         np.int64)],
+                                grad=[0]),
+    "sequence_pool_op": dict(in_=[U(-1, 1, (3, 4)),
+                                  lambda rs: np.array([3, 1, 4],
+                                                      np.int64)],
+                             attrs=dict(pool_type="average"), grad=[0]),
     # signal (real)
     "frame": dict(in_=[U(-1, 1, (16,))],
                   attrs=dict(frame_length=8, hop_length=4)),

@@ -1,0 +1,71 @@
+"""Sequence ops over the (padded, lengths) idiom (reference:
+operators/sequence_ops/ — the SURVEY §7 LoD → mask translation)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _lens():
+    return paddle.to_tensor(np.array([3, 1, 4], np.int64))
+
+
+class TestSequenceOps:
+    def test_pad_unpad_roundtrip(self):
+        flat = paddle.to_tensor(
+            np.arange(16, dtype=np.float32).reshape(8, 2))
+        padded, lens = F.sequence_pad(flat, paddle.to_tensor(
+            np.zeros((2,), np.float32)), lengths=_lens())
+        assert padded.shape == [3, 4, 2]
+        np.testing.assert_array_equal(padded.numpy()[1, 1:], 0.0)
+        back = F.sequence_unpad(padded, lens)
+        np.testing.assert_array_equal(back.numpy(), flat.numpy())
+
+    def test_reverse_keeps_padding(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        out = F.sequence_reverse(x, _lens()).numpy()
+        np.testing.assert_array_equal(out[0], [2, 1, 0, 3])   # len 3
+        np.testing.assert_array_equal(out[1], [4, 5, 6, 7])   # len 1
+        np.testing.assert_array_equal(out[2], [11, 10, 9, 8])  # len 4
+
+    def test_softmax_masks_padding(self):
+        x = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        out = F.sequence_softmax(x, _lens()).numpy()
+        np.testing.assert_allclose(out[0], [1 / 3, 1 / 3, 1 / 3, 0],
+                                   atol=1e-6)
+        np.testing.assert_allclose(out[1], [1, 0, 0, 0], atol=1e-6)
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-6)
+
+    @pytest.mark.parametrize("pool,expect", [
+        ("sum", [3.0, 4.0, 38.0]),
+        ("average", [1.0, 4.0, 9.5]),
+        ("max", [2.0, 4.0, 11.0]),
+        ("first", [0.0, 4.0, 8.0]),
+        ("last", [2.0, 4.0, 11.0]),
+    ])
+    def test_pool_modes(self, pool, expect):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        out = F.sequence_pool(x, pool, _lens()).numpy()
+        np.testing.assert_allclose(out, expect, atol=1e-6)
+
+    def test_expand(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+        out = F.sequence_expand(x, paddle.to_tensor(
+            np.array([2, 0, 3], np.int64)))
+        np.testing.assert_array_equal(out.numpy().ravel(),
+                                      [1, 1, 3, 3, 3])
+
+    def test_static_nn_namespace(self):
+        from paddle_tpu import static
+        assert static.nn.sequence_pool is not None
+        assert static.nn.sequence_pad is not None
+
+    def test_grads_through_masked_ops(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(3, 4)
+                             .astype(np.float32))
+        x.stop_gradient = False
+        F.sequence_pool(x, "average", _lens()).sum().backward()
+        g = x.grad.numpy()
+        np.testing.assert_allclose(g[1], [1.0, 0, 0, 0], atol=1e-6)
+        np.testing.assert_allclose(g[0], [1 / 3] * 3 + [0], atol=1e-6)
