@@ -37,6 +37,7 @@ from .framework.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace,
 # tensor + modes
 from .framework.tensor import Tensor, to_tensor
 from .framework.tensor import Parameter  # noqa: F401
+from .framework.selected_rows import SelectedRows  # noqa: F401
 from .framework.state import no_grad, in_dygraph_mode
 from .framework.random import seed, get_rng_state, set_rng_state
 from .framework.flags import get_flags, set_flags

@@ -22,6 +22,12 @@ from .tensor import Tensor
 # Process-global tape (reference: the autograd graph hanging off VarBases).
 GLOBAL_TAPE: List[TapeNode] = []
 
+# Ops with a registered row-sparse backward (reference: the is_sparse grad
+# kernels producing SelectedRows, e.g. lookup_table_v2_grad). Maps op name →
+# fn(in_arrays, cts, attrs) → per-input grads (SelectedRows or array or None),
+# aligned with the op's positional inputs.
+SPARSE_VJPS: Dict[str, object] = {}
+
 _TAPE_LIMIT = 1_000_000
 
 
@@ -86,7 +92,14 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
         if not any_ct:
             continue
 
-        if node.attr_key and len(node.attr_key) and node.attr_key[0] == "__raw__":
+        if node.name in SPARSE_VJPS:
+            attrs = (dict(node.attr_key[1])
+                     if node.attr_key and node.attr_key[0] == "__raw__"
+                     else dict(node.attr_key or ()))
+            all_grads = SPARSE_VJPS[node.name](node.in_arrays, tuple(cts),
+                                               attrs)
+            in_grads = tuple(g for g, m in zip(all_grads, node.need_mask) if m)
+        elif node.attr_key and len(node.attr_key) and node.attr_key[0] == "__raw__":
             # dynamic attrs: un-jitted vjp
             import jax as _jax
             attrs = dict(node.attr_key[1])
@@ -110,7 +123,7 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
             if not need:
                 continue
             g = next(gi)
-            if t is None or not _is_float(np.dtype(str(g.dtype)) if isinstance(g.dtype, str) else g.dtype):
+            if t is None or g is None or not _is_float(np.dtype(str(g.dtype)) if isinstance(g.dtype, str) else g.dtype):
                 continue
             _route_grad(t, g, grads)
 
@@ -124,6 +137,13 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
 
 
 def _route_grad(t: Tensor, g, grads: Dict[int, object]):
+    from .selected_rows import SelectedRows
+    if isinstance(g, SelectedRows) and (t._backward_hooks or t._node is not None):
+        # sparse cotangents are kept factored only on hook-free leaves
+        # (parameters); anything that flows further through the graph is
+        # densified — matching the reference, where SelectedRows grads only
+        # ever land on parameter grad slots.
+        g = g.to_dense()
     if t._backward_hooks:
         gt = Tensor(g, _internal=True)
         for hook in list(t._backward_hooks):
@@ -140,8 +160,19 @@ def _route_grad(t: Tensor, g, grads: Dict[int, object]):
 
 
 def _accumulate_leaf(t: Tensor, g):
+    from .selected_rows import SelectedRows
+    if isinstance(g, SelectedRows):
+        if t._grad is None:
+            t._grad = g
+        elif isinstance(t._grad, SelectedRows):
+            t._grad = t._grad.append(g)
+        else:
+            t._grad = Tensor(t._grad._data + g.to_dense(), _internal=True)
+        return
     if t._grad is None:
         t._grad = Tensor(g, _internal=True)
+    elif isinstance(t._grad, SelectedRows):
+        t._grad = Tensor(t._grad.to_dense() + g, _internal=True)
     else:
         t._grad = Tensor(t._grad._data + g, _internal=True)
 

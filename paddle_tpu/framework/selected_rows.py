@@ -1,0 +1,81 @@
+"""Row-sparse gradients — the TPU-native SelectedRows.
+
+Reference: paddle/fluid/framework/selected_rows.h:41 (rows_ + value_ +
+height_) and the sparse grad path of lookup_table_v2_grad
+(paddle/fluid/operators/lookup_table_v2_op.h, is_sparse branch).
+
+On TPU the win is the same as on GPU: an embedding backward over a huge
+vocabulary should not materialise a [V, D] dense cotangent when only a
+few thousand rows were touched. We keep the cotangent factored as
+(rows, values) on device; duplicate row ids are allowed and are folded
+in by scatter-add at apply time (XLA scatter accumulates duplicates
+natively, so SGD needs no merge pass at all). `merged()` compacts
+duplicates with a host-side unique + on-device segment-sum for the
+optimizers that index accumulator state by row (lazy Adam/AdamW).
+
+This is an EAGER-mode memory optimisation, exactly like the reference's
+``sparse=True``: under jit/pjit tracing the whole step fuses into one
+XLA module and grads stay dense (XLA turns them back into fused
+scatters), so the sparse tape path only engages on the eager tape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    """A row-sparse tensor: ``dense[rows[i]] += values[i]`` semantics."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32).reshape(-1)
+        values = jnp.asarray(values)
+        n = self.rows.shape[0]
+        if values.ndim == 0 or values.shape[0] != n:
+            values = values.reshape(n, -1)
+        self.values = values
+        self.height = int(height)
+
+    # -- shape/dtype façade (so generic code can introspect a .grad) --------
+    @property
+    def shape(self):
+        return [self.height] + list(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, nnz_rows="
+                f"{self.rows.shape[0]}, row_dim={tuple(self.values.shape[1:])})")
+
+    # -- conversions ---------------------------------------------------------
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.to_dense())
+
+    def merged(self) -> "SelectedRows":
+        """Fold duplicate row ids (host unique + device segment-sum)."""
+        rows_np = np.asarray(self.rows)
+        uniq, inv = np.unique(rows_np, return_inverse=True)
+        if uniq.shape[0] == rows_np.shape[0]:
+            return self
+        vals = jax.ops.segment_sum(self.values, jnp.asarray(inv, jnp.int32),
+                                   num_segments=int(uniq.shape[0]))
+        return SelectedRows(uniq, vals, self.height)
+
+    # -- accumulation (autograd's GradientAccumulator for sparse grads) -----
+    def append(self, other: "SelectedRows") -> "SelectedRows":
+        assert self.height == other.height, "height mismatch in sparse accum"
+        return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.height)

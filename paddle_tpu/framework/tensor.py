@@ -97,7 +97,13 @@ class Tensor:
 
     def clear_gradient(self, set_to_zero: bool = False):
         if set_to_zero and self._grad is not None:
-            self._grad = Tensor(jnp.zeros_like(self._grad._data), _internal=True)
+            if not isinstance(self._grad, Tensor):  # SelectedRows grad
+                self._grad = Tensor(jnp.zeros(self._grad.shape,
+                                              self._grad.dtype),
+                                    _internal=True)
+            else:
+                self._grad = Tensor(jnp.zeros_like(self._grad._data),
+                                    _internal=True)
         else:
             self._grad = None
 

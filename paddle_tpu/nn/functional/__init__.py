@@ -408,6 +408,14 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    if sparse:
+        from ...framework import state as _state
+        if not (_state.in_trace() or _state.in_static_mode()):
+            # eager: row-sparse backward (SelectedRows grad on `weight`)
+            return _nn.embedding_lookup_sparse(weight, x,
+                                               padding_idx=padding_idx)
+        # under jit/pjit/static tracing the step fuses into one XLA module
+        # and the dense cotangent becomes a fused scatter anyway
     return _nn.embedding_lookup(weight, x, padding_idx=padding_idx)
 
 

@@ -86,14 +86,18 @@ def sequence_pad(x, pad_value, maxlen=None, lengths=None, name=None):
     vals = np.asarray(raw(x))
     lens = np.asarray(raw(lengths)).astype(np.int64)
     T = int(maxlen) if maxlen is not None else int(lens.max(initial=0))
+    if lens.size and int(lens.max(initial=0)) > T:
+        # reference sequence_pad_op enforces padded_length >= max seq length
+        raise ValueError(
+            f"sequence_pad: maxlen={T} is smaller than the longest sequence "
+            f"({int(lens.max())})")
     pv = np.asarray(raw(pad_value))
     tail = vals.shape[1:]
     out = np.broadcast_to(pv, (len(lens), T) + tail).copy()
     off = 0
     for i, n in enumerate(lens):
-        n = min(int(n), T)
-        out[i, :n] = vals[off:off + int(lens[i])][:n]
-        off += int(lens[i])
+        out[i, :int(n)] = vals[off:off + int(n)]
+        off += int(n)
     return Tensor(out.astype(vals.dtype)), Tensor(lens)
 
 

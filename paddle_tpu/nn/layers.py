@@ -78,6 +78,7 @@ class Embedding(Layer):
         super().__init__()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
+        self._sparse = sparse
         self._padding_idx = (None if padding_idx is None else
                              padding_idx if padding_idx >= 0
                              else num_embeddings + padding_idx)
@@ -85,12 +86,13 @@ class Embedding(Layer):
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0))
         if self._padding_idx is not None:
-            arr = np.asarray(self.weight.numpy())
+            arr = np.array(self.weight.numpy())
             arr[self._padding_idx] = 0
             self.weight.set_value(arr)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
 
 class Flatten(Layer):

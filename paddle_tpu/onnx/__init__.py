@@ -1,17 +1,108 @@
-"""paddle.onnx shim (reference: python/paddle/onnx/export.py — a thin
-wrapper over the external paddle2onnx package). There is no paddle2onnx
-for this framework; the deployable interchange artifact is StableHLO
-(paddle_tpu.inference.Predictor.export_stablehlo), which is what TPU
-serving stacks consume. export() raises with that guidance."""
+"""paddle.onnx — native ONNX export.
+
+Reference: python/paddle/onnx/export.py, which shims to the external
+paddle2onnx tool (a ProgramDesc→ONNX op translator). We export natively
+instead: trace the layer to a jaxpr (the IR everything in this framework
+already lowers through), translate the closed set of lax primitives to
+ONNX ops, and serialise ModelProto with a dependency-free protobuf
+writer (see proto.py / jaxpr_export.py). Parameters are captured as
+initializers; the layer is traced in eval mode.
+"""
 from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "ONNX export is not supported by paddle_tpu (the reference shims "
-        "to the external paddle2onnx tool). Use paddle.jit.save for "
-        "python-reloadable deployment, or "
-        "paddle_tpu.inference.Predictor.export_stablehlo() for a portable "
-        "compiled artifact (StableHLO is the TPU-serving interchange).")
+def _example_arrays(layer, input_spec) -> List[np.ndarray]:
+    from ..framework.tensor import Tensor
+    from ..static.program import InputSpec
+    if input_spec is None:
+        raise ValueError(
+            "paddle.onnx.export needs input_spec (a list of InputSpec / "
+            "Tensor / ndarray examples) to trace the model")
+    arrays = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            arrays.append(np.asarray(spec.numpy()))
+        elif isinstance(spec, np.ndarray):
+            arrays.append(spec)
+        elif isinstance(spec, InputSpec) or hasattr(spec, "shape"):
+            shape = [1 if (d is None or d == -1) else int(d)
+                     for d in spec.shape]
+            dt = getattr(spec, "dtype", "float32") or "float32"
+            dt = getattr(dt, "name", dt)
+            arrays.append(np.zeros(shape, str(dt)))
+        else:
+            raise TypeError(f"unsupported input_spec entry: {spec!r}")
+    return arrays
+
+
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 13, output_spec=None, **configs):
+    """Trace `layer` (or a plain callable over Tensors) and write
+    `<path>.onnx`. Returns the written file path."""
+    import jax
+
+    from . import proto
+    from .jaxpr_export import _Converter
+    from ..framework import state
+    from ..framework.tensor import Tensor
+
+    if output_spec is not None:
+        raise NotImplementedError(
+            "paddle.onnx.export: output_spec pruning is not implemented — "
+            "export the full graph and select outputs at load time, or wrap "
+            "the layer to return only the wanted outputs")
+    if not 13 <= opset_version <= 17:
+        # the converter emits opset-13 operator forms (Slice/Pad with runtime
+        # inputs, Einsum, ReduceSum axes-as-input); those are valid through
+        # opset 17 but not before 13 or after the 18 reduce-op changes
+        raise ValueError(
+            f"opset_version={opset_version} unsupported: this exporter emits "
+            "opset 13-17 operator forms")
+    arrays = _example_arrays(layer, input_spec)
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        def pure(*in_arrs):
+            with state.trace_guard(), state.no_grad_guard():
+                out = layer(*[Tensor(a, _internal=True) for a in in_arrs])
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o._data if isinstance(o, Tensor) else o for o in outs]
+
+        closed = jax.make_jaxpr(pure)(*arrays)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    cv = _Converter(opset_version)
+    input_names = [f"input_{i}" for i in range(len(arrays))]
+    out_vals = cv.convert(closed.jaxpr, closed.consts, input_names)
+
+    out_names = []
+    for val in out_vals:
+        if isinstance(val, str):
+            out_names.append(val)
+        else:  # model output is a constant — still a legal graph output
+            name = cv.as_name(val, "const_out")
+            [alias] = cv.emit("Identity", [name])
+            out_names.append(alias)
+
+    g_inputs = [proto.value_info(n, a.dtype, a.shape)
+                for n, a in zip(input_names, arrays)]
+    g_outputs = [proto.value_info(n, v.aval.dtype, v.aval.shape)
+                 for n, v in zip(out_names, closed.jaxpr.outvars)]
+    graph = proto.graph(cv.nodes, "paddle_tpu_graph", cv.initializers,
+                        g_inputs, g_outputs)
+    blob = proto.model(graph, opset=opset_version)
+
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path

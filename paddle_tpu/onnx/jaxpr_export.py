@@ -1,0 +1,507 @@
+"""jaxpr → ONNX graph conversion.
+
+TPU-native take on the reference's paddle2onnx bridge
+(python/paddle/onnx/export.py): instead of walking a ProgramDesc op by op
+and maintaining a per-framework-op translation table, we trace the model
+once to a jaxpr — the same IR every compute path in this framework
+already lowers through — and translate the ~30 closed-set lax primitives
+that survive tracing. Anything outside the mapped set that is a pure
+function of constants (iota, eye, …) is constant-folded into an
+initializer at export time, since shapes are static under trace.
+
+Layers are exported in eval mode with parameters captured as
+initializers (the jaxpr's constvars), matching ONNX deployment
+semantics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+from . import proto
+
+# ---------------------------------------------------------------------------
+
+
+class _Converter:
+    def __init__(self, opset: int = 13):
+        self.opset = opset
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self._init_names: Dict[tuple, str] = {}
+        self._counter = [0]
+        # var id -> graph value name (str) OR numpy constant
+        self.env: Dict[int, object] = {}
+
+    # -- naming / env -------------------------------------------------------
+    def fresh(self, hint: str = "v") -> str:
+        self._counter[0] += 1
+        return f"{hint}_{self._counter[0]}"
+
+    def read(self, v):
+        from jax.extend.core import Literal
+        if isinstance(v, Literal):
+            return np.asarray(v.val)
+        return self.env[id(v)]
+
+    def write(self, v, value):
+        self.env[id(v)] = value
+
+    def as_name(self, value, hint: str = "c") -> str:
+        """Graph name for a value; constants become initializers (deduped)."""
+        if isinstance(value, str):
+            return value
+        arr = np.asarray(value)
+        key = (arr.tobytes(), str(arr.dtype), arr.shape)
+        if key not in self._init_names:
+            name = self.fresh(hint)
+            self._init_names[key] = name
+            self.initializers.append(proto.tensor_proto(name, arr))
+        return self._init_names[key]
+
+    def emit(self, op_type: str, inputs, n_out: int = 1, out_hint=None,
+             **attrs) -> List[str]:
+        in_names = [self.as_name(i) for i in inputs]
+        outs = [self.fresh(out_hint or op_type.lower())
+                for _ in range(n_out)]
+        self.nodes.append(proto.node(op_type, in_names, outs, **attrs))
+        return outs
+
+    def const_i64(self, values) -> str:
+        return self.as_name(np.asarray(values, np.int64), "shape")
+
+    # -- eqn dispatch -------------------------------------------------------
+    def convert(self, jaxpr, consts, input_names):
+        for v, c in zip(jaxpr.constvars, consts):
+            self.write(v, np.asarray(c))
+        for v, name in zip(jaxpr.invars, input_names):
+            self.write(v, name)
+        self._run(jaxpr)
+        return [self.read(v) for v in jaxpr.outvars]
+
+    def _run(self, jaxpr):
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn)
+
+    def _eqn(self, eqn):
+        prim = eqn.primitive.name
+        ins = [self.read(v) for v in eqn.invars]
+
+        # inline call-like primitives (pjit, custom_jvp/vjp, remat, ...)
+        sub = _subjaxpr(eqn)
+        if sub is not None:
+            inner, inner_consts = sub
+            names = []
+            for x in ins:
+                names.append(x)
+            for v, c in zip(inner.constvars, inner_consts):
+                self.write(v, np.asarray(c))
+            for v, x in zip(inner.invars, names):
+                self.write(v, x)
+            self._run(inner)
+            for outer_v, inner_v in zip(eqn.outvars, inner.outvars):
+                self.write(outer_v, self.read(inner_v))
+            return
+
+        # constant folding: every input known -> evaluate eagerly
+        if all(not isinstance(x, str) for x in ins):
+            vals = eqn.primitive.bind(
+                *[np.asarray(x) for x in ins], **eqn.params)
+            if not eqn.primitive.multiple_results:
+                vals = [vals]
+            for v, val in zip(eqn.outvars, vals):
+                self.write(v, np.asarray(val))
+            return
+
+        handler = _HANDLERS.get(prim)
+        if handler is None:
+            raise NotImplementedError(
+                f"ONNX export: unmapped primitive '{prim}' with non-constant "
+                f"inputs (params={list(eqn.params)}). Extend _HANDLERS in "
+                "paddle_tpu/onnx/jaxpr_export.py or restructure the model.")
+        outs = handler(self, eqn, ins)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for v, o in zip(eqn.outvars, outs):
+            self.write(v, o)
+
+
+def _subjaxpr(eqn):
+    p = eqn.params
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in p:
+            j = p[key]
+            if hasattr(j, "jaxpr"):  # ClosedJaxpr
+                return j.jaxpr, j.consts
+            return j, ()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# primitive handlers
+
+
+_HANDLERS = {}
+
+
+def _handles(*names):
+    def deco(fn):
+        for n in names:
+            _HANDLERS[n] = fn
+        return fn
+    return deco
+
+
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "max": "Max",
+    "min": "Min", "pow": "Pow", "neg": "Neg", "exp": "Exp", "log": "Log",
+    "tanh": "Tanh", "abs": "Abs", "sqrt": "Sqrt", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round", "erf": "Erf",
+    "logistic": "Sigmoid", "sin": "Sin", "cos": "Cos", "and": "And",
+    "or": "Or", "xor": "Xor", "not": "Not", "rem": "Mod",
+}
+
+
+@_handles(*_ELEMENTWISE)
+def _ew(cv, eqn, ins):
+    [o] = cv.emit(_ELEMENTWISE[eqn.primitive.name], ins)
+    return o
+
+
+@_handles("rsqrt")
+def _rsqrt(cv, eqn, ins):
+    [s] = cv.emit("Sqrt", ins)
+    return cv.emit("Reciprocal", [s])[0]
+
+
+@_handles("square")
+def _square(cv, eqn, ins):
+    return cv.emit("Mul", [ins[0], ins[0]])[0]
+
+
+@_handles("erfc")
+def _erfc(cv, eqn, ins):
+    [e] = cv.emit("Erf", ins)
+    one = np.asarray(1.0, eqn.invars[0].aval.dtype)
+    return cv.emit("Sub", [one, e])[0]
+
+
+@_handles("log1p")
+def _log1p(cv, eqn, ins):
+    one = np.asarray(1.0, eqn.invars[0].aval.dtype)
+    [a] = cv.emit("Add", [ins[0], one])
+    return cv.emit("Log", [a])[0]
+
+
+@_handles("expm1")
+def _expm1(cv, eqn, ins):
+    [e] = cv.emit("Exp", ins)
+    one = np.asarray(1.0, eqn.invars[0].aval.dtype)
+    return cv.emit("Sub", [e, one])[0]
+
+
+@_handles("integer_pow")
+def _ipow(cv, eqn, ins):
+    y = eqn.params["y"]
+    if y == 2:
+        return cv.emit("Mul", [ins[0], ins[0]])[0]
+    exp = np.asarray(float(y), eqn.invars[0].aval.dtype)
+    return cv.emit("Pow", [ins[0], exp])[0]
+
+
+@_handles("stop_gradient", "copy")
+def _identity(cv, eqn, ins):
+    return cv.emit("Identity", ins)[0]
+
+
+@_handles("eq", "ne", "lt", "le", "gt", "ge")
+def _cmp(cv, eqn, ins):
+    name = eqn.primitive.name
+    if name == "eq":
+        return cv.emit("Equal", ins)[0]
+    if name == "ne":
+        [e] = cv.emit("Equal", ins)
+        return cv.emit("Not", [e])[0]
+    table = {"lt": "Less", "le": "LessOrEqual", "gt": "Greater",
+             "ge": "GreaterOrEqual"}
+    return cv.emit(table[name], ins)[0]
+
+
+@_handles("select_n")
+def _select(cv, eqn, ins):
+    if len(ins) != 3:
+        raise NotImplementedError("select_n with >2 cases")
+    # lax.select_n(pred, on_false, on_true); ONNX Where(cond, X=true, Y=false)
+    return cv.emit("Where", [ins[0], ins[2], ins[1]])[0]
+
+
+@_handles("convert_element_type")
+def _cast(cv, eqn, ins):
+    to = proto.dtype_code(np.dtype(eqn.params["new_dtype"])
+                          if "bfloat16" not in str(eqn.params["new_dtype"])
+                          else "bfloat16")
+    return cv.emit("Cast", ins, to=to)[0]
+
+
+@_handles("reshape")
+def _reshape(cv, eqn, ins):
+    shape = cv.const_i64(eqn.outvars[0].aval.shape)
+    return cv.emit("Reshape", [ins[0], shape])[0]
+
+
+@_handles("squeeze")
+def _squeeze(cv, eqn, ins):
+    shape = cv.const_i64(eqn.outvars[0].aval.shape)
+    return cv.emit("Reshape", [ins[0], shape])[0]
+
+
+@_handles("expand_dims")
+def _expand_dims(cv, eqn, ins):
+    shape = cv.const_i64(eqn.outvars[0].aval.shape)
+    return cv.emit("Reshape", [ins[0], shape])[0]
+
+
+@_handles("transpose")
+def _transpose(cv, eqn, ins):
+    return cv.emit("Transpose", ins,
+                   perm=list(eqn.params["permutation"]))[0]
+
+
+@_handles("broadcast_in_dim")
+def _bcast(cv, eqn, ins):
+    out_shape = eqn.params["shape"]
+    bdims = eqn.params["broadcast_dimensions"]
+    in_aval = eqn.invars[0].aval
+    interim = [1] * len(out_shape)
+    for i, d in enumerate(bdims):
+        interim[d] = in_aval.shape[i]
+    x = ins[0]
+    if tuple(interim) != tuple(in_aval.shape):
+        x = cv.emit("Reshape", [x, cv.const_i64(interim)])[0]
+    if tuple(interim) == tuple(out_shape):
+        return x if isinstance(x, str) else cv.emit("Identity", [x])[0]
+    return cv.emit("Expand", [x, cv.const_i64(out_shape)])[0]
+
+
+@_handles("concatenate")
+def _concat(cv, eqn, ins):
+    return cv.emit("Concat", ins, axis=int(eqn.params["dimension"]))[0]
+
+
+@_handles("slice")
+def _slice(cv, eqn, ins):
+    p = eqn.params
+    starts = cv.const_i64(p["start_indices"])
+    ends = cv.const_i64(p["limit_indices"])
+    axes = cv.const_i64(list(range(len(p["start_indices"]))))
+    strides = p["strides"] or [1] * len(p["start_indices"])
+    steps = cv.const_i64(strides)
+    return cv.emit("Slice", [ins[0], starts, ends, axes, steps])[0]
+
+
+@_handles("rev")
+def _rev(cv, eqn, ins):
+    shape = eqn.invars[0].aval.shape
+    dims = eqn.params["dimensions"]
+    starts = cv.const_i64([shape[d] - 1 for d in dims])
+    ends = cv.const_i64([-(shape[d] + 1) for d in dims])
+    axes = cv.const_i64(list(dims))
+    steps = cv.const_i64([-1] * len(dims))
+    return cv.emit("Slice", [ins[0], starts, ends, axes, steps])[0]
+
+
+@_handles("pad")
+def _pad(cv, eqn, ins):
+    cfg = eqn.params["padding_config"]
+    if any(i != 0 for _, _, i in cfg):
+        raise NotImplementedError("interior padding in ONNX export")
+    lo = [l for l, _, _ in cfg]
+    hi = [h for _, h, _ in cfg]
+    pads = cv.const_i64(lo + hi)
+    return cv.emit("Pad", [ins[0], pads, ins[1]])[0]
+
+
+def _reduce(cv, eqn, ins, op):
+    axes = [int(a) for a in eqn.params["axes"]]
+    if op == "ReduceSum":  # axes moved to input at opset 13
+        return cv.emit(op, [ins[0], cv.const_i64(axes)], keepdims=0)[0]
+    return cv.emit(op, [ins[0]], axes=axes, keepdims=0)[0]
+
+
+@_handles("reduce_sum")
+def _rsum(cv, eqn, ins):
+    return _reduce(cv, eqn, ins, "ReduceSum")
+
+
+@_handles("reduce_max")
+def _rmax(cv, eqn, ins):
+    return _reduce(cv, eqn, ins, "ReduceMax")
+
+
+@_handles("reduce_min")
+def _rmin(cv, eqn, ins):
+    return _reduce(cv, eqn, ins, "ReduceMin")
+
+
+@_handles("reduce_prod")
+def _rprod(cv, eqn, ins):
+    return _reduce(cv, eqn, ins, "ReduceProd")
+
+
+@_handles("reduce_and")
+def _rand(cv, eqn, ins):
+    [x] = cv.emit("Cast", [ins[0]], to=6)
+    r = _reduce(cv, eqn, [x], "ReduceMin")
+    return cv.emit("Cast", [r], to=9)[0]
+
+
+@_handles("reduce_or")
+def _ror(cv, eqn, ins):
+    [x] = cv.emit("Cast", [ins[0]], to=6)
+    r = _reduce(cv, eqn, [x], "ReduceMax")
+    return cv.emit("Cast", [r], to=9)[0]
+
+
+@_handles("argmax", "argmin")
+def _argmax(cv, eqn, ins):
+    op = "ArgMax" if eqn.primitive.name == "argmax" else "ArgMin"
+    axes = eqn.params["axes"]
+    [r] = cv.emit(op, ins, axis=int(axes[0]), keepdims=0)
+    code = proto.dtype_code(np.dtype(eqn.params["index_dtype"]))
+    if code != 7:
+        r = cv.emit("Cast", [r], to=code)[0]
+    return r
+
+
+@_handles("dot_general")
+def _dot(cv, eqn, ins):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    # plain matmul: contract lhs last dim with rhs first non-batch dim
+    simple = (list(lb) == list(range(len(lb)))
+              and list(rb) == list(range(len(rb)))
+              and list(lc) == [lhs.ndim - 1]
+              and list(rc) == [len(rb)])
+    if simple:
+        return cv.emit("MatMul", ins)[0]
+    # general contraction -> Einsum (opset >= 12)
+    letters = "abcdefghijklmnopqrstuvwxyz"
+    it = iter(letters)
+    l_sub = [None] * lhs.ndim
+    r_sub = [None] * rhs.ndim
+    for i, (la, ra) in enumerate(zip(lb, rb)):
+        c = next(it)
+        l_sub[la] = c
+        r_sub[ra] = c
+    for la, ra in zip(lc, rc):
+        c = next(it)
+        l_sub[la] = c
+        r_sub[ra] = c
+    out = []
+    for i in range(lhs.ndim):
+        if l_sub[i] is None:
+            l_sub[i] = next(it)
+            out.append(l_sub[i])
+    r_out = []
+    for i in range(rhs.ndim):
+        if r_sub[i] is None:
+            r_sub[i] = next(it)
+            r_out.append(r_sub[i])
+    batch = [l_sub[b] for b in lb]
+    eqn_s = (f"{''.join(l_sub)},{''.join(r_sub)}->"
+             f"{''.join(batch + out + r_out)}")
+    return cv.emit("Einsum", ins, equation=eqn_s)[0]
+
+
+@_handles("conv_general_dilated")
+def _conv(cv, eqn, ins):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    nd = eqn.invars[0].aval.ndim
+    nchw = tuple(range(nd))
+    if (tuple(dn.lhs_spec) != nchw or tuple(dn.out_spec) != nchw
+            or tuple(dn.rhs_spec) != nchw):
+        raise NotImplementedError(
+            "ONNX export supports channel-first (NCHW/OIHW) convs only")
+    pads = list(p["padding"])
+    lo = [a for a, _ in pads]
+    hi = [b for _, b in pads]
+    attrs = dict(strides=list(p["window_strides"]),
+                 dilations=list(p["rhs_dilation"]),
+                 pads=lo + hi, group=int(p["feature_group_count"]))
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise NotImplementedError(
+            "transposed conv (lhs_dilation) in ONNX export")
+    return cv.emit("Conv", ins, **attrs)[0]
+
+
+def _window_attrs(eqn):
+    p = eqn.params
+    wd = p["window_dimensions"]
+    ws = p["window_strides"]
+    pad = p["padding"]
+    if (wd[0] != 1 or wd[1] != 1 or ws[0] != 1 or ws[1] != 1
+            or pad[0] != (0, 0) or pad[1] != (0, 0)):
+        raise NotImplementedError("reduce_window over batch/channel dims")
+    if any(d != 1 for d in p.get("base_dilation", ())) or \
+       any(d != 1 for d in p.get("window_dilation", ())):
+        raise NotImplementedError("dilated pooling in ONNX export")
+    k = list(wd[2:])
+    s = list(ws[2:])
+    lo = [a for a, _ in pad[2:]]
+    hi = [b for _, b in pad[2:]]
+    return k, s, lo + hi
+
+
+@_handles("reduce_window_max")
+def _maxpool(cv, eqn, ins):
+    k, s, pads = _window_attrs(eqn)
+    return cv.emit("MaxPool", ins, kernel_shape=k, strides=s, pads=pads)[0]
+
+
+@_handles("reduce_window_sum")
+def _sumpool(cv, eqn, ins):
+    k, s, pads = _window_attrs(eqn)
+    [avg] = cv.emit("AveragePool", ins, kernel_shape=k, strides=s, pads=pads,
+                    count_include_pad=1)
+    scale = np.asarray(float(np.prod(k)), eqn.invars[0].aval.dtype)
+    return cv.emit("Mul", [avg, scale])[0]
+
+
+@_handles("gather")
+def _gather(cv, eqn, ins):
+    p = eqn.params
+    dnums = p["dimension_numbers"]
+    operand = eqn.invars[0].aval
+    indices = eqn.invars[1].aval
+    ok = (tuple(dnums.collapsed_slice_dims) == (0,)
+          and tuple(dnums.start_index_map) == (0,)
+          and not getattr(dnums, "operand_batching_dims", ())
+          and indices.shape[-1] == 1
+          and tuple(p["slice_sizes"]) == (1,) + tuple(operand.shape[1:]))
+    if not ok:
+        raise NotImplementedError(
+            "ONNX export handles axis-0 take-style gather only "
+            f"(got {dnums}, slice_sizes={p['slice_sizes']})")
+    idx_shape = list(indices.shape[:-1])
+    idx = cv.emit("Reshape", [ins[1], cv.const_i64(idx_shape)])[0]
+    return cv.emit("Gather", [ins[0], idx], axis=0)[0]
+
+
+@_handles("iota")
+def _iota(cv, eqn, ins):
+    # no operand inputs -> always constant-foldable
+    p = eqn.params
+    out = np.asarray(jax.lax.iota(p["dtype"], p["shape"][p["dimension"]]))
+    shape = [1] * len(p["shape"])
+    shape[p["dimension"]] = p["shape"][p["dimension"]]
+    return cv.as_name(np.broadcast_to(out.reshape(shape), p["shape"]).copy())
+
+
+@_handles("clamp")
+def _clamp(cv, eqn, ins):
+    # lax.clamp(min, x, max)
+    [x] = cv.emit("Max", [ins[1], ins[0]])
+    return cv.emit("Min", [x, ins[2]])[0]

@@ -277,10 +277,30 @@ def pool(x, *, pool_type="max", kernel=(2, 2), stride=(2, 2), padding=(0, 0),
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, dims, strides, padcfg)
     # avg pool
-    ones = jnp.ones_like(x)
     s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padcfg)
     if exclusive:
-        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padcfg)
+        # Per-position divisor (count of non-pad elements in each window) is a
+        # static function of the shapes — build it with numpy at trace time so
+        # XLA never has to fold a reduce_window over a ones tensor (which is
+        # pathologically slow for the constant folder on large activations).
+        sp_axes = (range(1, 1 + nsp) if channel_last
+                   else range(2, 2 + nsp))
+        per_axis = []
+        for i, ax in enumerate(sp_axes):
+            size = x.shape[ax]
+            lo, hi = padcfg[ax]
+            k, st = kernel[i], stride[i]
+            n_out = (size + lo + hi - k) // st + 1
+            start = np.arange(n_out) * st - lo
+            c = np.minimum(start + k, size) - np.maximum(start, 0)
+            per_axis.append(np.maximum(c, 1))
+        cnt_sp = per_axis[0]
+        for c in per_axis[1:]:
+            cnt_sp = cnt_sp[..., None] * c
+        shape = ((1,) + cnt_sp.shape + (1,) if channel_last
+                 else (1, 1) + cnt_sp.shape)
+        cnt = jnp.asarray(cnt_sp.reshape(shape).astype(np.float32),
+                          dtype=s.dtype)
     else:
         cnt = float(np.prod(kernel))
     return s / cnt
@@ -493,6 +513,36 @@ def embedding_lookup(weight, ids, *, padding_idx=None):
         mask = (ids == padding_idx)[..., None]
         out = jnp.where(mask, 0.0, out)
     return out
+
+
+@primitive("lookup_table_v2_sparse")
+def embedding_lookup_sparse(weight, ids, *, padding_idx=None):
+    """Same forward as lookup_table_v2; its tape backward (registered in
+    framework.autograd.SPARSE_VJPS) emits a row-sparse SelectedRows
+    cotangent for `weight` instead of a dense [V, D] scatter — the
+    reference's is_sparse branch of lookup_table_v2_grad
+    (paddle/fluid/operators/lookup_table_v2_op.h)."""
+    return embedding_lookup.fn(weight, ids, padding_idx=padding_idx)
+
+
+def _embedding_sparse_vjp(in_arrays, cts, attrs):
+    from ..framework.selected_rows import SelectedRows
+    weight, ids = in_arrays
+    ct = cts[0]
+    padding_idx = attrs.get("padding_idx")
+    rows = ids.astype(jnp.int32).reshape(-1)
+    vals = ct.reshape(-1, ct.shape[-1]).astype(weight.dtype)
+    if padding_idx is not None and padding_idx >= 0:
+        vals = jnp.where((rows == padding_idx)[:, None], 0.0, vals)
+    return (SelectedRows(rows, vals, weight.shape[0]), None)
+
+
+def _register_sparse_vjps():
+    from ..framework.autograd import SPARSE_VJPS
+    SPARSE_VJPS["lookup_table_v2_sparse"] = _embedding_sparse_vjp
+
+
+_register_sparse_vjps()
 
 
 @primitive("one_hot_v2", nondiff=True)

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import state
+from ..framework.selected_rows import SelectedRows
 from ..framework.tensor import Parameter, Tensor
 from .lr import LRScheduler
 from . import lr  # noqa: F401
@@ -145,12 +146,25 @@ class Optimizer:
         if params is None:
             raise ValueError("optimizer constructed without parameters")
         params_grads = []
+        sparse_grads = []
         for p in params:
             if not getattr(p, "trainable", True) or p.stop_gradient:
                 continue
             if p._grad is None:
                 continue
-            g = p._grad._data
+            if isinstance(p._grad, SelectedRows):
+                # row-sparse grad (Embedding(sparse=True)); regularizers and
+                # clipping need the dense view — only the bare path stays
+                # factored (matches the reference, which forbids weight decay
+                # on SelectedRows grads)
+                if (self._regularization is None
+                        and getattr(p, "regularizer", None) is None
+                        and self._grad_clip is None):
+                    sparse_grads.append((p, p._grad))
+                    continue
+                g = p._grad.to_dense()
+            else:
+                g = p._grad._data
             if self._regularization is not None and getattr(p, "regularizer", None) is None:
                 g = self._regularization(p._data, g)
             elif getattr(p, "regularizer", None) is not None:
@@ -164,6 +178,16 @@ class Optimizer:
             accs = self._get_accumulators(p)
             param_lr = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             self._apply_one(p, g, lr * param_lr, accs)
+        for p, sr in sparse_grads:
+            accs = self._get_accumulators(p)
+            param_lr = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+            self._apply_one_sparse(p, sr, lr * param_lr, accs)
+
+    def _apply_one_sparse(self, p, sr: "SelectedRows", lr, accs):
+        """Default: densify (XLA fuses the scatter); SGD/lazy-Adam override
+        with true row-wise updates (reference: the SelectedRows branches of
+        sgd_op.h / adam_op.h)."""
+        self._apply_one(p, sr.to_dense(), lr, accs)
 
     def _apply_one(self, p, g, lr, accs):
         names = self._accumulator_names
@@ -267,6 +291,38 @@ def _update_exec(cls, static_args):
 # operators/optimizers/*.cc kernels)
 
 
+@functools.lru_cache(maxsize=None)
+def _sgd_sparse_exec():
+    def fn(param, rows, vals, lr):
+        return param.at[rows].add((-lr * vals).astype(param.dtype))
+
+    # XLA scatter-add folds duplicate rows natively — no merge pass needed
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _adam_lazy_exec(b1, b2, eps, coeff):
+    """Lazy (row-wise) Adam/AdamW on merged SelectedRows (reference:
+    adam_op.h SparseAdamFunctor with lazy_mode=true — moments decay and the
+    param moves ONLY on touched rows)."""
+
+    def fn(param, rows, vals, lr, t, m1, m2):
+        g = vals.astype(jnp.float32)
+        p_rows = param[rows].astype(jnp.float32)
+        if coeff:
+            p_rows = p_rows * (1.0 - lr * coeff)
+        m1r = b1 * m1[rows] + (1 - b1) * g
+        m2r = b2 * m2[rows] + (1 - b2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        c1 = 1 - jnp.power(jnp.float32(b1), tf)
+        c2 = 1 - jnp.power(jnp.float32(b2), tf)
+        step = lr * (m1r / c1) / (jnp.sqrt(m2r / c2) + eps)
+        return (param.at[rows].set((p_rows - step).astype(param.dtype)),
+                m1.at[rows].set(m1r), m2.at[rows].set(m2r))
+
+    return jax.jit(fn, donate_argnums=(0, 5, 6))
+
+
 class SGD(Optimizer):
     _accumulator_names = []
 
@@ -274,6 +330,10 @@ class SGD(Optimizer):
     def _update_rule(static_args, param, grad, lr, t):
         g = grad.astype(param.dtype)
         return (param - lr * g,)
+
+    def _apply_one_sparse(self, p, sr, lr, accs):
+        p._data = _sgd_sparse_exec()(p._data, sr.rows, sr.values,
+                                     np.float32(lr))
 
 
 class Momentum(Optimizer):
@@ -357,9 +417,26 @@ class Adam(Optimizer):
         self._beta1 = float(beta1)
         self._beta2 = float(beta2)
         self._epsilon = float(epsilon)
+        self._lazy_mode = bool(lazy_mode)
 
     def _static_args(self):
         return (self._beta1, self._beta2, self._epsilon)
+
+    def _sparse_decay_coeff(self, p):
+        return 0.0
+
+    def _apply_one_sparse(self, p, sr, lr, accs):
+        if not self._lazy_mode:
+            # non-lazy semantics: moments decay on EVERY row — same as a
+            # dense update with zero grads on untouched rows
+            return self._apply_one(p, sr.to_dense(), lr, accs)
+        sr = sr.merged()
+        fn = _adam_lazy_exec(self._beta1, self._beta2, self._epsilon,
+                             self._sparse_decay_coeff(p))
+        out = fn(p._data, sr.rows, sr.values, np.float32(lr),
+                 np.int32(self._step_count), accs["moment1"],
+                 accs["moment2"])
+        p._data, accs["moment1"], accs["moment2"] = out
 
     @staticmethod
     def _update_rule(static_args, param, grad, lr, t, m1, m2):
@@ -392,9 +469,14 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip)
+                         None, grad_clip, lazy_mode=lazy_mode)
         self._coeff = float(weight_decay) if not callable(weight_decay) else weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _sparse_decay_coeff(self, p):
+        if self._decay_applies(p) and not callable(self._coeff):
+            return self._coeff
+        return 0.0
 
     def _static_args(self):
         return (self._beta1, self._beta2, self._epsilon, self._coeff)
@@ -568,3 +650,89 @@ class Lamb(Optimizer):
         r_norm = jnp.linalg.norm(r)
         ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return (p32 - lr * ratio * r).astype(param.dtype), m1n, m2n
+
+
+class Ftrl(Optimizer):
+    """FTRL-Proximal (reference: operators/optimizers/ftrl_op.h FTRLFunctor;
+    python API fluid.optimizer.FtrlOptimizer). Accumulates squared gradients
+    and a linear term; the closed-form proximal step shrinks weights whose
+    accumulated linear term is inside the l1 ball to exactly zero."""
+
+    _accumulator_names = ["squared", "linear"]
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+        self._lr_power = float(lr_power)
+
+    def _static_args(self):
+        return (self._l1, self._l2, self._lr_power)
+
+    def _create_accumulators(self, p):
+        return {n: jnp.zeros(p._data.shape, jnp.float32)
+                for n in self._accumulator_names}
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, squared, linear):
+        l1, l2, lr_power = static_args
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        new_sq = squared + jnp.square(g)
+        if lr_power == -0.5:
+            sigma = (jnp.sqrt(new_sq) - jnp.sqrt(squared)) / lr
+        else:
+            sigma = (jnp.power(new_sq, -lr_power)
+                     - jnp.power(squared, -lr_power)) / lr
+        lin = linear + g - sigma * p32
+        x = l1 * jnp.sign(lin) - lin
+        if lr_power == -0.5:
+            y = jnp.sqrt(new_sq) / lr + 2.0 * l2
+        else:
+            y = jnp.power(new_sq, -lr_power) / lr + 2.0 * l2
+        new_p = jnp.where(jnp.abs(lin) > l1, x / y, 0.0)
+        return new_p.astype(param.dtype), new_sq, lin
+
+
+@functools.lru_cache(maxsize=None)
+def _dpsgd_exec(clip, batch_size):
+    def fn(param, grad, lr, noise):
+        g = grad.astype(jnp.float32)
+        l2 = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.where(l2 > clip, l2 / clip, 1.0)
+        step = lr * (g / scale + noise / batch_size)
+        return (param.astype(jnp.float32) - step).astype(param.dtype)
+
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (reference: operators/optimizers/dpsgd_op.h,
+    CCS'16 "Deep Learning with Differential Privacy"): per-step global-norm
+    clip of the gradient plus one gaussian noise draw scaled by 1/batch_size.
+    The noise is drawn host-side (per step, like the reference's Box-Muller
+    draw) and enters the jitted update as a scalar argument."""
+
+    _accumulator_names = []
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, seed=0, name=None):
+        super().__init__(learning_rate, parameters, None, None)
+        self._clip = float(clip)
+        self._batch_size = float(batch_size)
+        self._sigma = float(sigma)
+        self._noise_rng = np.random.RandomState(seed or None)
+
+    def _apply_one(self, p, g, lr, accs):
+        noise = float(self._noise_rng.normal(0.0, self._sigma))
+        p._data = _dpsgd_exec(self._clip, self._batch_size)(
+            p._data, g, np.float32(lr), np.float32(noise))
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, *accs):
+        raise NotImplementedError(
+            "Dpsgd is dygraph-only: its per-step host-side gaussian noise "
+            "draw cannot be baked into a compiled static update; use it "
+            "with loss.backward() + opt.step()")

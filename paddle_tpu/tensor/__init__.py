@@ -112,26 +112,40 @@ mv = _m.mv
 kron = _m.kron
 mm = _m.matmul
 
-# reductions
-sum = _m.sum_  # noqa: A001
-mean = _m.mean
-max = _m.max_  # noqa: A001
-min = _m.min_  # noqa: A001
-prod = _m.prod
-any = _m.any_  # noqa: A001
-all = _m.all_  # noqa: A001
-logsumexp = _m.logsumexp
-amax = _m.amax
-amin = _m.amin
-nanmean = _m.nanmean
-nansum = _m.nansum
-std = _m.std
-var = _m.var
-median = _m.median
-nanmedian = _m.median
-cumsum = _m.cumsum
-cumprod = _m.cumprod
-logcumsumexp = _m.logcumsumexp
+# reductions — primitives take attrs keyword-only (dispatch caching), but the
+# reference API accepts a positional axis (`paddle.mean(x, 1)`, `x.sum(1)`);
+# these wrappers restore that calling convention.
+def _positional(fn, *argnames):
+    def wrap(x, *args, name=None, **kw):
+        if len(args) > len(argnames):
+            raise TypeError(
+                f"{fn.name if hasattr(fn, 'name') else fn}: too many "
+                f"positional arguments")
+        for n, val in zip(argnames, args):
+            kw[n] = val
+        return fn(x, **kw)
+    return wrap
+
+
+sum = _positional(_m.sum_, "axis", "dtype", "keepdim")  # noqa: A001
+mean = _positional(_m.mean, "axis", "keepdim")
+max = _positional(_m.max_, "axis", "keepdim")  # noqa: A001
+min = _positional(_m.min_, "axis", "keepdim")  # noqa: A001
+prod = _positional(_m.prod, "axis", "keepdim", "dtype")
+any = _positional(_m.any_, "axis", "keepdim")  # noqa: A001
+all = _positional(_m.all_, "axis", "keepdim")  # noqa: A001
+logsumexp = _positional(_m.logsumexp, "axis", "keepdim")
+amax = _positional(_m.amax, "axis", "keepdim")
+amin = _positional(_m.amin, "axis", "keepdim")
+nanmean = _positional(_m.nanmean, "axis", "keepdim")
+nansum = _positional(_m.nansum, "axis", "keepdim")
+std = _positional(_m.std, "axis", "unbiased", "keepdim")
+var = _positional(_m.var, "axis", "unbiased", "keepdim")
+median = _positional(_m.median, "axis", "keepdim")
+nanmedian = median
+cumsum = _positional(_m.cumsum, "axis")
+cumprod = _positional(_m.cumprod, "dim")
+logcumsumexp = _positional(_m.logcumsumexp, "axis")
 
 
 def quantile(x, q, axis=None, keepdim=False):
@@ -164,10 +178,10 @@ allclose = _m.allclose
 equal_all = _m.equal_all
 
 # search
-argmax = _m.argmax
-argmin = _m.argmin
-argsort = _m.argsort
-sort = _m.sort
+argmax = _positional(_m.argmax, "axis", "keepdim", "dtype")
+argmin = _positional(_m.argmin, "axis", "keepdim", "dtype")
+argsort = _positional(_m.argsort, "axis", "descending")
+sort = _positional(_m.sort, "axis", "descending")
 where = _m.where
 masked_select = _m.masked_select
 nonzero = _m.nonzero
@@ -475,12 +489,12 @@ def _patch():
         "trunc": _m.trunc, "erf": _m.erf, "lgamma": _m.lgamma,
         "isnan": _m.isnan, "isinf": _m.isinf, "isfinite": _m.isfinite,
         "clip": clip,
-        "sum": _m.sum_, "mean": _m.mean, "max": _m.max_, "min": _m.min_,
-        "prod": _m.prod, "any": _m.any_, "all": _m.all_,
-        "std": _m.std, "var": _m.var, "median": _m.median,
-        "logsumexp": _m.logsumexp, "cumsum": _m.cumsum, "cumprod": _m.cumprod,
-        "argmax": _m.argmax, "argmin": _m.argmin, "argsort": _m.argsort,
-        "sort": _m.sort, "topk": topk, "nonzero": _m.nonzero,
+        "sum": sum, "mean": mean, "max": max, "min": min,
+        "prod": prod, "any": any, "all": all,
+        "std": std, "var": var, "median": median,
+        "logsumexp": logsumexp, "cumsum": cumsum, "cumprod": cumprod,
+        "argmax": argmax, "argmin": argmin, "argsort": argsort,
+        "sort": sort, "topk": topk, "nonzero": _m.nonzero,
         "equal": _m.equal, "not_equal": _m.not_equal,
         "greater_than": _m.greater_than, "greater_equal": _m.greater_equal,
         "less_than": _m.less_than, "less_equal": _m.less_equal,

@@ -3,12 +3,16 @@ from .alexnet import AlexNet, alexnet  # noqa: F401
 from .densenet import (DenseNet, densenet121, densenet161,  # noqa: F401
                        densenet169, densenet201)
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
 from .lenet import LeNet  # noqa: F401
 from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,  # noqa: F401
                         mobilenet_v2)
 from .resnet import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
                      resnet101, resnet152, wide_resnet50_2,
                      wide_resnet101_2)
+from .resnext import (ResNeXt, resnext50_32x4d,  # noqa: F401
+                      resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+                      resnext152_32x4d, resnext152_64x4d)
 from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_5,  # noqa: F401
                            shufflenet_v2_x1_0, shufflenet_v2_x1_5,
                            shufflenet_v2_x2_0)
@@ -17,10 +21,13 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 
 __all__ = [
     "AlexNet", "alexnet", "DenseNet", "densenet121", "densenet161",
-    "densenet169", "densenet201", "GoogLeNet", "googlenet", "LeNet",
+    "densenet169", "densenet201", "GoogLeNet", "googlenet", "InceptionV3",
+    "inception_v3", "LeNet",
     "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
-    "wide_resnet50_2", "wide_resnet101_2", "ShuffleNetV2",
+    "wide_resnet50_2", "wide_resnet101_2", "ResNeXt", "resnext50_32x4d",
+    "resnext50_64x4d", "resnext101_32x4d", "resnext101_64x4d",
+    "resnext152_32x4d", "resnext152_64x4d", "ShuffleNetV2",
     "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
     "shufflenet_v2_x2_0", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
     "VGG", "vgg11", "vgg13", "vgg16", "vgg19",

@@ -1,0 +1,177 @@
+"""Inception-v3 (reference: python/paddle/vision/models/inceptionv3.py).
+
+Standard Szegedy et al. 2015 architecture: factorised 7x7 convolutions
+(1x7 / 7x1 pairs), asymmetric 1x3/3x1 expansions in the tail blocks, and
+an auxiliary-free inference trunk. 299x299 input, 2048-d features."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor import concat
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                  bias_attr=False),
+        nn.BatchNorm2D(cout), nn.ReLU())
+
+
+class InceptionStem(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.body = nn.Sequential(
+            _conv_bn(3, 32, 3, stride=2),
+            _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _conv_bn(64, 80, 1),
+            _conv_bn(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+
+    def forward(self, x):
+        return self.body(x)
+
+
+class InceptionA(nn.Layer):
+    """1x1 / 5x5 / double-3x3 / pool branches -> 224 + pool_features."""
+
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = _conv_bn(cin, 64, 1)
+        self.b5 = nn.Sequential(_conv_bn(cin, 48, 1),
+                                _conv_bn(48, 64, 5, padding=2))
+        self.b3d = nn.Sequential(_conv_bn(cin, 64, 1),
+                                 _conv_bn(64, 96, 3, padding=1),
+                                 _conv_bn(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv_bn(cin, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3d(x), self.bp(x)],
+                      axis=1)
+
+
+class InceptionB(nn.Layer):
+    """Grid reduction 35x35 -> 17x17 (stride-2 branches + maxpool)."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _conv_bn(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_conv_bn(cin, 64, 1),
+                                 _conv_bn(64, 96, 3, padding=1),
+                                 _conv_bn(96, 96, 3, stride=2))
+        self.bp = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.bp(x)], axis=1)
+
+
+class InceptionC(nn.Layer):
+    """Factorised 7x7 block; c7 is the bottleneck width (128..192)."""
+
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _conv_bn(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            _conv_bn(cin, c7, 1),
+            _conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+            _conv_bn(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _conv_bn(cin, c7, 1),
+            _conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+            _conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+            _conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+            _conv_bn(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv_bn(cin, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)],
+                      axis=1)
+
+
+class InceptionD(nn.Layer):
+    """Grid reduction 17x17 -> 8x8."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(_conv_bn(cin, 192, 1),
+                                _conv_bn(192, 320, 3, stride=2))
+        self.b7x3 = nn.Sequential(
+            _conv_bn(cin, 192, 1),
+            _conv_bn(192, 192, (1, 7), padding=(0, 3)),
+            _conv_bn(192, 192, (7, 1), padding=(3, 0)),
+            _conv_bn(192, 192, 3, stride=2))
+        self.bp = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7x3(x), self.bp(x)], axis=1)
+
+
+class InceptionE(nn.Layer):
+    """Expanded-filter-bank tail block -> 2048 channels."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _conv_bn(cin, 320, 1)
+        self.b3_stem = _conv_bn(cin, 384, 1)
+        self.b3_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_conv_bn(cin, 448, 1),
+                                      _conv_bn(448, 384, 3, padding=1))
+        self.b3d_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv_bn(cin, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return concat([self.b1(x),
+                       self.b3_a(s), self.b3_b(s),
+                       self.b3d_a(d), self.b3d_b(d),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = InceptionStem()
+        self.blocks = nn.Sequential(
+            InceptionA(192, pool_features=32),
+            InceptionA(256, pool_features=64),
+            InceptionA(288, pool_features=64),
+            InceptionB(288),
+            InceptionC(768, c7=128),
+            InceptionC(768, c7=160),
+            InceptionC(768, c7=160),
+            InceptionC(768, c7=192),
+            InceptionD(768),
+            InceptionE(1280),
+            InceptionE(2048),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.blocks(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            from ...tensor import flatten
+            x = flatten(x, 1)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

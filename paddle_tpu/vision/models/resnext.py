@@ -1,0 +1,45 @@
+"""ResNeXt family (reference: python/paddle/vision/models/resnext.py —
+resnext{50,101,152}_{32x4d,64x4d}). Grouped-convolution bottlenecks; we
+reuse the ResNet trunk, which already threads cardinality/width through
+its BottleneckBlock the way torchvision-style ResNeXts do."""
+from __future__ import annotations
+
+from .resnet import BottleneckBlock, ResNet
+
+__all__ = ["ResNeXt", "resnext50_32x4d", "resnext50_64x4d",
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+           "resnext152_64x4d"]
+
+
+class ResNeXt(ResNet):
+    def __init__(self, depth=50, cardinality=32, base_width=4,
+                 num_classes=1000, with_pool=True):
+        # ResNet 50/101 share layer configs with ResNeXt; depth 152 uses
+        # [3, 8, 36, 3], also shared.
+        super().__init__(BottleneckBlock, depth, width=base_width,
+                         num_classes=num_classes, with_pool=with_pool,
+                         groups=cardinality)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return ResNeXt(50, cardinality=32, base_width=4, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return ResNeXt(50, cardinality=64, base_width=4, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return ResNeXt(101, cardinality=32, base_width=4, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return ResNeXt(101, cardinality=64, base_width=4, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return ResNeXt(152, cardinality=32, base_width=4, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return ResNeXt(152, cardinality=64, base_width=4, **kwargs)

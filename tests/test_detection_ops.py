@@ -153,7 +153,7 @@ class TestGenerateProposals:
 class TestNewModelFamilies:
     @pytest.mark.parametrize("name", [
         "alexnet", "googlenet", "densenet121", "shufflenet_v2_x0_5",
-        "squeezenet1_1"])
+        "squeezenet1_1", "resnext50_32x4d"])
     def test_forward(self, name):
         from paddle_tpu.vision import models as M
         paddle.seed(0)
@@ -165,12 +165,25 @@ class TestNewModelFamilies:
         assert out.shape == [1, 10]
         assert np.isfinite(out.numpy()).all()
 
+    def test_inception_v3_forward(self):
+        # 299x299 trunk; small batch keeps the CPU-mesh run cheap
+        from paddle_tpu.vision import models as M
+        paddle.seed(0)
+        net = M.inception_v3(num_classes=10)
+        net.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(1, 3, 299, 299).astype(np.float32))
+        out = net(x)
+        assert out.shape == [1, 10]
+        assert np.isfinite(out.numpy()).all()
+
     def test_family_count(self):
-        """SURVEY/VERDICT bar: >= 8 model families in the zoo."""
+        """Full parity with the reference zoo: 12 architecture families
+        (reference python/paddle/vision/models has 12 model modules)."""
         from paddle_tpu.vision import models as M
         families = ["LeNet", "AlexNet", "VGG", "ResNet", "GoogLeNet",
                     "DenseNet", "MobileNetV1", "MobileNetV2",
-                    "ShuffleNetV2", "SqueezeNet"]
+                    "ShuffleNetV2", "SqueezeNet", "ResNeXt", "InceptionV3"]
         for f in families:
             assert hasattr(M, f), f
-        assert len(families) >= 8
+        assert len(families) >= 12

@@ -120,3 +120,62 @@ def test_scheduler_drives_optimizer():
     assert opt.get_lr() == 0.1
     sched.step()
     assert abs(opt.get_lr() - 0.01) < 1e-9
+
+
+def test_ftrl_matches_reference_formula():
+    """numpy re-derivation of operators/optimizers/ftrl_op.h FTRLFunctor."""
+    rs = np.random.RandomState(0)
+    w0 = rs.randn(6).astype(np.float32)
+    grads = [rs.randn(6).astype(np.float32) for _ in range(4)]
+    l1, l2, lr_power, lr = 0.1, 0.2, -0.5, 0.05
+
+    w = paddle.framework.Parameter(w0.copy())
+    opt = optimizer.Ftrl(learning_rate=lr, l1=l1, l2=l2, lr_power=lr_power,
+                         parameters=[w])
+    p = w0.astype(np.float64).copy()
+    sq = np.zeros(6)
+    lin = np.zeros(6)
+    for g in grads:
+        w.grad = paddle.to_tensor(g)
+        opt.step()
+        opt.clear_grad()
+        g64 = g.astype(np.float64)
+        new_sq = sq + g64 * g64
+        lin += g64 - (np.sqrt(new_sq) - np.sqrt(sq)) / lr * p
+        x = l1 * np.sign(lin) - lin
+        y = np.sqrt(new_sq) / lr + 2 * l2
+        p = np.where(np.abs(lin) > l1, x / y, 0.0)
+        sq = new_sq
+    np.testing.assert_allclose(w.numpy(), p.astype(np.float32),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ftrl_l1_produces_sparsity():
+    paddle.seed(0)
+    w = paddle.framework.Parameter(np.full(8, 0.01, np.float32))
+    opt = optimizer.Ftrl(learning_rate=0.1, l1=10.0, parameters=[w])
+    w.grad = paddle.to_tensor(np.full(8, 0.001, np.float32))
+    opt.step()
+    assert np.abs(w.numpy()).max() == 0.0  # inside the l1 ball -> exact zero
+
+
+def test_dpsgd_clips_and_converges():
+    paddle.seed(0)
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    w = paddle.framework.Parameter(np.zeros(3, np.float32))
+    opt = optimizer.Dpsgd(learning_rate=0.05, clip=1e6, sigma=0.0,
+                          batch_size=1.0, parameters=[w])
+    for _ in range(100):
+        loss = paddle.sum((w - paddle.to_tensor(target)) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.abs(w.numpy() - target).max() < 0.15
+
+    # with a tight clip, one huge-grad step moves by at most ~lr*clip-ish
+    w2 = paddle.framework.Parameter(np.zeros(3, np.float32))
+    opt2 = optimizer.Dpsgd(learning_rate=1.0, clip=0.1, sigma=0.0,
+                           batch_size=1.0, parameters=[w2])
+    w2.grad = paddle.to_tensor(np.array([1e4, 0, 0], np.float32))
+    opt2.step()
+    assert np.abs(w2.numpy()).max() <= 0.1 + 1e-5

@@ -1,0 +1,158 @@
+"""Row-sparse (SelectedRows) embedding gradients.
+
+Parity model: the reference's is_sparse lookup_table_v2 grad path
+(paddle/fluid/operators/lookup_table_v2_op.h) + the SelectedRows branches
+of sgd_op.h / adam_op.h (lazy_mode row-wise updates), exercised the way
+unittests/test_lookup_table_v2_op.py and test_adam_op.py (lazy) do —
+sparse result must match the dense path bit-for-bit where semantics
+coincide."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import SelectedRows, nn
+
+
+def _ids(shape=(3, 5), vocab=50, seed=0, dup=True):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, vocab, shape).astype(np.int64)
+    if dup:
+        ids.flat[0] = ids.flat[1]  # force duplicate rows
+    return ids
+
+
+def _pair(vocab=50, dim=8, sparse=True, seed=0, **kw):
+    paddle.seed(seed)
+    emb = nn.Embedding(vocab, dim, sparse=sparse, **kw)
+    return emb
+
+
+class TestSparseBackward:
+    def test_grad_is_selected_rows_and_matches_dense(self):
+        ids = _ids()
+        emb_s = _pair(sparse=True)
+        emb_d = _pair(sparse=False)
+        emb_d.weight.set_value(emb_s.weight.numpy())
+
+        (emb_s(paddle.to_tensor(ids)) ** 2).sum().backward()
+        (emb_d(paddle.to_tensor(ids)) ** 2).sum().backward()
+
+        g = emb_s.weight.grad
+        assert isinstance(g, SelectedRows)
+        assert g.height == 50 and g.shape == [50, 8]
+        np.testing.assert_allclose(g.numpy(), emb_d.weight.grad.numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_padding_idx_rows_are_zero(self):
+        ids = _ids()
+        pad = int(ids.flat[2])
+        emb = _pair(sparse=True, padding_idx=pad)
+        emb(paddle.to_tensor(ids)).sum().backward()
+        assert isinstance(emb.weight.grad, SelectedRows)
+        assert np.abs(emb.weight.grad.numpy()[pad]).max() == 0.0
+
+    def test_accumulation_appends_then_merges(self):
+        emb = _pair(sparse=True)
+        for seed in (0, 1):
+            emb(paddle.to_tensor(_ids(seed=seed))).sum().backward()
+        g = emb.weight.grad
+        assert isinstance(g, SelectedRows)
+        assert g.rows.shape[0] == 2 * 15
+        merged = g.merged()
+        assert merged.rows.shape[0] < g.rows.shape[0]
+        np.testing.assert_allclose(merged.numpy(), g.numpy(), rtol=1e-6)
+
+    def test_dense_plus_sparse_accumulates_dense(self):
+        # same weight used through sparse lookup AND a dense op
+        emb = _pair(sparse=True)
+        emb(paddle.to_tensor(_ids())).sum().backward()
+        (emb.weight * 2.0).sum().backward()
+        g = emb.weight.grad
+        assert not isinstance(g, SelectedRows)  # densified on mix
+        assert np.isfinite(g.numpy()).all()
+
+    def test_traced_mode_stays_dense(self):
+        # under jit tracing sparse=True degrades to the dense fused path
+        import jax
+        emb = _pair(sparse=True)
+        w0 = emb.weight.numpy()
+        ids = _ids()
+
+        from paddle_tpu.jit.engine import make_train_step
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=emb.parameters())
+        crit = lambda out, lab: (out ** 2).mean()
+        step = make_train_step(emb, crit, opt)
+        loss, _ = step([paddle.to_tensor(ids)], [paddle.to_tensor(ids)])
+        assert np.isfinite(float(loss.numpy()))
+        assert not np.allclose(emb.weight.numpy(), w0)
+
+
+class TestSparseOptimizers:
+    def _both(self, make_opt, steps=3, **embkw):
+        outs = []
+        for sparse in (True, False):
+            emb = _pair(sparse=sparse, **embkw)
+            opt = make_opt(emb.parameters())
+            for s in range(steps):
+                emb(paddle.to_tensor(_ids(seed=s))).sum().backward()
+                opt.step()
+                opt.clear_grad()
+            outs.append(emb.weight.numpy())
+        return outs
+
+    def test_sgd_sparse_matches_dense(self):
+        s, d = self._both(lambda ps: paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=ps))
+        np.testing.assert_allclose(s, d, rtol=1e-6, atol=1e-6)
+
+    def test_adam_nonlazy_sparse_matches_dense(self):
+        s, d = self._both(lambda ps: paddle.optimizer.Adam(
+            learning_rate=0.1, parameters=ps))
+        np.testing.assert_allclose(s, d, rtol=1e-5, atol=1e-6)
+
+    def test_adam_lazy_first_step_matches_dense(self):
+        # step 1 from zero moments: untouched rows get exactly zero update
+        # in BOTH lazy and dense Adam, so they must agree
+        s, d = self._both(lambda ps: paddle.optimizer.Adam(
+            learning_rate=0.1, parameters=ps, lazy_mode=True), steps=1)
+        np.testing.assert_allclose(s, d, rtol=1e-5, atol=1e-6)
+
+    def test_adam_lazy_only_touches_seen_rows(self):
+        emb = _pair(sparse=True, vocab=100)
+        w0 = emb.weight.numpy().copy()
+        opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                    parameters=emb.parameters(),
+                                    lazy_mode=True)
+        ids = _ids(vocab=10)  # only rows < 10 touched
+        for _ in range(3):
+            emb(paddle.to_tensor(ids)).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        w1 = emb.weight.numpy()
+        touched = np.unique(ids)
+        untouched = np.setdiff1d(np.arange(100), touched)
+        assert np.abs(w1[untouched] - w0[untouched]).max() == 0.0
+        assert np.abs(w1[touched] - w0[touched]).max() > 0.0
+
+    def test_adamw_lazy_decay_on_touched_rows(self):
+        emb = _pair(sparse=True, vocab=100)
+        w0 = emb.weight.numpy().copy()
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                     parameters=emb.parameters(),
+                                     lazy_mode=True)
+        ids = np.array([[1, 2, 3]], np.int64)
+        emb(paddle.to_tensor(ids)).sum().backward()
+        opt.step()
+        untouched = np.setdiff1d(np.arange(100), [1, 2, 3])
+        w1 = emb.weight.numpy()
+        assert np.abs(w1[untouched] - w0[untouched]).max() == 0.0
+
+    def test_weight_decay_densifies(self):
+        # optimizer-level L2 can't stay factored; it must still train
+        emb = _pair(sparse=True)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, weight_decay=0.01,
+                                    parameters=emb.parameters())
+        emb(paddle.to_tensor(_ids())).sum().backward()
+        opt.step()
+        assert np.isfinite(emb.weight.numpy()).all()
