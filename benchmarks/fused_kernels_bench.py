@@ -7,21 +7,36 @@ from __future__ import annotations
 
 import functools
 import json
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+# standalone runs put benchmarks/ (not the repo root) on sys.path[0]
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _sync(out):
+    # block_until_ready is unreliable through the axon tunnel (returns
+    # before execution completes); a host transfer is a true barrier
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf).ravel()[:1]
+
+
+CHAIN = 10
+
 
 def timeit(fn, *args, iters=30, warmup=5):
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     return (time.perf_counter() - t0) / iters
 
 
@@ -33,22 +48,25 @@ def bench_flash_attention(B=8, H=12, T=1024, D=64, dtype=jnp.bfloat16):
     v = jnp.asarray(rs.randn(B, H, T, D), dtype)
     interp = jax.default_backend() != "tpu"
 
-    @jax.jit
-    def pallas_step(q, k, v):
-        loss, grads = jax.value_and_grad(
-            lambda q, k, v: _flash(q, k, v, True, interp).sum(),
-            argnums=(0, 1, 2))(q, k, v)
-        return grads
+    # CHAIN iterations inside one jit: per-call dispatch latency through the
+    # axon tunnel (~25 ms) would otherwise drown the kernel time
+    def chain(attn):
+        @jax.jit
+        def step(q, k, v):
+            for _ in range(CHAIN):
+                dq, dk, dv = jax.grad(
+                    lambda q, k, v: attn(q, k, v).sum(),
+                    argnums=(0, 1, 2))(q, k, v)
+                q = (q + 1e-3 * dq).astype(q.dtype)
+                k = (k + 1e-3 * dk).astype(k.dtype)
+                v = (v + 1e-3 * dv).astype(v.dtype)
+            return q
+        return step
 
-    @jax.jit
-    def xla_step(q, k, v):
-        loss, grads = jax.value_and_grad(
-            lambda q, k, v: _xla_attention(q, k, v, True).sum(),
-            argnums=(0, 1, 2))(q, k, v)
-        return grads
-
-    tp = timeit(pallas_step, q, k, v)
-    tx = timeit(xla_step, q, k, v)
+    tp = timeit(chain(lambda q, k, v: _flash(q, k, v, True, interp)),
+                q, k, v, iters=3) / CHAIN
+    tx = timeit(chain(lambda q, k, v: _xla_attention(q, k, v, True)),
+                q, k, v, iters=3) / CHAIN
     return {"kernel": "flash_attention_fwd_bwd",
             "shape": [B, H, T, D], "dtype": str(dtype.__name__),
             "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
@@ -83,8 +101,19 @@ def bench_fused_ln(N=8192, Hdim=768, p=0.1, dtype=jnp.bfloat16):
                     + beta).sum()
         return jax.grad(f)(x)
 
-    tp = timeit(fused, x, res, key)
-    tx = timeit(unfused, x, res, key)
+    def chain(g):
+        @jax.jit
+        def step(x, res, key):
+            for _ in range(CHAIN):
+                x = (x + 1e-3 * g(x, res, key)).astype(x.dtype)
+            return x
+        return step
+
+    tp = timeit(chain(lambda x, r, k2: fused._fun(x, r, k2)
+                      if hasattr(fused, "_fun") else fused(x, r, k2)),
+                x, res, key, iters=3) / CHAIN
+    tx = timeit(chain(lambda x, r, k2: unfused(x, r, k2)),
+                x, res, key, iters=3) / CHAIN
     return {"kernel": "fused_bias_dropout_residual_ln_fwd_bwd",
             "shape": [N, Hdim], "dtype": str(dtype.__name__),
             "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
@@ -110,8 +139,18 @@ def bench_fused_adamw(numel=768 * 3072, dtype=jnp.float32):
     xla_fn = jax.jit(lambda p, g, lr, t, m1, m2:
                      AdamW._update_rule(sa, p, g, lr, t, m1, m2))
 
-    tp = timeit(pallas_fn, p, g, lr, t, m1, m2)
-    tx = timeit(xla_fn, p, g, lr, t, m1, m2)
+    def chain(upd):
+        @jax.jit
+        def step(p, g, lr, t, m1, m2):
+            for _ in range(CHAIN):
+                p, m1, m2 = upd(p, g, lr, t, m1, m2)
+            return p, m1, m2
+        return step
+
+    tp = timeit(chain(lambda *a: pallas_fn(*a)), p, g, lr, t, m1, m2,
+                iters=3) / CHAIN
+    tx = timeit(chain(lambda *a: xla_fn(*a)), p, g, lr, t, m1, m2,
+                iters=3) / CHAIN
     return {"kernel": "fused_adamw_update",
             "shape": list(shape), "dtype": str(np.dtype(dtype).name),
             "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),

@@ -162,60 +162,26 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """reference: fleet_base.py:830 + the meta-optimizer pass
-    (fleet/meta_optimizers/: lars_optimizer.py, localsgd_optimizer.py) —
-    strategy switches rewrite/wrap the user optimizer here."""
+    """reference: fleet_base.py:830 → StrategyCompiler resolves which meta
+    optimizers fire (fleet/base/strategy_compiler.py + per-meta _can_apply,
+    e.g. lars_optimizer.py) and rewrites/wraps the user optimizer. The
+    sharding + hybrid wrappers are structural (driven by topology, not
+    switches) and sit between the pre- and post-stage metas."""
     if strategy is not None:
         _state.strategy = strategy
     _require_init()
     hcg = _state.hcg
     strat = _state.strategy
 
-    import paddle_tpu.optimizer as opt_mod
-    if strat.lars:
-        # reference swaps Momentum -> LarsMomentum (lars_optimizer.py:_can_apply)
-        if not isinstance(optimizer, opt_mod.Momentum):
-            raise TypeError(
-                "strategy.lars applies to Momentum optimizers "
-                f"(got {type(optimizer).__name__})")
-        cfg = strat.lars_configs
-        optimizer = opt_mod.Lars(
-            learning_rate=optimizer._lr,
-            momentum=optimizer._momentum,
-            lars_coeff=cfg["lars_coeff"],
-            lars_weight_decay=cfg["lars_weight_decay"],
-            epsilon=cfg["epsilon"],
-            exclude_from_weight_decay=cfg["exclude_from_weight_decay"],
-            parameters=optimizer._parameter_list,
-            grad_clip=optimizer._grad_clip)
-    if strat.lamb:
-        if not isinstance(optimizer, opt_mod.Adam):
-            raise TypeError(
-                "strategy.lamb applies to Adam optimizers "
-                f"(got {type(optimizer).__name__})")
-        cfg = strat.lamb_configs
-        exclude = tuple(cfg.get("exclude_from_weight_decay") or ())
-        optimizer = opt_mod.Lamb(
-            learning_rate=optimizer._lr,
-            lamb_weight_decay=cfg["lamb_weight_decay"],
-            beta1=optimizer._beta1, beta2=optimizer._beta2,
-            epsilon=optimizer._epsilon,
-            parameters=optimizer._parameter_list,
-            grad_clip=optimizer._grad_clip,
-            exclude_from_weight_decay_fn=(
-                (lambda p: any(tag in (getattr(p, "name", "") or "")
-                               for tag in exclude))
-                if exclude else None))
+    from .strategy_compiler import StrategyCompiler
+    compiler = StrategyCompiler()
+    chosen = compiler.select(strat, optimizer)
+    optimizer = compiler.apply_stage("pre", chosen, optimizer, strat, hcg)
 
     if hcg.get_sharding_parallel_world_size() > 1:
         optimizer = DygraphShardingOptimizer(optimizer=optimizer, hcg=hcg)
     wrapped = HybridParallelOptimizer(optimizer, hcg=hcg, strategy=strat)
-    if strat.localsgd:
-        cfg = strat.localsgd_configs
-        wrapped = LocalSGDOptimizer(wrapped, hcg=hcg,
-                                    k_steps=cfg["k_steps"],
-                                    begin_step=cfg["begin_step"])
-    return wrapped
+    return compiler.apply_stage("post", chosen, wrapped, strat, hcg)
 
 
 def worker_num():

@@ -74,6 +74,9 @@ define_flag("use_flash_attention", True,
 define_flag("use_fused_optimizer", True,
             "route Adam/AdamW updates to the Pallas fused kernel on TPU "
             "(single HBM pass, in-place via buffer aliasing)")
-define_flag("use_fused_dropout_ln", True,
+define_flag("use_fused_dropout_ln", False,
             "route fused bias+dropout+residual+layernorm to the Pallas "
-            "kernel when shapes/backend allow")
+            "kernel when shapes/backend allow. Default off: measured 0.47x "
+            "vs XLA's own fusion of this chain on v5e at GPT-2 shapes "
+            "(benchmarks/fused_kernels_bench.py r3) — XLA wins; the kernel "
+            "stays available for shapes/backends where it does not")

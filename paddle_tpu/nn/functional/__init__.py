@@ -749,5 +749,8 @@ def scaled_dot_product_attention(query, key=None, value=None, attn_mask=None,
     return out, w
 
 
-from .sequence import (sequence_expand, sequence_pad, sequence_pool,  # noqa: E402,F401
-                       sequence_reverse, sequence_softmax, sequence_unpad)
+from .sequence import (sequence_concat, sequence_conv,  # noqa: E402,F401
+                       sequence_enumerate, sequence_erase, sequence_expand,
+                       sequence_expand_as, sequence_pad, sequence_pool,
+                       sequence_reshape, sequence_reverse, sequence_scatter,
+                       sequence_slice, sequence_softmax, sequence_unpad)

@@ -18,7 +18,10 @@ from ...framework.dispatch import primitive, raw
 from ...framework.tensor import Tensor
 
 __all__ = ["sequence_pad", "sequence_unpad", "sequence_reverse",
-           "sequence_softmax", "sequence_pool", "sequence_expand"]
+           "sequence_softmax", "sequence_pool", "sequence_expand",
+           "sequence_concat", "sequence_enumerate", "sequence_erase",
+           "sequence_expand_as", "sequence_reshape", "sequence_slice",
+           "sequence_scatter", "sequence_conv"]
 
 
 def _mask(lengths, maxlen):
@@ -129,3 +132,137 @@ def sequence_expand(x, ref_lengths, name=None):
     vals = np.asarray(raw(x))
     lens = np.asarray(raw(ref_lengths)).astype(np.int64)
     return Tensor(np.repeat(vals, lens, axis=0))
+
+
+def sequence_concat(xs, lengths_list, name=None):
+    """Row-wise concat of ragged batches: output sequence i is the
+    concatenation of sequence i from every input (reference:
+    sequence_concat_op). Inputs are (flat values, lengths) pairs; returns
+    (flat values, lengths). Host-side eager (ragged output)."""
+    arrs = [np.asarray(raw(x)) for x in xs]
+    lens = [np.asarray(raw(l)).astype(np.int64) for l in lengths_list]
+    B = len(lens[0])
+    if any(len(l) != B for l in lens):
+        raise ValueError("sequence_concat: batch sizes differ")
+    offs = [np.concatenate([[0], np.cumsum(l)]) for l in lens]
+    rows = []
+    for i in range(B):
+        for a, o in zip(arrs, offs):
+            rows.append(a[o[i]:o[i + 1]])
+    out_lens = np.sum(np.stack(lens), axis=0)
+    return Tensor(np.concatenate(rows, axis=0)), Tensor(out_lens)
+
+
+def sequence_enumerate(x, lengths, win_size, pad_value=0, name=None):
+    """All win_size-grams per sequence, short windows padded (reference:
+    sequence_enumerate_op). (flat ids [N], lengths) → [N, win_size]."""
+    ids = np.asarray(raw(x)).reshape(-1)
+    lens = np.asarray(raw(lengths)).astype(np.int64)
+    out = np.full((len(ids), int(win_size)), pad_value, ids.dtype)
+    off = 0
+    for n in lens:
+        seq = ids[off:off + int(n)]
+        for i in range(int(n)):
+            take = seq[i:i + int(win_size)]
+            out[off + i, :len(take)] = take
+        off += int(n)
+    return Tensor(out)
+
+
+def sequence_erase(x, lengths, tokens, name=None):
+    """Remove every occurrence of `tokens` (reference: sequence_erase_op).
+    Host-side eager — output is ragged."""
+    ids = np.asarray(raw(x)).reshape(-1)
+    lens = np.asarray(raw(lengths)).astype(np.int64)
+    drop = set(int(t) for t in tokens)
+    rows, out_lens, off = [], [], 0
+    for n in lens:
+        seq = ids[off:off + int(n)]
+        keep = seq[~np.isin(seq, list(drop))]
+        rows.append(keep)
+        out_lens.append(len(keep))
+        off += int(n)
+    return (Tensor(np.concatenate(rows) if rows else ids[:0]),
+            Tensor(np.asarray(out_lens, np.int64)))
+
+
+def sequence_expand_as(x, ref_lengths, name=None):
+    """Expand row i of x to ref_lengths[i] copies — x must have exactly one
+    row per reference sequence (reference: sequence_expand_as_op)."""
+    return sequence_expand(x, ref_lengths, name=name)
+
+
+def sequence_reshape(x, lengths, new_dim, name=None):
+    """Reflow each sequence's flat payload to width new_dim (reference:
+    sequence_reshape_op). new_dim must divide each lengths[i]*old_dim."""
+    vals = np.asarray(raw(x))
+    lens = np.asarray(raw(lengths)).astype(np.int64)
+    old = vals.shape[-1]
+    tot = lens * old
+    if np.any(tot % new_dim):
+        raise ValueError(
+            f"sequence_reshape: payload {tot.tolist()} not divisible by "
+            f"new_dim={new_dim}")
+    return Tensor(vals.reshape(-1, int(new_dim))), Tensor(tot // new_dim)
+
+
+def sequence_slice(x, lengths, offset, length, name=None):
+    """Per-sequence slice [offset[i], offset[i]+length[i]) (reference:
+    sequence_slice_op)."""
+    vals = np.asarray(raw(x))
+    lens = np.asarray(raw(lengths)).astype(np.int64)
+    offs = np.asarray(raw(offset)).astype(np.int64).reshape(-1)
+    lns = np.asarray(raw(length)).astype(np.int64).reshape(-1)
+    rows, off = [], 0
+    for i, n in enumerate(lens):
+        if offs[i] < 0 or lns[i] < 0 or offs[i] + lns[i] > n:
+            raise ValueError(
+                f"sequence_slice: [{offs[i]}, {offs[i]+lns[i]}) out of "
+                f"range for length {n}")
+        rows.append(vals[off + offs[i]:off + offs[i] + lns[i]])
+        off += int(n)
+    return Tensor(np.concatenate(rows, axis=0)), Tensor(lns)
+
+
+def sequence_scatter(x, index, updates, seg_lengths, name=None):
+    """x[i, index[j]] += updates[j] for j in segment i (reference:
+    sequence_scatter_op; index/updates are ragged over segments)."""
+    base = np.array(np.asarray(raw(x)), copy=True)
+    idx = np.asarray(raw(index)).astype(np.int64).reshape(-1)
+    upd = np.asarray(raw(updates)).reshape(-1)
+    segs = np.asarray(raw(seg_lengths)).astype(np.int64)
+    off = 0
+    for i, n in enumerate(segs):
+        np.add.at(base[i], idx[off:off + int(n)], upd[off:off + int(n)])
+        off += int(n)
+    return Tensor(base)
+
+
+@primitive("sequence_conv_op")
+def _seq_conv(x, weight, lengths, *, context_length, context_start):
+    """Context-window conv over padded [B, T, D] (reference:
+    sequence_conv_op): gather the context_length window around each step
+    (zero outside [0, len)), flatten to [B, T, ctx*D], then one matmul
+    with weight [ctx*D, F] — im2col the MXU way."""
+    B, T, D = x.shape
+    m = _mask(lengths, T)[..., None]                      # [B, T, 1]
+    xz = jnp.where(m, x, 0.0)
+    cols = []
+    for c in range(context_length):
+        shift = context_start + c
+        rolled = jnp.roll(xz, -shift, axis=1)
+        pos = jnp.arange(T) + shift
+        valid = ((pos >= 0) & (pos < T))[None, :, None]
+        cols.append(jnp.where(valid, rolled, 0.0))
+    stacked = jnp.concatenate(cols, axis=-1)              # [B, T, ctx*D]
+    out = stacked @ weight                                # [B, T, F]
+    return jnp.where(m, out, 0.0)
+
+
+def sequence_conv(x, weight, lengths, context_length, context_start=None,
+                  name=None):
+    if context_start is None:
+        context_start = -((int(context_length) - 1) // 2)
+    return _seq_conv(x, weight, lengths,
+                     context_length=int(context_length),
+                     context_start=int(context_start))

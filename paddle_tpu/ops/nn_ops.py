@@ -205,11 +205,17 @@ def conv(x, w, *, stride=(1, 1), padding=(0, 0), dilation=(1, 1), groups=1,
     else:
         pad = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
     dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
-    return lax.conv_general_dilated(
+    out = lax.conv_general_dilated(
         x, w, window_strides=tuple(stride), padding=pad,
         rhs_dilation=tuple(dilation), dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        feature_group_count=groups)
+    # bf16 convs feed f32 consumers (BN stats etc.); upcast via an explicit
+    # convert rather than preferred_element_type=f32 — the latter makes the
+    # conv TRANSPOSE rule mix an f32 cotangent with bf16 operands, which
+    # lax rejects (verified: grad of preferred-f32 bf16 conv TypeErrors)
+    if x.dtype == jnp.bfloat16:
+        out = out.astype(jnp.float32)
+    return out
 
 
 @primitive("conv2d_transpose_op")

@@ -39,6 +39,30 @@ _NEG_INF = -1e30
 # attention uses for its l/m residuals).
 _LANES = 128
 
+# Index-map constant: this framework runs with jax_enable_x64=True (int64
+# tensors are first-class, like the reference), under which a bare `0` in a
+# BlockSpec index map traces to an i64 literal that Mosaic cannot legalize
+# ("func.return (i64)"); an np.int32 scalar keeps its dtype under x64.
+_I0 = np.int32(0)
+
+
+def _pallas_call(*args, **kwargs):
+    """pl.pallas_call with the kernel traced under x64=False.
+
+    Global x64 poisons Mosaic two ways (both reproduced on the v5e):
+    i64 literals in auto-generated index maps fail to legalize, and
+    convert_element_type lowering recurses infinitely on weak-typed
+    converts inside kernel bodies. The kernels only consume
+    f32/bf16/i32/u32 operands, so tracing them in 32-bit mode is
+    semantics-preserving."""
+    inner = pl.pallas_call(*args, **kwargs)
+
+    def call(*operands):
+        with jax.enable_x64(False):
+            return inner(*operands)
+
+    return call
+
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
                       block_k, causal, q_block, shift):
@@ -113,25 +137,25 @@ def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False,
     kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
                                block_k=block_k, causal=causal,
                                q_block=block_q, shift=Tk - Tq)
-    o_spec = pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0))
+    o_spec = pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, _I0))
     o_shape = jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)
     if need_lse:
         out_specs = [o_spec,
                      pl.BlockSpec((None, block_q, _LANES),
-                                  lambda b, i: (b, i, 0))]
+                                  lambda b, i: (b, i, _I0))]
         out_shape = [o_shape,
                      jax.ShapeDtypeStruct((B * H, Tq, _LANES), jnp.float32)]
     else:
         kernel = functools.partial(_nolse_kernel, kernel)
         out_specs = [o_spec]
         out_shape = [o_shape]
-    outs = pl.pallas_call(
+    outs = _pallas_call(
         kernel,
         grid=(B * H, Tq // block_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, _I0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, _I0, _I0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, _I0, _I0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -264,18 +288,18 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q=128, block_k=128,
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, sm_scale=sm_scale, block_k=block_k,
         causal=causal, q_block=block_q, shift=shift)
-    dq = pl.pallas_call(
+    dq = _pallas_call(
         dq_kernel,
         grid=(B * H, Tq // block_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, _LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, _I0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, _I0, _I0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, _I0, _I0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, _I0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, _I0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, i: (b, i, _I0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, _I0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         interpret=interpret,
     )(qr, kr, vr, orr, dor, lse)
@@ -283,20 +307,20 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q=128, block_k=128,
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, sm_scale=sm_scale, block_q=block_q,
         causal=causal, k_block=block_k, shift=shift)
-    dk, dv = pl.pallas_call(
+    dk, dv = _pallas_call(
         dkv_kernel,
         grid=(B * H, Tk // block_k),
         in_specs=[
-            pl.BlockSpec((None, Tq, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((None, Tq, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((None, Tq, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((None, Tq, _LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, Tq, D), lambda b, j: (b, _I0, _I0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, _I0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, _I0)),
+            pl.BlockSpec((None, Tq, D), lambda b, j: (b, _I0, _I0)),
+            pl.BlockSpec((None, Tq, D), lambda b, j: (b, _I0, _I0)),
+            pl.BlockSpec((None, Tq, _LANES), lambda b, j: (b, _I0, _I0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, _I0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, _I0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
@@ -455,31 +479,39 @@ def _fbdrln_bwd_kernel(rng_ref, z_ref, dy_ref, dz_extra_ref, gamma_ref,
 
 
 def _fbdrln_block_n(n, hdim):
-    """Largest power-of-two row block dividing n whose f32 footprint stays
-    ~2 MB per array — the kernels hold ~6 such blocks, comfortably inside
-    the ~16 MB/core VMEM even at hdim=16384."""
+    """Row-block size for an (n, hdim) kernel, or None when no legal block
+    exists. Two constraints: f32 footprint ~2 MB per array (the kernels hold
+    ~6 such blocks, comfortably inside the ~16 MB/core VMEM even at
+    hdim=16384), and Pallas-TPU block legality — the sublane dimension must
+    be divisible by 8 OR the block must span the whole array, so row blocks
+    below 8 are only legal as the full array."""
     cap = max(1, (2 << 20) // (4 * hdim))
-    for bn in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+    for bn in (256, 128, 64, 32, 16, 8):
         if bn <= cap and n % bn == 0:
             return bn
-    return 1
+    if n <= cap:
+        return n  # single full-array block: always a legal shape
+    return None
 
 
 def _fbdrln_call(kernel, n_out, rng, arrs, out_dtypes, *, p, scale, eps,
                  has_rng, with_ln, interpret):
     n, hdim = arrs[0].shape
     bn = _fbdrln_block_n(n, hdim)
-    row_spec = pl.BlockSpec((bn, hdim), lambda i: (i, 0))
-    vec_spec = pl.BlockSpec((1, hdim), lambda i: (0, 0))
+    row_spec = pl.BlockSpec((bn, hdim), lambda i: (i, _I0))
+    vec_spec = pl.BlockSpec((1, hdim), lambda i: (_I0, _I0))
     if has_rng:
-        rng_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+        # explicit i32 index map: the default one emits i64 literals under
+        # x64 that Mosaic rejects (same issue as _I0 above)
+        rng_spec = pl.BlockSpec((1,), lambda i: (_I0,),
+                                memory_space=pltpu.SMEM)
     else:
         rng_spec = row_spec  # precomputed mask bits, blocked like the rows
     in_specs = [rng_spec] + [row_spec if a.shape == (n, hdim) else vec_spec
                              for a in arrs]
     kern = functools.partial(kernel, p=p, scale=scale, eps=eps,
                              has_rng=has_rng, with_ln=with_ln)
-    return pl.pallas_call(
+    return _pallas_call(
         kern,
         grid=(n // bn,),
         in_specs=in_specs,
@@ -608,12 +640,13 @@ def fused_ln_shapes_ok(x):
     if not flag("use_fused_dropout_ln"):
         return False
     hdim = x.shape[-1]
+    n = 1
+    for s in x.shape[:-1]:
+        n *= s
     if jax.default_backend() != "tpu":
-        n = 1
-        for s in x.shape[:-1]:
-            n *= s
         return n * hdim <= 64 * 1024  # keep interpret mode cheap
-    return hdim % 128 == 0 and hdim <= 16384
+    return (hdim % 128 == 0 and hdim <= 16384
+            and _fbdrln_block_n(n, hdim) is not None)
 
 
 # ---------------------------------------------------------------------------
@@ -627,18 +660,19 @@ def fused_ln_shapes_ok(x):
 # ---------------------------------------------------------------------------
 
 
-def _adamw_kernel(lr_ref, t_ref, p_ref, g_ref, m1_ref, m2_ref,
+def _adamw_kernel(lr_ref, c_ref, p_ref, g_ref, m1_ref, m2_ref,
                   po_ref, m1o_ref, m2o_ref, *, b1, b2, eps, coeff):
+    # bias corrections c1/c2 = 1-bᵗ are precomputed OUTSIDE the kernel:
+    # Mosaic has no powf lowering, and they are scalars anyway
     lr = lr_ref[0].astype(jnp.float32)
-    tf = t_ref[0].astype(jnp.float32)
+    c1 = c_ref[0]
+    c2 = c_ref[1]
     g = g_ref[...].astype(jnp.float32)
     p = p_ref[...].astype(jnp.float32)
     if coeff:
         p = p * (1.0 - lr * coeff)  # decoupled decay (AdamW)
     m1 = b1 * m1_ref[...] + (1.0 - b1) * g
     m2 = b2 * m2_ref[...] + (1.0 - b2) * g * g
-    c1 = 1.0 - jnp.power(jnp.float32(b1), tf)
-    c2 = 1.0 - jnp.power(jnp.float32(b2), tf)
     step = lr * (m1 / c1) / (jnp.sqrt(m2 / c2) + eps)
     po_ref[...] = (p - step).astype(po_ref.dtype)
     m1o_ref[...] = m1
@@ -672,15 +706,18 @@ def fused_adamw_or_none(param, grad, lr, t, m1, m2, *, beta1, beta2,
 
     rows = numel // _LANES
     bn = _fbdrln_block_n(rows, _LANES)
+    if bn is None:
+        return None  # no legal block shape — take the jnp fallback
     shape2d = (rows, _LANES)
-    row_spec = pl.BlockSpec((bn, _LANES), lambda i: (i, 0))
-    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    row_spec = pl.BlockSpec((bn, _LANES), lambda i: (i, _I0))
+    lr_smem = pl.BlockSpec((1,), lambda i: (_I0,), memory_space=pltpu.SMEM)
+    c_smem = pl.BlockSpec((2,), lambda i: (_I0,), memory_space=pltpu.SMEM)
     kern = functools.partial(_adamw_kernel, b1=beta1, b2=beta2,
                              eps=epsilon, coeff=coeff)
-    po, m1o, m2o = pl.pallas_call(
+    po, m1o, m2o = _pallas_call(
         kern,
         grid=(rows // bn,),
-        in_specs=[smem, smem, row_spec, row_spec, row_spec, row_spec],
+        in_specs=[lr_smem, c_smem, row_spec, row_spec, row_spec, row_spec],
         out_specs=[row_spec] * 3,
         out_shape=[
             jax.ShapeDtypeStruct(shape2d, param.dtype),
@@ -690,7 +727,10 @@ def fused_adamw_or_none(param, grad, lr, t, m1, m2, *, beta1, beta2,
         input_output_aliases={2: 0, 4: 1, 5: 2},
         interpret=interpret,
     )(jnp.reshape(lr, (1,)).astype(jnp.float32),
-      jnp.reshape(t, (1,)).astype(jnp.int32),
+      jnp.stack([1.0 - jnp.power(jnp.float32(beta1),
+                                 jnp.asarray(t, jnp.float32)),
+                 1.0 - jnp.power(jnp.float32(beta2),
+                                 jnp.asarray(t, jnp.float32))]),
       param.reshape(shape2d), grad.astype(jnp.float32).reshape(shape2d),
       m1.reshape(shape2d), m2.reshape(shape2d))
     return (po.reshape(param.shape), m1o.reshape(param.shape),

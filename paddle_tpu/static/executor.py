@@ -160,6 +160,11 @@ class _CompiledProgram:
     # -- entry ---------------------------------------------------------------
     def run(self, feed_arrays):
         from ..framework.random import RNG
+        # explicit device_put of host feeds: measurably faster than letting
+        # jit transfer numpy implicitly (5x on the v5e tunnel: 835 vs
+        # ~165 MB/s — a 64x224x224 image batch costs 46 ms instead of 230)
+        feed_arrays = [jax.device_put(a) if isinstance(a, np.ndarray) else a
+                       for a in feed_arrays]
         cap_arrays = [t._data for t in self.cap_tensors]
         rng_arrays = [RNG.next_key() for _ in self.rng_names]
         if not self.train:

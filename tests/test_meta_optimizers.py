@@ -128,3 +128,61 @@ class TestStrategyHonesty:
                   "gradient_merge", "amp"]:
             setattr(strategy, s, True)
             assert getattr(strategy, s) is True
+
+
+class TestStrategyCompiler:
+    """reference: fleet/base/strategy_compiler.py — meta selection,
+    conflicts, and the _can_apply protocol."""
+
+    def test_conflicting_switches_raise(self):
+        _reset_fleet()
+        from paddle_tpu.distributed.fleet.strategy_compiler import (
+            StrategyCompiler)
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.lars = True
+        strategy.lamb = True
+        m = paddle.nn.Linear(4, 3)
+        opt = paddle.optimizer.Momentum(parameters=m.parameters())
+        with pytest.raises(ValueError, match="conflict"):
+            StrategyCompiler().select(strategy, opt)
+
+    def test_can_apply_rejects_wrong_optimizer(self):
+        from paddle_tpu.distributed.fleet.strategy_compiler import (
+            StrategyCompiler)
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.lamb = True
+        m = paddle.nn.Linear(4, 3)
+        opt = paddle.optimizer.SGD(parameters=m.parameters())
+        with pytest.raises(TypeError, match="lamb"):
+            StrategyCompiler().select(strategy, opt)
+
+    def test_stage_split_pre_then_post(self):
+        from paddle_tpu.distributed.fleet.strategy_compiler import (
+            StrategyCompiler)
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.lars = True
+        strategy.localsgd = True
+        m = paddle.nn.Linear(4, 3)
+        opt = paddle.optimizer.Momentum(parameters=m.parameters())
+        chosen = StrategyCompiler().select(strategy, opt)
+        assert [c.switch for c in chosen] == ["lars", "localsgd"]
+        assert [c.stage for c in chosen] == ["pre", "post"]
+
+    def test_compiled_path_end_to_end(self):
+        _reset_fleet()
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.lars = True
+        strategy.localsgd = True
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        m = paddle.nn.Linear(4, 3)
+        opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                        parameters=m.parameters())
+        wrapped = dist.fleet.distributed_optimizer(opt)
+        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
+            LocalSGDOptimizer)
+        assert isinstance(wrapped, LocalSGDOptimizer)
+        x = paddle.randn([8, 4])
+        m(x).sum().backward()
+        wrapped.step()
+        wrapped.clear_grad()
+        _reset_fleet()

@@ -69,3 +69,97 @@ class TestSequenceOps:
         g = x.grad.numpy()
         np.testing.assert_allclose(g[1], [1.0, 0, 0, 0], atol=1e-6)
         np.testing.assert_allclose(g[0], [1 / 3] * 3 + [0], atol=1e-6)
+
+
+class TestSequenceOpsBreadth:
+    """The remaining sequence_ops family (reference:
+    operators/sequence_ops/sequence_concat_op.h, sequence_enumerate_op.h,
+    sequence_erase_op.h, sequence_reshape_op.h, sequence_slice_op.h,
+    sequence_scatter_op.h, sequence_conv_op.h)."""
+
+    def test_concat(self):
+        a = paddle.to_tensor(np.arange(5, dtype=np.float32)[:, None])
+        b = paddle.to_tensor(np.arange(10, 14, dtype=np.float32)[:, None])
+        vals, lens = F.sequence_concat(
+            [a, b], [paddle.to_tensor(np.array([2, 3])),
+                     paddle.to_tensor(np.array([1, 3]))])
+        assert lens.numpy().tolist() == [3, 6]
+        np.testing.assert_allclose(
+            vals.numpy().ravel(), [0, 1, 10, 2, 3, 4, 11, 12, 13])
+
+    def test_enumerate(self):
+        ids = paddle.to_tensor(np.array([1, 2, 3, 7, 8], np.int64))
+        lens = paddle.to_tensor(np.array([3, 2], np.int64))
+        out = F.sequence_enumerate(ids, lens, win_size=2, pad_value=0)
+        np.testing.assert_array_equal(
+            out.numpy(), [[1, 2], [2, 3], [3, 0], [7, 8], [8, 0]])
+
+    def test_erase(self):
+        ids = paddle.to_tensor(np.array([2, 3, 5, 2, 6, 2], np.int64))
+        lens = paddle.to_tensor(np.array([4, 2], np.int64))
+        vals, out_lens = F.sequence_erase(ids, lens, [2, 5])
+        assert out_lens.numpy().tolist() == [1, 1]
+        assert vals.numpy().tolist() == [3, 6]
+
+    def test_reshape(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+        lens = paddle.to_tensor(np.array([4, 2], np.int64))
+        vals, out_lens = F.sequence_reshape(x, lens, new_dim=4)
+        assert out_lens.numpy().tolist() == [2, 1]
+        assert vals.shape == [3, 4]
+        np.testing.assert_allclose(vals.numpy().ravel(),
+                                   np.arange(12, dtype=np.float32))
+
+    def test_slice(self):
+        x = paddle.to_tensor(np.arange(10, dtype=np.float32)[:, None])
+        lens = paddle.to_tensor(np.array([6, 4], np.int64))
+        vals, out_lens = F.sequence_slice(
+            x, lens, paddle.to_tensor(np.array([1, 0], np.int64)),
+            paddle.to_tensor(np.array([2, 3], np.int64)))
+        assert out_lens.numpy().tolist() == [2, 3]
+        np.testing.assert_allclose(vals.numpy().ravel(), [1, 2, 6, 7, 8])
+        with pytest.raises(ValueError, match="out of range"):
+            F.sequence_slice(
+                x, lens, paddle.to_tensor(np.array([5, 0], np.int64)),
+                paddle.to_tensor(np.array([2, 3], np.int64)))
+
+    def test_scatter(self):
+        x = paddle.to_tensor(np.zeros((2, 5), np.float32))
+        out = F.sequence_scatter(
+            x, paddle.to_tensor(np.array([1, 1, 4, 0], np.int64)),
+            paddle.to_tensor(np.array([1., 2., 3., 9.], np.float32)),
+            paddle.to_tensor(np.array([3, 1], np.int64)))
+        np.testing.assert_allclose(out.numpy()[0], [0, 3, 0, 0, 3])
+        np.testing.assert_allclose(out.numpy()[1], [9, 0, 0, 0, 0])
+
+    def test_conv_matches_manual(self):
+        rs = np.random.RandomState(0)
+        B, T, D, F_out, ctx = 2, 5, 3, 4, 3
+        x = rs.randn(B, T, D).astype(np.float32)
+        w = rs.randn(ctx * D, F_out).astype(np.float32)
+        lens = np.array([5, 3], np.int64)
+        out = F.sequence_conv(paddle.to_tensor(x), paddle.to_tensor(w),
+                              paddle.to_tensor(lens), context_length=ctx)
+        ref = np.zeros((B, T, F_out), np.float32)
+        start = -((ctx - 1) // 2)
+        for b in range(B):
+            for t in range(int(lens[b])):
+                window = []
+                for c in range(ctx):
+                    pos = t + start + c
+                    if 0 <= pos < int(lens[b]):
+                        window.append(x[b, pos])
+                    else:
+                        window.append(np.zeros(D, np.float32))
+                ref[b, t] = np.concatenate(window) @ w
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_conv_grad_flows(self):
+        w = paddle.to_tensor(
+            np.random.RandomState(1).randn(9, 2).astype(np.float32))
+        w.stop_gradient = False
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(1, 4, 3).astype(np.float32))
+        lens = paddle.to_tensor(np.array([4], np.int64))
+        F.sequence_conv(x, w, lens, context_length=3).sum().backward()
+        assert w.grad is not None and np.isfinite(w.grad.numpy()).all()

@@ -20,6 +20,9 @@ Categories:
 """
 
 WHITE_LIST = {
+    "sequence_conv_op": ("dedicated — required context attrs + integer "
+                         "lengths input; grads + parity in "
+                         "test_sequence_ops.TestSequenceOpsBreadth"),
     # rng
     "alpha_dropout_op": "rng",
     "bernoulli_op": "rng",
