@@ -109,11 +109,8 @@ def bench_fused_ln(N=8192, Hdim=768, p=0.1, dtype=jnp.bfloat16):
             return x
         return step
 
-    tp = timeit(chain(lambda x, r, k2: fused._fun(x, r, k2)
-                      if hasattr(fused, "_fun") else fused(x, r, k2)),
-                x, res, key, iters=3) / CHAIN
-    tx = timeit(chain(lambda x, r, k2: unfused(x, r, k2)),
-                x, res, key, iters=3) / CHAIN
+    tp = timeit(chain(fused), x, res, key, iters=3) / CHAIN
+    tx = timeit(chain(unfused), x, res, key, iters=3) / CHAIN
     return {"kernel": "fused_bias_dropout_residual_ln_fwd_bwd",
             "shape": [N, Hdim], "dtype": str(dtype.__name__),
             "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),

@@ -1,0 +1,64 @@
+/* paddle_tpu inference C API.
+ *
+ * Reference: paddle/fluid/inference/capi/c_api.h (PD_NewAnalysisConfig /
+ * PD_NewPredictor / PD_ZeroCopyRun surface over the C++ AnalysisPredictor).
+ * Here the predictor runtime is the Python-side compiled XLA executor
+ * (paddle_tpu.inference.Predictor); this library embeds a CPython
+ * interpreter and drives it through the stable C ABI, so a plain C/C++
+ * serving process can load a saved inference model and run it on TPU
+ * without writing any Python.
+ *
+ * Thread-model: calls take the GIL internally; concurrent calls from
+ * multiple threads are safe but serialized.
+ */
+#ifndef PADDLE_TPU_INFERENCE_C_H_
+#define PADDLE_TPU_INFERENCE_C_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Predictor PD_Predictor;
+
+typedef enum {
+  PD_DTYPE_FLOAT32 = 0,
+  PD_DTYPE_INT64 = 1,
+  PD_DTYPE_INT32 = 2,
+} PD_DType;
+
+/* Load a model saved by paddle.static.save_inference_model(prefix, ...).
+ * Returns NULL on failure (see PD_GetLastError). */
+PD_Predictor* PD_NewPredictor(const char* model_prefix);
+void PD_DeletePredictor(PD_Predictor* pred);
+
+int PD_PredictorGetInputNum(PD_Predictor* pred);
+int PD_PredictorGetOutputNum(PD_Predictor* pred);
+/* Returned strings are owned by the predictor; valid until deletion. */
+const char* PD_PredictorGetInputName(PD_Predictor* pred, int i);
+const char* PD_PredictorGetOutputName(PD_Predictor* pred, int i);
+
+/* Copy `data` (row-major, `ndim` dims of `shape`) into input `name`. */
+int PD_PredictorSetInput(PD_Predictor* pred, const char* name,
+                         const void* data, const int64_t* shape, int ndim,
+                         PD_DType dtype);
+
+/* Run the compiled program on the configured inputs. 0 on success. */
+int PD_PredictorRun(PD_Predictor* pred);
+
+/* Output introspection + copy-out after a successful run. */
+int PD_PredictorGetOutputNumDims(PD_Predictor* pred, const char* name);
+int PD_PredictorGetOutputShape(PD_Predictor* pred, const char* name,
+                               int64_t* shape /* len >= ndim */);
+int PD_PredictorCopyOutput(PD_Predictor* pred, const char* name,
+                           void* dst, int64_t nbytes);
+
+/* Last error message for this thread ("" if none). */
+const char* PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_INFERENCE_C_H_ */

@@ -73,6 +73,10 @@ from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401
 from . import linalg  # noqa: F401
+from . import fluid  # noqa: F401  (legacy compat namespace)
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from . import cost_model  # noqa: F401
 from .hapi.flops import flops  # noqa: F401
 
 __version__ = "0.1.0"
@@ -128,3 +132,20 @@ def summary(net, input_size=None, dtypes=None, input=None):
     print(f"Trainable params: {trainable}")
     print(f"Non-trainable params: {total - trainable}")
     return {"total_params": total, "trainable_params": trainable}
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Classic reader batching (reference: python/paddle/batch.py) — turns
+    a sample reader into a reader of lists of batch_size samples."""
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -1,0 +1,113 @@
+"""paddle.dataset — legacy reader-style dataset loaders.
+
+Reference: python/paddle/dataset/ (mnist.py, cifar.py, uci_housing.py,
+imdb.py — each exposes train()/test() returning zero-arg readers that
+yield numpy samples). The modern surface is paddle.vision.datasets /
+paddle.text.datasets (map-style Datasets); these adapters re-expose them
+in the classic reader protocol so pre-2.0 pipelines
+(`paddle.batch(paddle.dataset.mnist.train(), 128)`) run unchanged."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb"]
+
+
+def _reader_of(dataset, transform=None):
+    def reader():
+        for i in range(len(dataset)):
+            item = dataset[i]
+            yield transform(item) if transform else item
+
+    return reader
+
+
+class _Mnist:
+    """mnist.train()/test() yield (flattened 784 float image, int label)
+    (reference: dataset/mnist.py reader_creator)."""
+
+    @staticmethod
+    def train():
+        from ..vision.datasets import MNIST
+        ds = MNIST(mode="train")
+        return _reader_of(ds, lambda it: (
+            np.asarray(it[0], np.float32).reshape(-1), int(it[1])))
+
+    @staticmethod
+    def test():
+        from ..vision.datasets import MNIST
+        ds = MNIST(mode="test")
+        return _reader_of(ds, lambda it: (
+            np.asarray(it[0], np.float32).reshape(-1), int(it[1])))
+
+
+class _Cifar:
+    """cifar.train10()/test10() yield (3072 float vector, int label)."""
+
+    @staticmethod
+    def train10():
+        from ..vision.datasets import Cifar10
+        ds = Cifar10(mode="train")
+        return _reader_of(ds, lambda it: (
+            np.asarray(it[0], np.float32).reshape(-1), int(it[1])))
+
+    @staticmethod
+    def test10():
+        from ..vision.datasets import Cifar10
+        ds = Cifar10(mode="test")
+        return _reader_of(ds, lambda it: (
+            np.asarray(it[0], np.float32).reshape(-1), int(it[1])))
+
+    @staticmethod
+    def train100():
+        from ..vision.datasets import Cifar100
+        ds = Cifar100(mode="train")
+        return _reader_of(ds, lambda it: (
+            np.asarray(it[0], np.float32).reshape(-1), int(it[1])))
+
+    @staticmethod
+    def test100():
+        from ..vision.datasets import Cifar100
+        ds = Cifar100(mode="test")
+        return _reader_of(ds, lambda it: (
+            np.asarray(it[0], np.float32).reshape(-1), int(it[1])))
+
+
+class _UCIHousing:
+    """uci_housing.train()/test() yield (13 features, 1 target)."""
+
+    @staticmethod
+    def train():
+        from ..text.datasets import UCIHousing
+        return _reader_of(UCIHousing(mode="train"))
+
+    @staticmethod
+    def test():
+        from ..text.datasets import UCIHousing
+        return _reader_of(UCIHousing(mode="test"))
+
+
+class _Imdb:
+    """imdb.train(word_idx)/test(word_idx) yield (ids, 0/1 label)."""
+
+    @staticmethod
+    def word_dict():
+        from ..text.datasets import Imdb
+        ds = Imdb(mode="train")
+        return dict(ds.word_idx) if hasattr(ds, "word_idx") else {}
+
+    @staticmethod
+    def train(word_idx=None):
+        from ..text.datasets import Imdb
+        return _reader_of(Imdb(mode="train"))
+
+    @staticmethod
+    def test(word_idx=None):
+        from ..text.datasets import Imdb
+        return _reader_of(Imdb(mode="test"))
+
+
+mnist = _Mnist()
+cifar = _Cifar()
+uci_housing = _UCIHousing()
+imdb = _Imdb()

@@ -80,6 +80,18 @@ def estimate_jaxpr_cost(jaxpr) -> JaxprCost:
         # recurse into call-like eqns; loop bodies run `length` times
         # (scan) — while_loop trip counts are data-dependent, so its body
         # is priced once (a documented lower bound)
+        if "branches" in eqn.params:  # lax.cond/switch: price the worst arm
+            best = None
+            for br in eqn.params["branches"]:
+                sub = estimate_jaxpr_cost(br)
+                if best is None or sub.flops > best.flops:
+                    best = sub
+            if best is not None:
+                cost.flops += best.flops
+                cost.bytes += best.bytes
+                for k, v in best.by_prim.items():
+                    cost.by_prim[k] = cost.by_prim.get(k, 0.0) + v
+            continue
         for key, rep_key in (("jaxpr", "length"), ("call_jaxpr", None),
                              ("fun_jaxpr", None), ("body_jaxpr", None)):
             if key in eqn.params:

@@ -1,0 +1,103 @@
+"""paddle.fluid — the legacy compat namespace.
+
+Reference: python/paddle/fluid/__init__.py. Pre-2.0 user code is written
+against `import paddle.fluid as fluid` (Program/Executor/layers.fc/
+dygraph.guard); this package maps that surface onto the TPU-native
+modern API so reference-era scripts run unchanged. Everything here is a
+thin delegation — no second implementation.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import state as _state
+from ..framework.place import (CPUPlace, CUDAPinnedPlace,  # noqa: F401
+                               CUDAPlace)
+from ..framework.tensor import Tensor
+from ..nn.layer_base import ParamAttr  # noqa: F401
+from ..static import (Executor, Program, Scope,  # noqa: F401
+                      default_main_program, default_startup_program,
+                      global_scope)
+from ..static import program_guard as _modern_program_guard
+from ..static.program import data  # noqa: F401
+from .. import nn  # noqa: F401
+from ..nn import initializer  # noqa: F401
+from .. import optimizer as _opt_mod
+from .. import io as _io_mod  # noqa: F401
+from . import layers  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import io  # noqa: F401
+
+__all__ = ["CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "Executor",
+           "Program", "Scope", "ParamAttr", "data", "layers", "dygraph",
+           "io", "initializer", "optimizer", "default_main_program",
+           "default_startup_program", "program_guard", "global_scope",
+           "scope_guard", "enable_dygraph", "disable_dygraph",
+           "in_dygraph_mode", "is_compiled_with_cuda"]
+
+
+class _OptimizerCompat:
+    """fluid.optimizer.* — classic names over the modern classes
+    (reference: fluid/optimizer.py SGDOptimizer/AdamOptimizer/...)."""
+
+    SGD = SGDOptimizer = _opt_mod.SGD
+    Momentum = MomentumOptimizer = _opt_mod.Momentum
+    Adagrad = AdagradOptimizer = _opt_mod.Adagrad
+    Adam = AdamOptimizer = _opt_mod.Adam
+    AdamW = _opt_mod.AdamW
+    Adamax = AdamaxOptimizer = _opt_mod.Adamax
+    Adadelta = AdadeltaOptimizer = _opt_mod.Adadelta
+    RMSProp = RMSPropOptimizer = _opt_mod.RMSProp
+    Lamb = LambOptimizer = _opt_mod.Lamb
+    Ftrl = FtrlOptimizer = _opt_mod.Ftrl
+    Dpsgd = DpsgdOptimizer = _opt_mod.Dpsgd
+    LarsMomentum = LarsMomentumOptimizer = _opt_mod.Lars
+
+
+optimizer = _OptimizerCompat
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """fluid-era program_guard: fluid 1.x was implicitly static-mode, so
+    the guard also enables static mode for its scope (modern code calls
+    paddle.enable_static() explicitly instead)."""
+    prev = _state.STATE.static_mode
+    _state.STATE.static_mode = True
+    try:
+        with _modern_program_guard(main_program, startup_program):
+            yield
+    finally:
+        _state.STATE.static_mode = prev
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """reference: fluid/executor.py scope_guard — scopes are implicit in
+    the TPU build (variables live on python objects), so this is a
+    no-op context preserved for API compatibility."""
+    yield scope
+
+
+def enable_dygraph(place=None):
+    _state.STATE.static_mode = False
+
+
+def disable_dygraph():
+    _state.STATE.static_mode = True
+
+
+def in_dygraph_mode():
+    return not _state.in_static_mode()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def create_lod_tensor(data_arr, recursive_seq_lens, place=None):
+    """LoD tensors map to (padded dense, lengths) — see SURVEY §7. The
+    compat shim returns a plain Tensor of the flat data; lengths travel
+    separately in the sequence ops."""
+    import numpy as np
+    return Tensor(np.asarray(data_arr), _internal=True)

@@ -1,0 +1,71 @@
+"""fluid.dygraph — legacy imperative-mode API.
+
+Reference: python/paddle/fluid/dygraph/__init__.py (guard, to_variable,
+Layer, nn sublayers). The modern engine IS imperative by default, so
+`guard` just ensures dygraph mode; `to_variable` is to_tensor."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..framework import state as _state
+from ..framework.tensor import Tensor, to_tensor
+from ..nn.layer_base import Layer  # noqa: F401
+from ..framework.state import no_grad  # noqa: F401
+from .. import nn as _nn
+
+__all__ = ["guard", "to_variable", "Layer", "no_grad", "Linear",
+           "Conv2D", "BatchNorm", "Embedding", "Pool2D", "Dropout",
+           "LayerNorm", "enabled"]
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    prev = _state.STATE.static_mode
+    _state.STATE.static_mode = False
+    try:
+        yield
+    finally:
+        _state.STATE.static_mode = prev
+
+
+def enabled():
+    return not _state.in_static_mode()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    if isinstance(value, Tensor):
+        return value
+    t = to_tensor(np.asarray(value))
+    if dtype is not None:
+        from ..tensor import cast
+        t = cast(t, dtype)
+    return t
+
+
+# classic dygraph sublayer names (reference: fluid/dygraph/nn.py — note
+# the old Linear took (input_dim, output_dim) like the modern one)
+Linear = _nn.Linear
+Conv2D = _nn.Conv2D
+BatchNorm = _nn.BatchNorm2D
+Embedding = _nn.Embedding
+LayerNorm = _nn.LayerNorm
+Dropout = _nn.Dropout
+
+
+class Pool2D(Layer):
+    """reference: fluid/dygraph/nn.py Pool2D."""
+
+    def __init__(self, pool_size=2, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False):
+        super().__init__()
+        self._cfg = dict(pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride,
+                         pool_padding=pool_padding,
+                         global_pooling=global_pooling,
+                         ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        from .layers import pool2d
+        return pool2d(x, **self._cfg)

@@ -1,0 +1,65 @@
+"""fluid.io — legacy save/load API (reference: python/paddle/fluid/io.py
+save_inference_model:1246 / load_inference_model:1466, save_persistables).
+Delegates to the modern static/io + framework/io implementations."""
+from __future__ import annotations
+
+import os
+
+from ..framework.io import load as _load
+from ..framework.io import save as _save
+from ..static.io import load_inference_model as _load_inf
+from ..static.io import save_inference_model as _save_inf
+
+__all__ = ["save_inference_model", "load_inference_model",
+           "save_persistables", "load_persistables", "save", "load",
+           "DataLoader"]
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, **kw):
+    """Legacy signature: feed names + fetch Variables + a directory."""
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    feed_vars = [prog.vars[n] if isinstance(n, str) else n
+                 for n in feeded_var_names]
+    prefix = os.path.join(dirname, model_filename or "model")
+    return _save_inf(prefix, feed_vars, list(target_vars), executor,
+                     program=prog)
+
+
+def load_inference_model(dirname, executor=None, model_filename=None,
+                         params_filename=None):
+    prefix = os.path.join(dirname, model_filename or "model")
+    return _load_inf(prefix, executor)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    sd = {p.name or f"param_{i}": p
+          for i, p in enumerate(prog.all_parameters())}
+    _save(sd, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import default_main_program
+    import numpy as np
+    prog = main_program or default_main_program()
+    sd = _load(os.path.join(dirname, filename or "persistables.pdparams"))
+    for i, p in enumerate(prog.all_parameters()):
+        key = p.name or f"param_{i}"
+        if key in sd:
+            v = sd[key]
+            p.set_value(np.asarray(v.numpy() if hasattr(v, "numpy") else v))
+
+
+def save(state_dict, path):
+    return _save(state_dict, path)
+
+
+def load(path, **cfg):
+    return _load(path)
+
+
+from ..io import DataLoader  # noqa: E402,F401

@@ -1,0 +1,346 @@
+"""fluid.layers — the classic functional layer API.
+
+Reference: python/paddle/fluid/layers/nn.py (fc:212, conv2d, pool2d,
+batch_norm, ...), tensor.py (fill_constant, cast, concat), loss.py
+(cross_entropy). Layers that create parameters (fc/conv2d/batch_norm/
+embedding) instantiate the modern nn.Layer on first call and cache it on
+the call site's name, mirroring how the reference's LayerHelper reuses
+parameters by unique name within a program."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import nn as _nn
+from .. import tensor as _t
+import paddle_tpu.nn.functional as F
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
+    "dropout", "softmax", "relu", "sigmoid", "tanh", "cross_entropy",
+    "softmax_with_cross_entropy", "mean", "reduce_sum", "reduce_mean",
+    "reduce_max", "reduce_min", "reduce_prod", "matmul", "mul",
+    "transpose", "reshape", "squeeze", "unsqueeze", "concat", "split",
+    "cast", "fill_constant", "zeros", "ones", "one_hot", "topk",
+    "gather", "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "accuracy", "data", "sequence_pool", "sequence_conv",
+    "sequence_softmax", "l2_normalize", "clip", "pad", "label_smooth",
+]
+
+# parameter-creating layers are cached per PROGRAM (WeakKeyDictionary:
+# entries die with the Program, so a sweep building many programs does
+# not leak and a recycled id() cannot resurrect stale weights) so
+# repeated calls reuse weights like LayerHelper does. In dygraph mode
+# names are process-global (the reference's dygraph parameter naming).
+import weakref
+
+_PROGRAM_CACHES = weakref.WeakKeyDictionary()
+_DYGRAPH_CACHE: Dict[tuple, object] = {}
+_AUTO = [0]
+
+
+def _scope_cache():
+    from ..framework import state as _state
+    if not _state.in_static_mode():
+        return _DYGRAPH_CACHE
+    from ..static.program import default_main_program
+    prog = default_main_program()
+    cache = _PROGRAM_CACHES.get(prog)
+    if cache is None:
+        cache = {}
+        _PROGRAM_CACHES[prog] = cache
+    return cache
+
+
+def _cached(name: Optional[str], kind: str, build):
+    if name is None:
+        _AUTO[0] += 1
+        return build()  # anonymous: fresh params every call
+    cache = _scope_cache()
+    key = (kind, name)
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """reference: fluid/layers/nn.py:212."""
+    x = input
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= int(d)
+    lin = _cached(name, "fc", lambda: _nn.Linear(
+        in_dim, size, weight_attr=param_attr, bias_attr=bias_attr))
+    flat = _t.flatten(x, num_flatten_dims) if x.ndim > num_flatten_dims + 1 \
+        else x
+    out = lin(flat)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    emb = _cached(name, "embedding", lambda: _nn.Embedding(
+        size[0], size[1], padding_idx=padding_idx, sparse=is_sparse,
+        weight_attr=param_attr))
+    return emb(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    cin = int(input.shape[1 if data_format == "NCHW" else -1])
+    conv = _cached(name, "conv2d", lambda: _nn.Conv2D(
+        cin, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format))
+    out = conv(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def pool2d(input, pool_size=2, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCHW", name=None):
+    if global_pooling:
+        axes = (2, 3) if data_format == "NCHW" else (1, 2)
+        red = _t.max if pool_type == "max" else _t.mean
+        return red(input, axis=list(axes), keepdim=True)
+    fn = F.max_pool2d if pool_type == "max" else F.avg_pool2d
+    kw = {} if pool_type == "max" else {"exclusive": exclusive}
+    return fn(input, kernel_size=pool_size, stride=pool_stride,
+              padding=pool_padding, ceil_mode=ceil_mode, **kw)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None):
+    cin = int(input.shape[1 if data_layout == "NCHW" else -1])
+    bn = _cached(name, "batch_norm", lambda: _nn.BatchNorm2D(
+        cin, momentum=momentum, epsilon=epsilon, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_layout))
+    if is_test:
+        bn.eval()
+    out = bn(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, name=None):
+    shape = [int(d) for d in input.shape[begin_norm_axis:]]
+    ln = _cached(name, "layer_norm", lambda: _nn.LayerNorm(
+        shape, epsilon=epsilon,
+        weight_attr=param_attr if scale else False,
+        bias_attr=bias_attr if shift else False))
+    return ln(input)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None,
+            dropout_implementation="downgrade_in_infer", name=None):
+    mode = ("downscale_in_infer"
+            if dropout_implementation == "downgrade_in_infer"
+            else dropout_implementation)
+    return F.dropout(x, p=dropout_prob, training=not is_test, mode=mode)
+
+
+def softmax(input, axis=-1, name=None):
+    return F.softmax(input, axis=axis)
+
+
+def relu(x, name=None):
+    return F.relu(x)
+
+
+def sigmoid(x, name=None):
+    return F.sigmoid(x)
+
+
+def tanh(x, name=None):
+    return F.tanh(x)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """reference: fluid/layers/loss.py cross_entropy — input is expected
+    to be PROBABILITIES (post-softmax), unlike paddle.nn CrossEntropyLoss
+    which takes logits."""
+    eps = 1e-12
+    if soft_label:
+        return -_t.sum(label * _t.log(input + eps), axis=-1, keepdim=True)
+    lab = label
+    if lab.ndim == input.ndim:  # [..., 1] int labels
+        lab = _t.squeeze(lab, -1)
+    onehot = F.one_hot(lab, input.shape[-1])
+    return -_t.sum(onehot.astype(input.dtype) * _t.log(input + eps),
+                   axis=-1, keepdim=True)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1):
+    return F.softmax_with_cross_entropy(logits, label,
+                                        soft_label=soft_label,
+                                        ignore_index=ignore_index)
+
+
+def mean(x, name=None):
+    return _t.mean(x)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _t.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _t.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _t.max(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _t.min(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _t.prod(input, axis=dim, keepdim=keep_dim)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    out = _t.matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    xf = _t.flatten(x, x_num_col_dims) if x.ndim > x_num_col_dims + 1 else x
+    return _t.matmul(xf, y)
+
+
+def transpose(x, perm, name=None):
+    return _t.transpose(x, perm)
+
+
+def reshape(x, shape, name=None):
+    return _t.reshape(x, shape)
+
+
+def squeeze(input, axes=None, name=None):
+    return _t.squeeze(input, axes)
+
+
+def unsqueeze(input, axes, name=None):
+    if isinstance(axes, (list, tuple)):
+        out = input
+        for a in sorted(axes):
+            out = _t.unsqueeze(out, a)
+        return out
+    return _t.unsqueeze(input, axes)
+
+
+def concat(input, axis=0, name=None):
+    return _t.concat(input, axis=axis)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    return _t.split(input, num_or_sections, axis=dim)
+
+
+def cast(x, dtype):
+    return _t.cast(x, dtype)
+
+
+def fill_constant(shape, dtype, value, name=None):
+    import paddle_tpu as paddle
+    return paddle.full(shape, value, dtype=dtype)
+
+
+def zeros(shape, dtype="float32", name=None):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype="float32", name=None):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def one_hot(input, depth, name=None):
+    x = _t.squeeze(input, -1) if input.ndim > 1 and \
+        int(input.shape[-1]) == 1 else input
+    return F.one_hot(x, depth)
+
+
+def topk(input, k, name=None):
+    return _t.topk(input, k)
+
+
+def gather(input, index, overwrite=True, name=None):
+    return _t.gather(input, index)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    out = x + y
+    return getattr(F, act)(out) if act else out
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    out = x - y
+    return getattr(F, act)(out) if act else out
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    out = x * y
+    return getattr(F, act)(out) if act else out
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    out = x / y
+    return getattr(F, act)(out) if act else out
+
+
+def accuracy(input, label, k=1, name=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    return F.normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+def clip(x, min, max, name=None):  # noqa: A002
+    return _t.clip(x, min, max)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return F.pad(x, paddings, value=pad_value)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    n = int(label.shape[-1])
+    return label * (1.0 - epsilon) + epsilon / n
+
+
+def sequence_pool(x, pool_type, lengths=None, name=None):
+    return F.sequence_pool(x, pool_type, lengths)
+
+
+def sequence_conv(x, weight, lengths=None, context_length=3,
+                  context_start=None, name=None):
+    return F.sequence_conv(x, weight, lengths, context_length,
+                           context_start)
+
+
+def sequence_softmax(x, lengths=None, name=None):
+    return F.sequence_softmax(x, lengths)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    from ..static.program import data as _data
+    return _data(name, shape, dtype)

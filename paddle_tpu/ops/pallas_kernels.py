@@ -498,6 +498,13 @@ def _fbdrln_call(kernel, n_out, rng, arrs, out_dtypes, *, p, scale, eps,
                  has_rng, with_ln, interpret):
     n, hdim = arrs[0].shape
     bn = _fbdrln_block_n(n, hdim)
+    if bn is None:
+        # gated entries never get here (fused_ln_shapes_ok checks); direct
+        # callers of the public array API can
+        raise ValueError(
+            f"fused dropout+LN: no legal TPU block for rows={n}, "
+            f"hdim={hdim} (rows must be divisible by 8 or small enough "
+            "for a single block) — use the unfused functional path")
     row_spec = pl.BlockSpec((bn, hdim), lambda i: (i, _I0))
     vec_spec = pl.BlockSpec((1, hdim), lambda i: (_I0, _I0))
     if has_rng:

@@ -1,0 +1,192 @@
+"""paddle.reader — legacy reader decorators.
+
+Reference: python/paddle/reader/decorator.py (cache:52, map_readers:92,
+shuffle:134, chain:183, compose:248, buffered:308, firstn:367,
+xmap_readers:412, multiprocess_reader:505). A "reader" is a zero-arg
+callable returning an iterable of samples; decorators compose them.
+Pure-python utilities — identical semantics, no device involvement
+(the modern pipeline is paddle.io.DataLoader; these exist so
+reference-era input pipelines run unchanged)."""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers"]
+
+
+def cache(reader):
+    """Materialise on first use, replay from memory afterwards. The full
+    pass happens eagerly when the first iteration starts — a partially
+    consumed first epoch must not poison later epochs with duplicates."""
+    state = {"data": None}
+
+    def r():
+        if state["data"] is None:
+            state["data"] = list(reader())
+        yield from state["data"]
+
+    return r
+
+
+def map_readers(func, *readers):
+    """Zip readers, map func over the per-reader items."""
+
+    def r():
+        for items in zip(*[rd() for rd in readers]):
+            yield func(*items)
+
+    return r
+
+
+def shuffle(reader, buf_size):
+    """Window shuffle with a buf_size reservoir."""
+
+    def r():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return r
+
+
+def chain(*readers):
+    """Concatenate readers end to end."""
+
+    def r():
+        return itertools.chain(*[rd() for rd in readers])
+
+    return r
+
+
+def compose(*readers, check_alignment=True):
+    """Zip readers into flattened tuples per step."""
+
+    def _flatten(item):
+        if isinstance(item, tuple):
+            return item
+        return (item,)
+
+    def r():
+        its = [rd() for rd in readers]
+        for items in itertools.zip_longest(*its):
+            if check_alignment and any(i is None for i in items):
+                raise RuntimeError(
+                    "compose: readers have different lengths")
+            yield sum((_flatten(i) for i in items), ())
+
+    return r
+
+
+def buffered(reader, size):
+    """Background thread keeps `size` items prefetched. A reader error is
+    re-raised in the consumer — never silently truncated to EOF."""
+
+    _END = object()
+
+    def r():
+        q = _queue.Queue(maxsize=size)
+        err = []
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            except BaseException as e:  # noqa: BLE001 — resurfaced below
+                err.append(e)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                break
+            yield item
+
+    return r
+
+
+def firstn(reader, n):
+    def r():
+        return itertools.islice(reader(), n)
+
+    return r
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker THREADS (the reference uses
+    threads too; numpy/jax release the GIL for the heavy parts)."""
+
+    _END = object()
+
+    def r():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def feed():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(_END)
+
+        errors = []
+
+        def work():
+            while True:
+                got = in_q.get()
+                if got is _END:
+                    out_q.put(_END)
+                    return
+                i, item = got
+                try:
+                    out_q.put((i, mapper(item)))
+                except BaseException as e:  # noqa: BLE001 — resurfaced
+                    errors.append(e)
+                    out_q.put(_END)
+                    return
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        done = 0
+        if order:
+            pending = {}
+            want = 0
+            while done < process_num:
+                got = out_q.get()
+                if got is _END:
+                    done += 1
+                    continue
+                i, val = got
+                pending[i] = val
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while done < process_num:
+                got = out_q.get()
+                if got is _END:
+                    done += 1
+                    continue
+                yield got[1]
+        if errors:
+            raise errors[0]
+
+    return r

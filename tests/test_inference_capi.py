@@ -1,0 +1,141 @@
+"""Inference C API: a REAL C program loads a saved model and runs it.
+
+Reference: paddle/fluid/inference/capi/ (c_api.h over AnalysisPredictor)
+and its unittests (fluid/inference/tests/api/analyzer_capi_tester.cc).
+The test saves an inference model, compiles a C driver against
+native/src/inference_c.h with g++, executes it in a clean process, and
+compares its printed output against the in-process Python predictor."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_DRIVER = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include "inference_c.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) return 2;
+  PD_Predictor* pred = PD_NewPredictor(argv[1]);
+  if (!pred) { fprintf(stderr, "new: %s\n", PD_GetLastError()); return 3; }
+  if (PD_PredictorGetInputNum(pred) != 1) return 4;
+  const char* in_name = PD_PredictorGetInputName(pred, 0);
+  const char* out_name = PD_PredictorGetOutputName(pred, 0);
+
+  float data[2 * 8];
+  for (int i = 0; i < 16; ++i) data[i] = (float)i * 0.25f - 2.0f;
+  int64_t shape[2] = {2, 8};
+  if (PD_PredictorSetInput(pred, in_name, data, shape, 2,
+                           PD_DTYPE_FLOAT32) != 0) {
+    fprintf(stderr, "set: %s\n", PD_GetLastError());
+    return 5;
+  }
+  if (PD_PredictorRun(pred) != 0) {
+    fprintf(stderr, "run: %s\n", PD_GetLastError());
+    return 6;
+  }
+  int nd = PD_PredictorGetOutputNumDims(pred, out_name);
+  int64_t oshape[8];
+  PD_PredictorGetOutputShape(pred, out_name, oshape);
+  int64_t numel = 1;
+  for (int i = 0; i < nd; ++i) numel *= oshape[i];
+  float* out = (float*)malloc(numel * sizeof(float));
+  if (PD_PredictorCopyOutput(pred, out_name, out,
+                             numel * sizeof(float)) != 0) {
+    fprintf(stderr, "copy: %s\n", PD_GetLastError());
+    return 7;
+  }
+  printf("%d\n", nd);
+  for (int i = 0; i < nd; ++i) printf("%lld ", (long long)oshape[i]);
+  printf("\n");
+  for (int64_t i = 0; i < numel; ++i) printf("%.6f ", out[i]);
+  printf("\n");
+  // second run with the same input must be cached + identical
+  if (PD_PredictorRun(pred) != 0) return 8;
+  PD_DeletePredictor(pred);
+  free(out);
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def capi_lib():
+    lib = os.path.join(ROOT, "native", "build",
+                       "libpaddle_tpu_inference_c.so")
+    r = subprocess.run(["make", "-C", os.path.join(ROOT, "native"),
+                        "inference_c"], capture_output=True, text=True)
+    if r.returncode != 0 or not os.path.exists(lib):
+        pytest.skip(f"cannot build inference_c: {r.stderr[-300:]}")
+    return lib
+
+
+@pytest.fixture()
+def saved_model(tmp_path):
+    from paddle_tpu import static
+    paddle.enable_static()
+    try:
+        paddle.seed(7)
+        x = static.data("x", [-1, 8], "float32")
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                   paddle.nn.Tanh(),
+                                   paddle.nn.Linear(16, 3))
+        out = net(x)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        prefix = str(tmp_path / "capi_model")
+        static.save_inference_model(prefix, [x], [out], exe)
+    finally:
+        paddle.disable_static()
+    # in-process expected output
+    xs = (np.arange(16, dtype=np.float32) * 0.25 - 2.0).reshape(2, 8)
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(prefix))
+    (want,) = pred.run([xs])
+    return prefix, np.asarray(want)
+
+
+def test_c_program_runs_saved_model(capi_lib, saved_model, tmp_path):
+    prefix, want = saved_model
+    src = tmp_path / "driver.c"
+    src.write_text(C_DRIVER)
+    exe_path = tmp_path / "driver"
+    inc = os.path.join(ROOT, "native", "src")
+    r = subprocess.run(
+        ["g++", "-O1", str(src), f"-I{inc}", capi_lib,
+         f"-Wl,-rpath,{os.path.dirname(capi_lib)}", "-o", str(exe_path)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-500:]
+
+    env = dict(os.environ, PADDLE_TPU_C_PLATFORM="cpu",
+               PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    run = subprocess.run([str(exe_path), prefix], capture_output=True,
+                         text=True, env=env, timeout=300)
+    assert run.returncode == 0, (run.stdout[-300:], run.stderr[-500:])
+    lines = run.stdout.strip().splitlines()
+    nd = int(lines[0])
+    shape = [int(v) for v in lines[1].split()]
+    vals = np.asarray([float(v) for v in lines[2].split()], np.float32)
+    assert nd == want.ndim and shape == list(want.shape)
+    np.testing.assert_allclose(vals.reshape(shape), want, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_error_surface(capi_lib, tmp_path):
+    """Bad model prefix must fail cleanly through the C ABI (no crash)."""
+    import ctypes
+    lib = ctypes.CDLL(capi_lib)
+    lib.PD_NewPredictor.restype = ctypes.c_void_p
+    lib.PD_NewPredictor.argtypes = [ctypes.c_char_p]
+    lib.PD_GetLastError.restype = ctypes.c_char_p
+    p = lib.PD_NewPredictor(str(tmp_path / "nope").encode())
+    assert not p
+    assert lib.PD_GetLastError()
