@@ -209,10 +209,84 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  "NCL", 1)
 
 
+def _index_pool_cfg(in_hw, kernel_size, stride, padding, ceil_mode):
+    """Resolve (kernel, stride, pad-pairs) for the with-index pool path:
+    one normalization shared by max_pool2d(return_mask=True) and
+    max_unpool2d, accepting the same padding forms as _pool
+    (int / per-dim / per-side pairs / 'SAME' / 'VALID')."""
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride if stride is not None else kernel_size, 2)
+    pad = _norm_padding(padding, 2)
+    if pad == "VALID":
+        pairs = [(0, 0), (0, 0)]
+    elif pad == "SAME":
+        pairs = []
+        for i in range(2):
+            out = -(-in_hw[i] // st[i])
+            total = max((out - 1) * st[i] + ks[i] - in_hw[i], 0)
+            pairs.append((total // 2, total - total // 2))
+    else:
+        pairs = [tuple(p) for p in pad]
+    if ceil_mode:
+        ext = []
+        for i in range(2):
+            lo, hi = pairs[i]
+            size = in_hw[i] + lo + hi
+            out = -(-(size - ks[i]) // st[i]) + 1
+            need = (out - 1) * st[i] + ks[i] - size
+            ext.append((lo, hi + max(need, 0)))
+        pairs = ext
+    return ks, st, tuple(pairs)
+
+
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("return_mask requires NCHW")
+        ks, st, pairs = _index_pool_cfg(tuple(x.shape[2:]), kernel_size,
+                                        stride, padding, ceil_mode)
+        return _nn.max_pool2d_with_index(x, kernel=ks, stride=st,
+                                         padding=pairs)
     return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True,
                  data_format, 2)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d(return_mask=True) (reference:
+    nn/functional/pooling.py max_unpool2d over unpool_op)."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d supports NCHW only")
+    n, c, oh, ow = x.shape
+    if output_size is None:
+        ks = _pair(kernel_size, 2)
+        st = _pair(stride if stride is not None else kernel_size, 2)
+        pad = _norm_padding(padding, 2)
+        if isinstance(pad, str):
+            raise ValueError(
+                "max_unpool2d with SAME/VALID padding needs an explicit "
+                "output_size (the inverse shape is ambiguous)")
+        out_h = (oh - 1) * st[0] - (pad[0][0] + pad[0][1]) + ks[0]
+        out_w = (ow - 1) * st[1] - (pad[1][0] + pad[1][1]) + ks[1]
+    else:
+        out_h, out_w = [int(v) for v in output_size[-2:]]
+    return _nn.max_unpool2d_prim(x, indices, out_h=int(out_h),
+                                 out_w=int(out_w))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """reference: nn/functional/common.py bilinear over
+    bilinear_tensor_product_op."""
+    return _nn.bilinear(x1, x2, weight, bias)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """reference: nn/functional/loss.py hsigmoid_loss."""
+    return _nn.hsigmoid_loss(input, label, weight, bias, path_table,
+                             path_code, num_classes=int(num_classes))
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -245,11 +319,23 @@ def _pool(x, ptype, kernel, stride, padding, ceil_mode, exclusive,
           data_format, n):
     channel_last = data_format[-1] == "C" and len(data_format) > 2
     stride = stride if stride is not None else kernel
+    ks = _pair(kernel, n)
+    st = _pair(stride, n)
     pad = _norm_padding(padding, n)
     if isinstance(pad, str):
-        pad = ((0, 0),) * n if pad == "VALID" else pad
-    return _nn.pool(x, pool_type=ptype, kernel=_pair(kernel, n),
-                    stride=_pair(stride, n), padding=pad,
+        if pad == "VALID":
+            pad = ((0, 0),) * n
+        else:  # SAME: out = ceil(in/stride), XLA-style lo/hi split
+            sp = (tuple(x.shape[1:1 + n]) if channel_last
+                  else tuple(x.shape[2:2 + n]))
+            pairs = []
+            for i in range(n):
+                out = -(-sp[i] // st[i])
+                total = max((out - 1) * st[i] + ks[i] - sp[i], 0)
+                pairs.append((total // 2, total - total // 2))
+            pad = tuple(pairs)
+    return _nn.pool(x, pool_type=ptype, kernel=ks,
+                    stride=st, padding=pad,
                     ceil_mode=bool(ceil_mode), exclusive=bool(exclusive),
                     channel_last=channel_last)
 

@@ -32,7 +32,8 @@ __all__ = [
     "LogSoftmax", "Softmax", "Maxout", "ThresholdedReLU", "GLU",
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
     "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "MarginRankingLoss",
-    "HingeEmbeddingLoss", "Identity", "CTCLoss",
+    "HingeEmbeddingLoss", "Identity", "CTCLoss", "Bilinear",
+    "PairwiseDistance", "MaxUnPool2D", "HSigmoidLoss",
 ]
 
 
@@ -259,7 +260,8 @@ class MaxPool2D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, data_format="NCHW", name=None):
         super().__init__(F.max_pool2d, kernel_size, stride, padding,
-                         ceil_mode=ceil_mode, data_format=data_format)
+                         ceil_mode=ceil_mode, data_format=data_format,
+                         **({"return_mask": True} if return_mask else {}))
 
 
 class MaxPool3D(_Pool):
@@ -1026,3 +1028,75 @@ class CTCLoss(Layer):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           blank=self.blank, reduction=self.reduction,
                           norm_by_times=norm_by_times)
+
+
+class Bilinear(Layer):
+    """out = x1ᵀ W x2 + b (reference: nn/layer/common.py Bilinear over
+    bilinear_tensor_product_op)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features), attr=weight_attr)
+        self.bias = (self.create_parameter((out_features,), attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class PairwiseDistance(Layer):
+    """||x - y||_p along the last axis (reference: nn/layer/distance.py)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = float(p)
+        self.epsilon = float(epsilon)
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ..tensor import norm
+        return norm(x - y + self.epsilon, p=self.p, axis=-1,
+                    keepdim=self.keepdim)
+
+
+class MaxUnPool2D(Layer):
+    """Inverse of MaxPool2D(return_mask=True) (reference:
+    nn/layer/pooling.py MaxUnPool2D)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._cfg = dict(kernel_size=kernel_size, stride=stride,
+                         padding=padding, data_format=data_format,
+                         output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, **self._cfg)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classification loss (reference:
+    nn/layer/loss.py HSigmoidLoss; O(log C) instead of a C-way
+    softmax — num_classes-1 internal-node weight rows)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._num_classes = num_classes
+        rows = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter((rows, feature_size),
+                                            attr=weight_attr)
+        self.bias = (self.create_parameter((rows, 1), attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias, path_table,
+                               path_code)

@@ -841,3 +841,101 @@ def ctc_loss_op(log_probs, labels, input_lengths, label_lengths, *,
     m = jnp.maximum(a_b, a_l)
     ll = shift_T + m + jnp.log(jnp.exp(a_b - m) + jnp.exp(a_l - m))
     return -ll
+
+
+@primitive("max_pool2d_with_index")
+def max_pool2d_with_index(x, *, kernel, stride, padding):
+    """Max pool returning (values, flat spatial argmax indices) —
+    reference: operators/max_pool_with_index_op (the mask consumed by
+    unpool). `padding` is explicit (lo, hi) pairs per spatial dim (the
+    functional layer resolves SAME/VALID/ceil_mode to pairs). Patch
+    extraction + argmax keeps shapes static for XLA."""
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    sh, sw = stride
+    (ph0, ph1), (pw0, pw1) = padding
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                 constant_values=neg)
+    patches = lax.conv_general_dilated_patches(
+        xp, filter_shape=(kh, kw), window_strides=(sh, sw),
+        padding=[(0, 0), (0, 0)],
+        dimension_numbers=lax.conv_dimension_numbers(
+            xp.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")))
+    _, ckk, oh, ow = patches.shape
+    pr = patches.reshape(n, c, kh * kw, oh, ow)
+    arg = jnp.argmax(pr, axis=2)                       # [n, c, oh, ow]
+    vals = jnp.max(pr, axis=2)
+    # window offset -> padded coords -> unpadded flat index
+    dh = arg // kw
+    dw = arg % kw
+    base_h = jnp.arange(oh, dtype=jnp.int32)[None, None, :, None] * sh
+    base_w = jnp.arange(ow, dtype=jnp.int32)[None, None, None, :] * sw
+    src_h = base_h + dh.astype(jnp.int32) - ph0
+    src_w = base_w + dw.astype(jnp.int32) - pw0
+    flat = jnp.clip(src_h, 0, h - 1) * w + jnp.clip(src_w, 0, w - 1)
+    return vals, flat.astype(jnp.int64)
+
+
+@primitive("max_unpool2d_op")
+def max_unpool2d_prim(x, indices, *, out_h, out_w):
+    """Scatter pooled values back to their argmax positions (reference:
+    operators/unpool_op.cc); non-selected positions are zero."""
+    n, c, oh, ow = x.shape
+    flat = indices.astype(jnp.int32).reshape(n, c, oh * ow)
+    vals = x.reshape(n, c, oh * ow)
+    out = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, idx, v: o.at[idx].set(v)))(out, flat, vals)
+    return out.reshape(n, c, out_h, out_w)
+
+
+@primitive("bilinear_op")
+def bilinear(x1, x2, weight, bias=None):
+    """out[b,o] = x1[b,i] W[o,i,j] x2[b,j] (+ bias) — reference:
+    operators/bilinear_tensor_product_op.h."""
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@primitive("hsigmoid_loss_op")
+def hsigmoid_loss(x, label, weight, bias=None, path_table=None,
+                  path_code=None, *, num_classes):
+    """Hierarchical sigmoid loss (reference: operators/hierarchical_
+    sigmoid_op.h). Default tree: complete binary heap with num_classes
+    leaves and num_classes-1 internal nodes; custom trees come in as
+    (path_table, path_code) id/bit matrices padded with -1."""
+    if path_table is None:
+        # heap indexing: leaf id = label + (num_classes - 1); ancestors
+        # (id-1)//2 ... 0 are the internal nodes whose weights are used
+        depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+        ids = label.astype(jnp.int32) + (num_classes - 1)
+        tables = []
+        codes = []
+        cur = ids
+        for _ in range(depth):
+            parent = (cur - 1) // 2
+            code = (cur % 2 == 1)  # left child has odd heap index
+            valid = cur > 0
+            tables.append(jnp.where(valid, parent, -1))
+            codes.append(jnp.where(valid, code, False))
+            cur = jnp.maximum(parent, 0)
+        path_table = jnp.stack(tables, axis=-1)     # [B, depth]
+        path_code = jnp.stack(codes, axis=-1)
+    else:
+        path_table = path_table.astype(jnp.int32)
+        path_code = path_code.astype(jnp.bool_)
+    mask = path_table >= 0
+    safe = jnp.maximum(path_table, 0)
+    w = weight[safe]                                # [B, depth, D]
+    logit = jnp.einsum("bd,bpd->bp", x, w)
+    if bias is not None:
+        logit = logit + bias.reshape(-1)[safe]
+    # label bit 1 -> sigmoid(logit), 0 -> sigmoid(-logit)
+    sign = jnp.where(path_code, 1.0, -1.0)
+    losses = jnp.logaddexp(0.0, -sign * logit)
+    losses = jnp.where(mask, losses, 0.0)
+    return jnp.sum(losses, axis=-1, keepdims=True)

@@ -175,16 +175,4 @@ from . import control_flow  # noqa: E402
 from .control_flow import case, cond, switch_case, while_loop  # noqa: E402
 
 
-class nn:  # namespace mirror of paddle.static.nn (reference: static/nn/)
-    cond = staticmethod(cond)
-    while_loop = staticmethod(while_loop)
-    case = staticmethod(case)
-    switch_case = staticmethod(switch_case)
-
-
-from ..nn import functional as _F  # noqa: E402
-
-for _sname in ("sequence_pad", "sequence_unpad", "sequence_reverse",
-               "sequence_softmax", "sequence_pool", "sequence_expand"):
-    setattr(nn, _sname, staticmethod(getattr(_F, _sname)))
-del _sname
+from . import nn  # noqa: E402,F401  (paddle.static.nn layer namespace)

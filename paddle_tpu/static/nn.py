@@ -1,0 +1,29 @@
+"""paddle.static.nn — the static-graph layer API.
+
+Reference: python/paddle/static/nn/__init__.py, which re-exports
+fluid.layers' parameter-creating functions (fc, conv2d, batch_norm, ...)
+under the modern static namespace. Identical arrangement here: the
+implementations live in paddle_tpu.fluid.layers (delegating to the
+modern nn Layers) and the static control-flow ops come from
+static.control_flow."""
+from ..fluid.layers import (fc, embedding, conv2d, pool2d,  # noqa: F401
+                            batch_norm, layer_norm, dropout, softmax,
+                            relu, sigmoid, tanh, cross_entropy,
+                            softmax_with_cross_entropy, mean, reduce_sum,
+                            reduce_mean, reduce_max, reduce_min,
+                            reduce_prod, matmul, mul, transpose, reshape,
+                            squeeze, unsqueeze, concat, split, cast,
+                            fill_constant, zeros, ones, one_hot, topk,
+                            gather, elementwise_add, elementwise_sub,
+                            elementwise_mul, elementwise_div, accuracy,
+                            sequence_pool, sequence_conv,
+                            sequence_softmax, l2_normalize, clip, pad,
+                            label_smooth, data)
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+from ..nn.functional import (sequence_pad, sequence_unpad,  # noqa: F401
+                             sequence_reverse, sequence_expand)
+
+__all__ = ["fc", "embedding", "conv2d", "pool2d", "batch_norm",
+           "layer_norm", "dropout", "softmax", "cross_entropy", "mean",
+           "case", "cond", "switch_case", "while_loop", "sequence_conv",
+           "sequence_pool", "sequence_softmax"]

@@ -3,6 +3,8 @@ UCIHousing, Movielens, Conll05st, WMT14/16, ViterbiDecoder lives in
 nn). Zero-egress environment: datasets load local files when present,
 else deterministic synthetic corpora with the reference's shapes/dtypes
 — see vision/datasets.py for the same policy."""
-from .datasets import Imdb, UCIHousing, WMT14  # noqa: F401
+from .datasets import (Conll05st, Imdb, Imikolov,  # noqa: F401
+                       Movielens, UCIHousing, WMT14, WMT16)
 
-__all__ = ["Imdb", "UCIHousing", "WMT14"]
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens",
+           "UCIHousing", "WMT14", "WMT16"]

@@ -112,3 +112,144 @@ class WMT14(Dataset):
 
     def __len__(self):
         return len(self.src)
+
+
+class Imikolov(Dataset):
+    """PTB language-model windows (reference: text/datasets/imikolov.py).
+    data_type 'NGRAM' yields fixed windows of ids; 'SEQ' yields
+    (src_seq, trg_seq) shifted pairs."""
+
+    VOCAB = 2000
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        rs = np.random.RandomState(0 if mode == "train" else 1)
+        n_sent = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", 2000))
+        self.data = []
+        for _ in range(n_sent):
+            ln = rs.randint(4, 30)
+            sent = rs.randint(3, self.VOCAB, ln).astype(np.int64).tolist()
+            if self.data_type == "NGRAM":
+                for i in range(window_size, len(sent) + 1):
+                    self.data.append(
+                        np.asarray(sent[i - window_size:i], np.int64))
+            else:  # SEQ
+                src = [1] + sent          # <s>
+                trg = sent + [2]          # <e>
+                if 0 < window_size < len(src):
+                    continue
+                self.data.append((np.asarray(src, np.int64),
+                                  np.asarray(trg, np.int64)))
+
+    def word_idx(self):
+        d = {f"w{i}": i for i in range(3, self.VOCAB)}
+        d.update({"<s>": 1, "<e>": 2, "<unk>": 0})
+        return d
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """Rating tuples (user feats..., movie feats..., title ids, [rating])
+    (reference: text/datasets/movielens.py MovieInfo/UserInfo.value)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        # distinct stream per split: the test split must not be a prefix
+        # duplicate of train (same policy as Imikolov/Conll05st)
+        rs = np.random.RandomState(rand_seed + (0 if mode == "train"
+                                                else 1))
+        n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", 10000))
+        n = max(10, int(n * (1 - test_ratio)) if mode == "train"
+                else int(n * test_ratio))
+        self.data = []
+        for _ in range(n):
+            user_id = rs.randint(1, 6041)
+            gender = rs.randint(0, 2)
+            age = rs.randint(0, 7)
+            job = rs.randint(0, 21)
+            mov_id = rs.randint(1, 3953)
+            categories = rs.randint(0, 18, rs.randint(1, 4)).tolist()
+            title = rs.randint(0, 5175, rs.randint(1, 8)).tolist()
+            rating = float(rs.randint(1, 6))
+            self.data.append(([user_id], [gender], [age], [job], [mov_id],
+                              categories, title, [rating]))
+
+    def __getitem__(self, idx):
+        return tuple(np.asarray(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """SRL tuples (word_ids, ctx_n2/n1/0/p1/p2, pred_id, mark, labels)
+    (reference: text/datasets/conll05.py — 9 aligned int sequences)."""
+
+    WORD_VOCAB = 4000
+    PRED_VOCAB = 3000
+    LABELS = 59
+
+    def __init__(self, data_file=None, word_dict_file=None, mode="train",
+                 **kw):
+        rs = np.random.RandomState(0 if mode == "train" else 1)
+        n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", 5000))
+        self.data = []
+        for _ in range(n):
+            ln = rs.randint(3, 40)
+            words = rs.randint(0, self.WORD_VOCAB, ln).astype(np.int64)
+            pred_pos = rs.randint(0, ln)
+
+            def ctx(off):
+                j = min(max(pred_pos + off, 0), ln - 1)
+                return np.full(ln, words[j], np.int64)
+
+            mark = np.zeros(ln, np.int64)
+            mark[pred_pos] = 1
+            labels = rs.randint(0, self.LABELS, ln).astype(np.int64)
+            pred = np.full(ln, rs.randint(0, self.PRED_VOCAB), np.int64)
+            self.data.append((words, ctx(-2), ctx(-1), ctx(0), ctx(1),
+                              ctx(2), pred, mark, labels))
+
+    def get_dict(self):
+        return ({f"w{i}": i for i in range(self.WORD_VOCAB)},
+                {f"p{i}": i for i in range(self.PRED_VOCAB)},
+                {f"l{i}": i for i in range(self.LABELS)})
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT16(WMT14):
+    """reference: text/datasets/wmt16.py — same (src, trg, trg_next)
+    schema as WMT14 with a BPE vocab of the requested size; synthetic
+    fallback draws from its own cache/seed (ids < src_dict_size)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=2000,
+                 trg_dict_size=2000, lang="en"):
+        self.dict_size = int(src_dict_size)
+        self.trg_dict_size = int(trg_dict_size)
+        self.lang = lang
+        path = data_file or os.path.join(_CACHE, "wmt16", f"{mode}.npz")
+        if os.path.exists(path):
+            z = np.load(path, allow_pickle=True)
+            self.src, self.trg = list(z["src"]), list(z["trg"])
+            return
+        n = int(os.environ.get("PADDLE_TPU_SYNTH_SAMPLES", 2000))
+        rs = np.random.RandomState(4 if mode == "train" else 5)
+        self.src, self.trg = [], []
+        for _ in range(n):
+            ls, lt = rs.randint(4, 30), rs.randint(4, 30)
+            self.src.append(
+                rs.randint(3, self.dict_size, ls).astype(np.int64))
+            self.trg.append(
+                rs.randint(3, self.trg_dict_size, lt).astype(np.int64))

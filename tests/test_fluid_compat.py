@@ -199,3 +199,57 @@ def test_cond_priced_at_worst_branch():
 
     c = estimate_jaxpr_cost(jax.make_jaxpr(f)(True, jnp.ones((8, 64))))
     assert c.by_prim.get("dot_general", 0) == 2 * 2 * 8 * 64 * 64
+
+
+class TestTextDatasetBreadth:
+    """Full reference text/datasets parity: Conll05st, Imikolov,
+    Movielens, WMT16 join Imdb/UCIHousing/WMT14 (reference:
+    python/paddle/text/datasets/)."""
+
+    def test_all_seven_families(self):
+        import paddle_tpu.text as text
+        for name in ["Conll05st", "Imdb", "Imikolov", "Movielens",
+                     "UCIHousing", "WMT14", "WMT16"]:
+            assert hasattr(text, name), name
+
+    def test_imikolov_ngram_and_seq(self):
+        from paddle_tpu.text import Imikolov
+        ng = Imikolov(data_type="NGRAM", window_size=5)
+        assert ng[0].shape == (5,)
+        sq = Imikolov(data_type="SEQ", window_size=0)
+        src, trg = sq[0]
+        assert len(src) == len(trg)
+        assert src[0] == 1 and trg[-1] == 2  # <s> ... <e>
+
+    def test_conll_alignment(self):
+        from paddle_tpu.text import Conll05st
+        item = Conll05st()[0]
+        assert len(item) == 9
+        ln = len(item[0])
+        assert all(len(seq) == ln for seq in item)
+        assert item[7].sum() == 1  # exactly one predicate mark
+
+    def test_movielens_schema(self):
+        from paddle_tpu.text import Movielens
+        item = Movielens()[0]
+        assert len(item) == 8
+        assert 1.0 <= float(item[-1][0]) <= 5.0
+
+
+class TestStaticNN:
+    def test_static_nn_namespace(self):
+        import paddle_tpu as paddle
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = paddle.static.data("x", [-1, 16], "float32")
+                h = paddle.static.nn.fc(x, 8, act="relu", name="sn1")
+                y = paddle.static.nn.batch_norm(
+                    paddle.static.nn.conv2d(
+                        paddle.static.nn.reshape(h, [-1, 2, 2, 2]),
+                        4, 1, name="snc"), name="snb")
+            assert y.shape[1] == 4
+            assert callable(paddle.static.nn.while_loop)
+        finally:
+            paddle.disable_static()

@@ -1,0 +1,176 @@
+"""Bilinear / PairwiseDistance / MaxUnPool2D / HSigmoidLoss — the last
+four reference nn.Layer classes (reference: nn/layer/common.py Bilinear,
+distance.py, pooling.py MaxUnPool2D, loss.py HSigmoidLoss). Torch is the
+numeric oracle where an equivalent exists."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+torch = pytest.importorskip("torch")
+rs = np.random.RandomState(0)
+
+
+def test_bilinear_matches_torch():
+    x1 = rs.randn(4, 5).astype(np.float32)
+    x2 = rs.randn(4, 7).astype(np.float32)
+    m = nn.Bilinear(5, 7, 3)
+    tm = torch.nn.Bilinear(5, 7, 3)
+    tm.weight.data = torch.from_numpy(np.array(m.weight.numpy()))
+    tm.bias.data = torch.from_numpy(np.array(m.bias.numpy()))
+    got = m(paddle.to_tensor(x1), paddle.to_tensor(x2)).numpy()
+    want = tm(torch.from_numpy(x1), torch.from_numpy(x2)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_grads_flow():
+    m = nn.Bilinear(5, 7, 3)
+    x1 = paddle.to_tensor(rs.randn(4, 5).astype(np.float32))
+    x2 = paddle.to_tensor(rs.randn(4, 7).astype(np.float32))
+    m(x1, x2).sum().backward()
+    assert m.weight.grad is not None
+    assert np.isfinite(m.weight.grad.numpy()).all()
+
+
+def test_pairwise_distance_matches_torch():
+    a = rs.randn(6, 9).astype(np.float32)
+    b = rs.randn(6, 9).astype(np.float32)
+    for p in (1.0, 2.0):
+        got = nn.PairwiseDistance(p=p)(paddle.to_tensor(a),
+                                       paddle.to_tensor(b)).numpy()
+        want = torch.nn.PairwiseDistance(p=p)(
+            torch.from_numpy(a), torch.from_numpy(b)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestMaxUnpool:
+    def test_pool_indices_and_unpool_match_torch(self):
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        vals, idx = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                                 return_mask=True)
+        tv, ti = torch.nn.functional.max_pool2d(
+            torch.from_numpy(x), 2, 2, return_indices=True)
+        np.testing.assert_allclose(vals.numpy(), tv.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), ti.numpy())
+        up = nn.MaxUnPool2D(2, stride=2)(vals, idx).numpy()
+        tup = torch.nn.functional.max_unpool2d(tv, ti, 2, 2).numpy()
+        np.testing.assert_allclose(up, tup, rtol=1e-6)
+
+    def test_padded_overlapping_windows(self):
+        x = rs.randn(1, 2, 7, 7).astype(np.float32)
+        vals, idx = F.max_pool2d(paddle.to_tensor(x), 3, stride=2,
+                                 padding=1, return_mask=True)
+        tv, ti = torch.nn.functional.max_pool2d(
+            torch.from_numpy(x), 3, 2, padding=1, return_indices=True)
+        np.testing.assert_allclose(vals.numpy(), tv.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), ti.numpy())
+
+    def test_grad_routes_to_argmax_positions(self):
+        t = paddle.to_tensor(rs.randn(1, 1, 4, 4).astype(np.float32))
+        t.stop_gradient = False
+        vals, idx = F.max_pool2d(t, 2, stride=2, return_mask=True)
+        vals.sum().backward()
+        g = t.grad.numpy()
+        assert g.sum() == 4.0  # one unit per window
+        assert ((g == 0) | (g == 1)).all()
+
+
+class TestHSigmoid:
+    def test_trains_down(self):
+        paddle.seed(0)
+        hs = nn.HSigmoidLoss(16, 10)
+        opt = paddle.optimizer.Adam(parameters=hs.parameters(),
+                                    learning_rate=0.05)
+        feats = paddle.to_tensor(rs.randn(32, 16).astype(np.float32))
+        labels = paddle.to_tensor(rs.randint(0, 10, (32,)).astype(np.int64))
+        losses = []
+        for _ in range(25):
+            loss = hs(feats, labels).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.7
+        assert np.isfinite(losses).all()
+
+    def test_loss_formula_binary_tree(self):
+        # num_classes=2: one internal node; loss = log(1+exp(-sign*wx))
+        hs = nn.HSigmoidLoss(4, 2, bias_attr=False)
+        w = hs.weight.numpy()[0]
+        x = rs.randn(3, 4).astype(np.float32)
+        lab = np.array([0, 1, 0], np.int64)
+        got = hs(paddle.to_tensor(x),
+                 paddle.to_tensor(lab)).numpy().ravel()
+        logit = x @ w
+        # heap: leaf id = label+1; code = (id % 2 == 1) -> label 0 ->
+        # id 1 -> code True (sign +), label 1 -> id 2 -> code False
+        sign = np.where(lab == 0, 1.0, -1.0)
+        want = np.log1p(np.exp(-sign * logit))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_custom_path(self):
+        hs = nn.HSigmoidLoss(8, 4, is_custom=True)
+        x = paddle.to_tensor(rs.randn(2, 8).astype(np.float32))
+        lab = paddle.to_tensor(np.array([0, 1], np.int64))
+        table = paddle.to_tensor(np.array([[0, 1, -1], [0, 2, 3]],
+                                          np.int64))
+        code = paddle.to_tensor(np.array([[1, 0, 0], [0, 1, 1]],
+                                         np.int64))
+        out = hs(x, lab, path_table=table, path_code=code)
+        assert out.shape == [2, 1]
+        assert np.isfinite(out.numpy()).all()
+
+
+class TestMaxPoolIndexConfigs:
+    """Review regressions: ceil_mode, string/pair paddings, and the
+    layer-level return_mask must behave like the maskless path."""
+
+    def test_ceil_mode_shapes_agree(self):
+        x = paddle.to_tensor(rs.randn(1, 1, 6, 6).astype(np.float32))
+        plain = F.max_pool2d(x, 3, stride=2, ceil_mode=True)
+        vals, idx = F.max_pool2d(x, 3, stride=2, ceil_mode=True,
+                                 return_mask=True)
+        assert vals.shape == plain.shape
+        np.testing.assert_allclose(vals.numpy(), plain.numpy())
+
+    def test_string_and_pair_padding(self):
+        x = paddle.to_tensor(rs.randn(1, 2, 7, 7).astype(np.float32))
+        for padding in ("SAME", "VALID", [1, 1], [(0, 1), (1, 0)]):
+            plain = F.max_pool2d(x, 3, stride=2, padding=padding)
+            vals, idx = F.max_pool2d(x, 3, stride=2, padding=padding,
+                                     return_mask=True)
+            assert vals.shape == plain.shape, padding
+            np.testing.assert_allclose(vals.numpy(), plain.numpy())
+
+    def test_layer_returns_mask_and_roundtrips(self):
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        out, idx = nn.MaxPool2D(2, return_mask=True)(paddle.to_tensor(x))
+        up = nn.MaxUnPool2D(2)(out, idx)
+        tv, ti = torch.nn.functional.max_pool2d(
+            torch.from_numpy(x), 2, 2, return_indices=True)
+        tup = torch.nn.functional.max_unpool2d(tv, ti, 2, 2)
+        np.testing.assert_allclose(up.numpy(), tup.numpy(), rtol=1e-6)
+
+    def test_unpool_same_padding_needs_output_size(self):
+        x = paddle.to_tensor(rs.randn(1, 1, 4, 4).astype(np.float32))
+        vals, idx = F.max_pool2d(x, 2, return_mask=True)
+        with pytest.raises(ValueError, match="output_size"):
+            F.max_unpool2d(vals, idx, 2, padding="SAME")
+
+
+class TestTextDatasetSplits:
+    def test_movielens_splits_differ(self):
+        from paddle_tpu.text import Movielens
+        tr = Movielens(mode="train")[0]
+        te = Movielens(mode="test")[0]
+        assert any(not np.array_equal(a, b) for a, b in zip(tr, te))
+
+    def test_wmt16_respects_dict_size_and_differs_from_wmt14(self):
+        from paddle_tpu.text import WMT14, WMT16
+        w16 = WMT16(src_dict_size=2000, trg_dict_size=1500)
+        assert max(int(s.max()) for s in w16.src) < 2000
+        assert max(int(t.max()) for t in w16.trg) < 1500
+        w14 = WMT14()
+        assert not np.array_equal(w14[0][0], w16[0][0])

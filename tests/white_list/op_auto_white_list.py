@@ -23,6 +23,16 @@ WHITE_LIST = {
     "sequence_conv_op": ("dedicated — required context attrs + integer "
                          "lengths input; grads + parity in "
                          "test_sequence_ops.TestSequenceOpsBreadth"),
+    "max_pool2d_with_index": ("dedicated — required window attrs, int "
+                              "index output; torch parity in "
+                              "test_nn_parity_extra"),
+    "max_unpool2d_op": ("dedicated — int indices input + required shape "
+                        "attrs; torch parity in test_nn_parity_extra"),
+    "bilinear_op": ("dedicated — correlated (x1, W, x2) shape contract; "
+                    "torch parity + grads in test_nn_parity_extra"),
+    "hsigmoid_loss_op": ("dedicated — int labels + tree-structured "
+                         "weights; formula + training tests in "
+                         "test_nn_parity_extra"),
     # rng
     "alpha_dropout_op": "rng",
     "bernoulli_op": "rng",
