@@ -2,6 +2,7 @@
 python/paddle/nn/functional/*.py) over the TPU primitive library."""
 from __future__ import annotations
 
+import jax
 import numpy as np
 
 from ...framework.tensor import Tensor
@@ -209,6 +210,29 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  "NCL", 1)
 
 
+def _same_pairs(in_sp, ks, st):
+    """XLA-style SAME resolution: out = ceil(in/stride), lo/hi split."""
+    pairs = []
+    for i in range(len(in_sp)):
+        out = -(-in_sp[i] // st[i])
+        total = max((out - 1) * st[i] + ks[i] - in_sp[i], 0)
+        pairs.append((total // 2, total - total // 2))
+    return tuple(pairs)
+
+
+def _ceil_extend(in_sp, ks, st, pairs):
+    """Extend high padding so the trailing partial window is included
+    (paddle ceil_mode; same formula as ops.pool's internal extension)."""
+    ext = []
+    for i in range(len(in_sp)):
+        lo, hi = pairs[i]
+        size = in_sp[i] + lo + hi
+        out = -(-(size - ks[i]) // st[i]) + 1
+        need = (out - 1) * st[i] + ks[i] - size
+        ext.append((lo, hi + max(need, 0)))
+    return tuple(ext)
+
+
 def _index_pool_cfg(in_hw, kernel_size, stride, padding, ceil_mode):
     """Resolve (kernel, stride, pad-pairs) for the with-index pool path:
     one normalization shared by max_pool2d(return_mask=True) and
@@ -218,25 +242,14 @@ def _index_pool_cfg(in_hw, kernel_size, stride, padding, ceil_mode):
     st = _pair(stride if stride is not None else kernel_size, 2)
     pad = _norm_padding(padding, 2)
     if pad == "VALID":
-        pairs = [(0, 0), (0, 0)]
+        pairs = ((0, 0), (0, 0))
     elif pad == "SAME":
-        pairs = []
-        for i in range(2):
-            out = -(-in_hw[i] // st[i])
-            total = max((out - 1) * st[i] + ks[i] - in_hw[i], 0)
-            pairs.append((total // 2, total - total // 2))
+        pairs = _same_pairs(in_hw, ks, st)
     else:
-        pairs = [tuple(p) for p in pad]
+        pairs = tuple(tuple(p) for p in pad)
     if ceil_mode:
-        ext = []
-        for i in range(2):
-            lo, hi = pairs[i]
-            size = in_hw[i] + lo + hi
-            out = -(-(size - ks[i]) // st[i]) + 1
-            need = (out - 1) * st[i] + ks[i] - size
-            ext.append((lo, hi + max(need, 0)))
-        pairs = ext
-    return ks, st, tuple(pairs)
+        pairs = _ceil_extend(in_hw, ks, st, pairs)
+    return ks, st, pairs
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -271,6 +284,17 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
         out_w = (ow - 1) * st[1] - (pad[1][0] + pad[1][1]) + ks[1]
     else:
         out_h, out_w = [int(v) for v in output_size[-2:]]
+    import jax as _jax
+    if not isinstance(getattr(indices, "_data", indices), _jax.core.Tracer):
+        # eager: reject an output_size the indices cannot fit — JAX's
+        # scatter would otherwise silently DROP out-of-bounds values
+        mx = int(np.asarray(indices.numpy() if isinstance(indices, Tensor)
+                            else indices).max(initial=0))
+        if mx >= out_h * out_w:
+            raise ValueError(
+                f"max_unpool2d: index {mx} out of range for output "
+                f"{out_h}x{out_w} — output_size smaller than the pooled "
+                "input")
     return _nn.max_unpool2d_prim(x, indices, out_h=int(out_h),
                                  out_w=int(out_w))
 
@@ -325,15 +349,10 @@ def _pool(x, ptype, kernel, stride, padding, ceil_mode, exclusive,
     if isinstance(pad, str):
         if pad == "VALID":
             pad = ((0, 0),) * n
-        else:  # SAME: out = ceil(in/stride), XLA-style lo/hi split
+        else:  # SAME
             sp = (tuple(x.shape[1:1 + n]) if channel_last
                   else tuple(x.shape[2:2 + n]))
-            pairs = []
-            for i in range(n):
-                out = -(-sp[i] // st[i])
-                total = max((out - 1) * st[i] + ks[i] - sp[i], 0)
-                pairs.append((total // 2, total - total // 2))
-            pad = tuple(pairs)
+            pad = _same_pairs(sp, ks, st)
     return _nn.pool(x, pool_type=ptype, kernel=ks,
                     stride=st, padding=pad,
                     ceil_mode=bool(ceil_mode), exclusive=bool(exclusive),
@@ -840,3 +859,69 @@ from .sequence import (sequence_concat, sequence_conv,  # noqa: E402,F401
                        sequence_expand_as, sequence_pad, sequence_pool,
                        sequence_reshape, sequence_reverse, sequence_scatter,
                        sequence_slice, sequence_softmax, sequence_unpad)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference: nn/functional/vision.py affine_grid."""
+    out_h, out_w = [int(v) for v in out_shape[-2:]]
+    return _nn.affine_grid(theta, out_h=out_h, out_w=out_w,
+                           align_corners=bool(align_corners))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """reference: nn/functional/vision.py grid_sample."""
+    return _nn.grid_sample(x, grid, mode=mode, padding_mode=padding_mode,
+                           align_corners=bool(align_corners))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """reference: nn/functional/loss.py margin_cross_entropy (single-rank
+    path; the sharded-classifier variant is the mp_layers
+    ParallelCrossEntropy)."""
+    out = _nn.margin_cross_entropy(logits, label, margin1=float(margin1),
+                                   margin2=float(margin2),
+                                   margin3=float(margin3),
+                                   scale=float(scale),
+                                   return_softmax=bool(return_softmax))
+    loss, soft = out if return_softmax else (out, None)
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    return (loss, soft) if return_softmax else loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Positive classes + uniform negatives -> (remapped_label,
+    sampled_class_index) (reference: nn/functional/common.py
+    class_center_sample). Host-side eager: the sampled set is data
+    dependent, like detection post-processing."""
+    lab = np.asarray(label.numpy() if isinstance(label, Tensor)
+                     else label).astype(np.int64).reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=np.int64),
+                                pos)
+        need = num_samples - len(pos)
+        seed = int(np.asarray(
+            jax.random.bits(RNG.next_key(), (), np.uint32)))
+        rng = np.random.RandomState(seed)
+        negs = rng.choice(neg_pool, size=min(need, len(neg_pool)),
+                          replace=False)
+        sampled = np.sort(np.concatenate([pos, negs]))
+    remap = {int(c): i for i, c in enumerate(sampled)}
+    remapped = np.asarray([remap[int(v)] for v in lab], np.int64)
+    return (Tensor(remapped, _internal=True),
+            Tensor(sampled.astype(np.int64), _internal=True))
+
+
+# reference exposes inplace-aliased activations (relu_/elu_/softmax_);
+# tensors here are functional, so these alias the pure versions
+relu_ = relu
+elu_ = elu
+softmax_ = softmax

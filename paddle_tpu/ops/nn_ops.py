@@ -939,3 +939,104 @@ def hsigmoid_loss(x, label, weight, bias=None, path_table=None,
     losses = jnp.logaddexp(0.0, -sign * logit)
     losses = jnp.where(mask, losses, 0.0)
     return jnp.sum(losses, axis=-1, keepdims=True)
+
+
+@primitive("affine_grid_op")
+def affine_grid(theta, *, out_h, out_w, align_corners=True):
+    """Sampling grid from batched 2x3 affines (reference:
+    operators/affine_grid_op.h). Output [N, H, W, 2] in [-1, 1] coords."""
+    n = theta.shape[0]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = axis_coords(out_h)
+    xs = axis_coords(out_w)
+    gx, gy = jnp.meshgrid(xs, ys)                       # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)           # [H, W, 3]
+    out = jnp.einsum("hwk,nck->nhwc", base.astype(theta.dtype), theta)
+    return out                                          # [N, H, W, 2]
+
+
+@primitive("grid_sample_op")
+def grid_sample(x, grid, *, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Bilinear/nearest sampling of NCHW x at [-1,1] grid locations
+    (reference: operators/grid_sampler_op.h)."""
+    if mode not in ("bilinear", "nearest"):
+        raise NotImplementedError(
+            f"grid_sample mode={mode!r}: bilinear/nearest only")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample padding_mode={padding_mode!r}: zeros/border only "
+            "(reflection is not implemented)")
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+
+    def unnorm(v, size):
+        if align_corners:
+            return (v + 1.0) * (size - 1) / 2.0
+        return ((v + 1.0) * size - 1.0) / 2.0
+
+    fx = unnorm(gx, w)
+    fy = unnorm(gy, h)
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    if mode == "nearest":
+        ix = jnp.round(fx).astype(jnp.int32)
+        iy = jnp.round(fy).astype(jnp.int32)
+        valid = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        gathered = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(
+            x, iyc, ixc)                                 # [N, C, H', W']
+        return jnp.where(valid[:, None], gathered, 0.0)
+
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1 = x0 + 1
+    y1 = y0 + 1
+    wx = fx - x0
+    wy = fy - y0
+
+    def tap(ix, iy):
+        valid = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        v = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, iyc, ixc)
+        return jnp.where(valid[:, None], v, 0.0)
+
+    v00 = tap(x0, y0)
+    v01 = tap(x1, y0)
+    v10 = tap(x0, y1)
+    v11 = tap(x1, y1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+@primitive("margin_cross_entropy_op")
+def margin_cross_entropy(logits, label, *, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False):
+    """ArcFace-family margin softmax CE (reference:
+    operators/margin_cross_entropy_op.h): target-class cosine theta gets
+    cos(m1*theta + m2) - m3 before scaled softmax."""
+    lab = label.astype(jnp.int32).reshape(-1)
+    onehot = jax.nn.one_hot(lab, logits.shape[-1], dtype=logits.dtype)
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    adjusted = jnp.cos(margin1 * theta + margin2) - margin3
+    z = scale * jnp.where(onehot > 0, adjusted, cos)
+    logp = jax.nn.log_softmax(z, axis=-1)
+    loss = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss

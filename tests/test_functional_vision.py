@@ -1,0 +1,106 @@
+"""Spatial transformer + margin-softmax functionals — the last
+reference nn.functional entries (reference: nn/functional/vision.py
+affine_grid/grid_sample, loss.py margin_cross_entropy, common.py
+class_center_sample). Torch is the oracle for the spatial pair."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+rs = np.random.RandomState(0)
+
+
+def _theta():
+    return rs.randn(2, 2, 3).astype(np.float32) * 0.3 + np.array(
+        [[1, 0, 0], [0, 1, 0]], np.float32) * 0.7
+
+
+@pytest.mark.parametrize("ac", [True, False])
+def test_affine_grid_and_bilinear_sample_match_torch(ac):
+    theta = _theta()
+    grid = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 7],
+                         align_corners=ac)
+    tg = torch.nn.functional.affine_grid(torch.from_numpy(theta),
+                                         (2, 3, 5, 7), align_corners=ac)
+    np.testing.assert_allclose(grid.numpy(), tg.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    x = rs.randn(2, 3, 5, 7).astype(np.float32)
+    out = F.grid_sample(paddle.to_tensor(x), grid, align_corners=ac)
+    tout = torch.nn.functional.grid_sample(torch.from_numpy(x), tg,
+                                           align_corners=ac)
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_grid_sample_nearest_matches_torch():
+    theta = _theta()
+    grid = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 7])
+    x = rs.randn(2, 3, 5, 7).astype(np.float32)
+    out = F.grid_sample(paddle.to_tensor(x), grid, mode="nearest")
+    tg = torch.nn.functional.affine_grid(torch.from_numpy(theta),
+                                         (2, 3, 5, 7), align_corners=True)
+    tout = torch.nn.functional.grid_sample(torch.from_numpy(x), tg,
+                                           mode="nearest",
+                                           align_corners=True)
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_grid_sample_grad_flows():
+    theta = paddle.to_tensor(_theta())
+    theta.stop_gradient = False
+    x = paddle.to_tensor(rs.randn(2, 3, 5, 7).astype(np.float32))
+    grid = F.affine_grid(theta, [2, 3, 5, 7])
+    F.grid_sample(x, grid).sum().backward()
+    assert theta.grad is not None
+    assert np.isfinite(theta.grad.numpy()).all()
+
+
+def test_margin_ce_degenerates_to_scaled_ce():
+    logits = np.clip(rs.randn(6, 10).astype(np.float32) * 0.3, -1, 1)
+    lab = rs.randint(0, 10, (6,)).astype(np.int64)
+    got = F.margin_cross_entropy(paddle.to_tensor(logits),
+                                 paddle.to_tensor(lab), margin1=1.0,
+                                 margin2=0.0, margin3=0.0, scale=64.0,
+                                 reduction="none")
+    z = 64.0 * logits
+    lp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+    want = -lp[np.arange(6), lab][:, None]
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-4)
+    # a positive margin can only increase the loss
+    got_m = F.margin_cross_entropy(paddle.to_tensor(logits),
+                                   paddle.to_tensor(lab), margin2=0.5,
+                                   reduction="none")
+    assert (got_m.numpy() >= got.numpy() - 1e-4).all()
+
+
+def test_class_center_sample_contract():
+    lab = paddle.to_tensor(np.array([3, 9, 3, 40], np.int64))
+    remapped, sampled = F.class_center_sample(lab, 100, 8)
+    s = sampled.numpy()
+    assert set([3, 9, 40]).issubset(set(s.tolist())) and len(s) == 8
+    r = remapped.numpy()
+    assert (s[r] == np.array([3, 9, 3, 40])).all()
+
+
+def test_inplace_aliases_exist():
+    for name in ("relu_", "elu_", "softmax_"):
+        assert callable(getattr(F, name))
+
+
+def test_grid_sample_rejects_unimplemented_modes():
+    x = paddle.to_tensor(rs.randn(1, 1, 4, 4).astype(np.float32))
+    grid = paddle.to_tensor(np.zeros((1, 2, 2, 2), np.float32))
+    with pytest.raises(NotImplementedError, match="reflection"):
+        F.grid_sample(x, grid, padding_mode="reflection")
+    with pytest.raises(NotImplementedError, match="bicubic"):
+        F.grid_sample(x, grid, mode="bicubic")
+
+
+def test_max_unpool_rejects_too_small_output():
+    x = paddle.to_tensor(rs.randn(1, 1, 4, 4).astype(np.float32))
+    vals, idx = F.max_pool2d(x, 2, return_mask=True)
+    with pytest.raises(ValueError, match="out of range"):
+        F.max_unpool2d(vals, idx, 2, output_size=[2, 2])

@@ -33,6 +33,13 @@ WHITE_LIST = {
     "hsigmoid_loss_op": ("dedicated — int labels + tree-structured "
                          "weights; formula + training tests in "
                          "test_nn_parity_extra"),
+    "affine_grid_op": ("dedicated — required out-shape attrs; torch "
+                       "parity in test_functional_vision"),
+    "grid_sample_op": ("dedicated — correlated grid input in [-1,1]; "
+                       "torch parity + grads in test_functional_vision"),
+    "margin_cross_entropy_op": ("dedicated — int labels + cosine-domain "
+                                "inputs; formula tests in "
+                                "test_functional_vision"),
     # rng
     "alpha_dropout_op": "rng",
     "bernoulli_op": "rng",
