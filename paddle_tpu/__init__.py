@@ -149,3 +149,91 @@ def batch(reader, batch_size, drop_last=False):
             yield buf
 
     return batch_reader
+
+
+# -- remaining reference top-level surface -----------------------------------
+from . import hub  # noqa: E402,F401
+from .hapi import callbacks  # noqa: E402,F401
+
+full_version = __version__
+commit = "tpu-native"
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference: fluid/layers create_parameter — a standalone trainable
+    Parameter outside any Layer."""
+    import numpy as _np
+    from .nn import initializer as _I
+    init = default_initializer
+    if init is None and attr is not None:
+        init = getattr(attr, "initializer", None)
+    if init is None:
+        init = _I.Constant(0.0) if is_bias else _I.XavierNormal()
+    arr = init(tuple(int(s) for s in shape), _np.dtype(str(dtype)))
+    p = Parameter(arr, name=name or getattr(attr, "name", None))
+    if attr is not None and getattr(attr, "trainable", True) is False:
+        p.stop_gradient = True
+        p.trainable = False
+    return p
+
+
+def enable_dygraph(place=None):
+    _state.STATE.static_mode = False
+
+
+def disable_dygraph():
+    _state.STATE.static_mode = True
+
+
+def in_dynamic_mode():
+    return not _state.in_static_mode()
+
+
+def get_cuda_rng_state():
+    """CUDA-compat alias: there is no CUDA here; returns the global TPU/CPU
+    PRNG state so checkpoint code keeps working."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state_list):
+    return set_rng_state(state_list)
+
+
+def get_cudnn_version():
+    return None  # not compiled with cuDNN (TPU build)
+
+
+def disable_signal_handler():
+    pass  # jax installs no paddle-style signal handlers
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def monkey_patch_math_varbase():
+    pass  # Tensor dunders are installed at import (tensor/__init__.py)
+
+
+def monkey_patch_variable():
+    pass  # Variable inherits the full Tensor surface
+
+
+def check_shape(shape):
+    for s in shape:
+        if s is not None and int(s) < -1:
+            raise ValueError(f"illegal dimension {s} in shape {shape}")

@@ -92,6 +92,15 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
         if not any_ct:
             continue
 
+        if node.in_arrays is None:
+            # residuals already freed by an earlier backward() pass
+            raise RuntimeError(
+                f"Trying to backward through op '{node.name}' a second "
+                "time: its saved activations were freed by a previous "
+                "backward(). Recompute the value inside the loop, detach "
+                "it (stop_gradient=True), or pass retain_graph=True to "
+                "the first backward (reference: the same error in "
+                "imperative/basic_engine.cc).")
         if node.name in SPARSE_VJPS:
             attrs = (dict(node.attr_key[1])
                      if node.attr_key and node.attr_key[0] == "__raw__"

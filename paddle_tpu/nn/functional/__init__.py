@@ -284,8 +284,7 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
         out_w = (ow - 1) * st[1] - (pad[1][0] + pad[1][1]) + ks[1]
     else:
         out_h, out_w = [int(v) for v in output_size[-2:]]
-    import jax as _jax
-    if not isinstance(getattr(indices, "_data", indices), _jax.core.Tracer):
+    if not isinstance(getattr(indices, "_data", indices), jax.core.Tracer):
         # eager: reject an output_size the indices cannot fit — JAX's
         # scatter would otherwise silently DROP out-of-bounds values
         mx = int(np.asarray(indices.numpy() if isinstance(indices, Tensor)
@@ -862,7 +861,11 @@ from .sequence import (sequence_concat, sequence_conv,  # noqa: E402,F401
 
 
 def affine_grid(theta, out_shape, align_corners=True, name=None):
-    """reference: nn/functional/vision.py affine_grid."""
+    """reference: nn/functional/vision.py affine_grid (4-D / 2-D grids)."""
+    if len(out_shape) != 4:
+        raise NotImplementedError(
+            f"affine_grid supports 4-D out_shape [N, C, H, W] (got "
+            f"{len(out_shape)} dims); 5-D/3-D grids are not implemented")
     out_h, out_w = [int(v) for v in out_shape[-2:]]
     return _nn.affine_grid(theta, out_h=out_h, out_w=out_w,
                            align_corners=bool(align_corners))

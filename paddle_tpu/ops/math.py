@@ -733,3 +733,46 @@ def trapezoid(y, *, dx=1.0, axis=-1):
 @primitive("identity")
 def _identity(x):
     return x
+
+
+@primitive("searchsorted_op", nondiff=True)
+def searchsorted(sorted_sequence, values, *, right=False, out_int32=False):
+    """reference: operators/searchsorted_op.h — insertion indices into a
+    sorted last axis."""
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        flat_seq = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+        flat_val = values.reshape(-1, values.shape[-1])
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            flat_seq, flat_val).reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@primitive("tensordot_op")
+def _tensordot(x, y, *, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@primitive("dist_op")
+def _dist(x, y, *, p=2.0):
+    """reference: operators/dist_op.h — p-norm of the broadcast
+    difference, computed and returned in the inputs' promoted dtype."""
+    d = jnp.abs(x - y)
+    if not jnp.issubdtype(d.dtype, jnp.floating):
+        d = d.astype(jnp.float32)
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == float("-inf"):
+        return jnp.min(d)
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+
+@primitive("scale_op")
+def _scale(x, *, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return scale * x + bias
+    return scale * (x + bias)

@@ -533,3 +533,114 @@ def _patch():
 
 
 _patch()
+
+
+# -- late-bound compat surface (reference top-level names) -------------------
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference: math.py add_n over sum_op)."""
+    if isinstance(inputs, (list, tuple)):
+        out = inputs[0]
+        for t in inputs[1:]:
+            out = _m.add(out, t)
+        return out
+    return inputs
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    out = _m._scale(x, scale=float(scale), bias=float(bias),
+                    bias_after_scale=bool(bias_after_scale))
+    if act:
+        import paddle_tpu.nn.functional as _F
+        out = getattr(_F, act)(out)
+    return out
+
+
+def dist(x, y, p=2, name=None):
+    return _m._dist(x, y, p=float(p))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    return _m.searchsorted(sorted_sequence, values, right=bool(right),
+                           out_int32=bool(out_int32))
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(int(i) for i in a) if isinstance(a, (list, tuple))
+                     else int(a) for a in axes)
+    else:
+        axes = int(axes)
+    return _m._tensordot(x, y, axes=axes)
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (reference: fluid/layers reverse)."""
+    return flip(x, axis)
+
+
+def is_empty(x, name=None):
+    from ..framework.tensor import Tensor as _T
+    return _T(__import__("numpy").asarray(x.size == 0), _internal=True)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop (reference: crop_tensor_op): slice `shape` starting at
+    `offsets` (defaults: zeros)."""
+    import builtins
+    shp = [int(s) for s in (shape if shape is not None else x.shape)]
+    offs = [int(o) for o in (offsets if offsets is not None
+                             else [0] * x.ndim)]
+    # shape entry -1 = "to the end of the dimension" (reference
+    # crop_tensor semantics)
+    slices = tuple(
+        builtins.slice(o, None if s == -1 else o + s)
+        for o, s in zip(offs, shp))
+    return x[slices]
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    """Host-side eager (data-dependent output length), like `unique`.
+    axis=None flattens first, per the reference contract."""
+    a = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    if axis is None:
+        a = a.reshape(-1)
+        change = np.concatenate([[True], a[1:] != a[:-1]])
+    else:
+        moved = np.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        change = np.concatenate([[True],
+                                 (flat[1:] != flat[:-1]).any(axis=1)])
+        out_vals = np.moveaxis(moved[change], 0, axis)
+    idx = np.nonzero(change)[0]
+    if axis is None:
+        out_vals = a[change]
+    results = [to_tensor(out_vals)]
+    if return_inverse:
+        inverse = np.cumsum(change) - 1
+        results.append(to_tensor(inverse.astype(np.int64)))
+    if return_counts:
+        counts = np.diff(np.concatenate([idx, [len(change)]]))
+        results.append(to_tensor(counts.astype(np.int64)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def tolist(x):
+    return x.numpy().tolist()
+
+
+# inplace-aliased manipulations (functional tensors: aliases of the pure
+# forms, matching the reference's *_ naming)
+reshape_ = reshape
+squeeze_ = squeeze
+unsqueeze_ = unsqueeze
+scatter_ = scatter
+tanh_ = _nn.tanh

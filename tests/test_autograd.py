@@ -138,3 +138,20 @@ def test_conv_grad():
                                atol=1e-3)
     np.testing.assert_allclose(tw.grad.numpy(), np.asarray(gw), rtol=1e-3,
                                atol=1e-3)
+
+
+def test_second_backward_through_freed_graph_raises_clearly():
+    """reference: BasicEngine raises on retain_graph=False double
+    backward; we must too instead of crashing on freed residuals."""
+    import numpy as np
+    import pytest
+    w = paddle.framework.Parameter(np.ones(3, np.float32))
+    y = (w * 2.0).sum()
+    y.backward()
+    z = (w * 2.0).sum()  # fresh graph: fine
+    z.backward()
+    # reusing a tensor whose graph was freed must raise with guidance
+    shared = w * 3.0
+    (shared.sum()).backward()
+    with pytest.raises(RuntimeError, match="second"):
+        (shared * 1.0).sum().backward()

@@ -1,0 +1,139 @@
+"""The last reference top-level names (reference: python/paddle/__init__.py
+__all__): add_n, scale, dist, searchsorted, tensordot, crop, reverse,
+broadcast_shape, create_parameter, hub, rng compat, printoptions."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_math_compat_surface():
+    x = paddle.to_tensor(np.array([1., 3., 5.], np.float32))
+    y = paddle.to_tensor(np.array([1., 3., 6.], np.float32))
+    assert float(paddle.dist(x, y)) == pytest.approx(1.0)
+    assert float(paddle.dist(x, y, p=float("inf"))) == pytest.approx(1.0)
+    assert paddle.add_n([x, x, x]).numpy().tolist() == [3., 9., 15.]
+    assert paddle.scale(x, 2.0, 1.0).numpy().tolist() == [3., 7., 11.]
+    assert paddle.scale(x, 2.0, 1.0,
+                        bias_after_scale=False).numpy().tolist() \
+        == [4., 8., 12.]
+    np.testing.assert_array_equal(
+        paddle.searchsorted(x, paddle.to_tensor(
+            np.array([0., 2., 9.], np.float32))).numpy(), [0, 1, 3])
+    a = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, 4)
+                         .astype(np.float32))
+    b = paddle.to_tensor(np.random.RandomState(1).rand(4, 5)
+                         .astype(np.float32))
+    got = paddle.tensordot(a, b, axes=1).numpy()
+    np.testing.assert_allclose(got, np.tensordot(a.numpy(), b.numpy(), 1),
+                               rtol=1e-5)
+    assert paddle.broadcast_shape([2, 1, 4], [3, 4]) == [2, 3, 4]
+    assert paddle.reverse(x, 0).numpy().tolist() == [5., 3., 1.]
+    assert paddle.crop(a, shape=[1, 2, 2],
+                       offsets=[0, 1, 1]).shape == [1, 2, 2]
+    assert bool(paddle.is_empty(paddle.to_tensor(
+        np.zeros((0, 3), np.float32))))
+    assert paddle.tolist(x) == [1., 3., 5.]
+
+
+def test_inplace_alias_names():
+    for n in ("reshape_", "squeeze_", "unsqueeze_", "scatter_", "tanh_"):
+        assert callable(getattr(paddle, n))
+
+
+def test_create_parameter_and_rng_compat():
+    p = paddle.create_parameter([4, 3], "float32")
+    assert not p.stop_gradient and p.shape == [4, 3]
+    b = paddle.create_parameter([3], is_bias=True)
+    assert np.allclose(b.numpy(), 0.0)
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+    assert paddle.get_cudnn_version() is None
+    paddle.disable_signal_handler()
+    paddle.set_printoptions(precision=4)
+    paddle.monkey_patch_math_varbase()
+    paddle.check_shape([2, -1, 3])
+    with pytest.raises(ValueError):
+        paddle.check_shape([2, -7])
+
+
+def test_hub_local_protocol():
+    d = tempfile.mkdtemp()
+    with open(os.path.join(d, "hubconf.py"), "w") as f:
+        f.write("def tiny(n=4):\n"
+                "    '''tiny linear'''\n"
+                "    import paddle_tpu.nn as nn\n"
+                "    return nn.Linear(n, n)\n")
+    assert paddle.hub.list(d, source="local") == ["tiny"]
+    assert "tiny linear" in paddle.hub.help(d, "tiny", source="local")
+    m = paddle.hub.load(d, "tiny", 6, source="local")
+    assert m(paddle.to_tensor(np.ones((1, 6), np.float32))).shape == [1, 6]
+    with pytest.raises(NotImplementedError, match="egress"):
+        paddle.hub.load("org/repo", "x", source="github")
+
+
+def test_dygraph_mode_toggles():
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+        paddle.enable_dygraph()
+        assert paddle.in_dynamic_mode()
+        paddle.disable_dygraph()
+        assert not paddle.in_dynamic_mode()
+    finally:
+        paddle.disable_static()
+
+
+class TestCompatFixes:
+    """Review regressions: unique_consecutive tuple contract, crop -1,
+    dist dtype/-inf, attr initializer, affine_grid dim guard."""
+
+    def test_unique_consecutive_full_contract(self):
+        x = paddle.to_tensor(np.array([1, 1, 2, 2, 2, 3, 1], np.int64))
+        out, inv, cnt = paddle.unique_consecutive(
+            x, return_inverse=True, return_counts=True)
+        assert out.numpy().tolist() == [1, 2, 3, 1]
+        assert inv.numpy().tolist() == [0, 0, 1, 1, 1, 2, 3]
+        assert cnt.numpy().tolist() == [2, 3, 1, 1]
+        # ND flattens under axis=None
+        x2 = paddle.to_tensor(np.array([[1, 1], [2, 2]], np.int64))
+        assert paddle.unique_consecutive(x2).numpy().tolist() == [1, 2]
+        # axis-wise: consecutive duplicate ROWS collapse
+        x3 = paddle.to_tensor(np.array([[1, 2], [1, 2], [3, 4]], np.int64))
+        out3 = paddle.unique_consecutive(x3, axis=0)
+        assert out3.numpy().tolist() == [[1, 2], [3, 4]]
+
+    def test_crop_minus_one_extends(self):
+        a = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        got = paddle.crop(a, shape=[2, -1], offsets=[1, 1])
+        assert got.shape == [2, 3]
+        np.testing.assert_allclose(got.numpy(), a.numpy()[1:3, 1:])
+
+    def test_dist_dtype_and_neg_inf(self):
+        # to_tensor keeps floats at f32 (TPU-first policy); explicit casts
+        # must survive dist without a silent f32 downcast
+        x = paddle.cast(paddle.to_tensor(np.array([1., 3., 5.],
+                                                  np.float32)), "float64")
+        y = paddle.cast(paddle.to_tensor(np.array([2., 3., 9.],
+                                                  np.float32)), "float64")
+        d = paddle.dist(x, y)
+        assert "float64" in str(d.dtype)
+        assert float(paddle.dist(x, y, p=float("-inf"))) == 0.0
+        assert float(paddle.dist(x, y, p=float("inf"))) == 4.0
+
+    def test_create_parameter_honors_attr_initializer(self):
+        from paddle_tpu.nn import initializer as I
+        from paddle_tpu.nn.layer_base import ParamAttr
+        p = paddle.create_parameter(
+            [8, 8], attr=ParamAttr(initializer=I.Constant(3.0)))
+        np.testing.assert_allclose(p.numpy(), 3.0)
+
+    def test_affine_grid_rejects_5d(self):
+        import paddle_tpu.nn.functional as F
+        theta = paddle.to_tensor(np.zeros((1, 3, 4), np.float32))
+        with pytest.raises(NotImplementedError, match="5-D"):
+            F.affine_grid(theta, [1, 1, 2, 4, 4])
