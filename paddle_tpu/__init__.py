@@ -170,7 +170,8 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
         init = getattr(attr, "initializer", None)
     if init is None:
         init = _I.Constant(0.0) if is_bias else _I.XavierNormal()
-    arr = init(tuple(int(s) for s in shape), _np.dtype(str(dtype)))
+    dt = getattr(dtype, "name", dtype)  # paddle DType or str
+    arr = init(tuple(int(s) for s in shape), _np.dtype(str(dt)))
     p = Parameter(arr, name=name or getattr(attr, "name", None))
     if attr is not None and getattr(attr, "trainable", True) is False:
         p.stop_gradient = True

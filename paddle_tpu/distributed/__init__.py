@@ -30,3 +30,72 @@ __all__ = [
     "global_gather", "global_scatter", "fleet", "spawn", "auto_parallel",
     "ProcessMesh", "shard_tensor", "shard_op",
 ]
+
+
+_SPLIT_CACHE = {}
+_SPLIT_AUTO = [0]
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel sharded op (reference: collective.py split:747 —
+    builds VocabParallelEmbedding / Column-/RowParallelLinear under the
+    hood). size = (in, out) for 'linear', (vocab, dim) for 'embedding';
+    axis picks column (1) vs row (0) sharding for linear. Parameters are
+    cached per `name` like the classic functional layers."""
+    from .fleet.meta_parallel.mp_layers import (ColumnParallelLinear,
+                                                RowParallelLinear,
+                                                VocabParallelEmbedding)
+    key = None
+    layer = None
+    if name is not None:
+        # named: parameters cached + reused across calls (training loops
+        # MUST name their split or build the mp layer once themselves —
+        # an anonymous split creates fresh weights every call and is
+        # neither cached nor trainable across steps)
+        key = (operation, tuple(size), int(axis), bool(gather_out),
+               bias_attr is not False, name)
+        layer = _SPLIT_CACHE.get(key)
+    if layer is None:
+        if operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr)
+        elif operation == "linear" and int(axis) == 1:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        elif operation == "linear":
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        else:
+            raise ValueError(
+                f"split: unknown operation {operation!r} "
+                "(expected 'linear' or 'embedding')")
+        if key is not None:
+            _SPLIT_CACHE[key] = layer
+    return layer(x)
+
+
+# gloo CPU-rendezvous compat (reference: fluid gloo_* ops) — collectives
+# here run over the jax mesh regardless of transport, so these map to the
+# standard bootstrap/barrier
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    from .env import init_parallel_env as _init
+    return _init()
+
+
+def gloo_barrier():
+    from . import collective as _c
+    return _c.barrier()
+
+
+def gloo_release():
+    from . import collective as _c
+    return _c.destroy_process_group()
+
+
+# classic dataset names also live at paddle.distributed.* in the reference
+from .fleet import InMemoryDataset, QueueDataset  # noqa: E402,F401
+from . import launch  # noqa: E402,F401

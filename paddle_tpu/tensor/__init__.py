@@ -613,9 +613,19 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
     a = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
     if axis is None:
         a = a.reshape(-1)
+        if a.size == 0:
+            empty = to_tensor(a)
+            extras = [to_tensor(np.zeros(0, np.int64))] * (
+                int(return_inverse) + int(return_counts))
+            return empty if not extras else tuple([empty] + extras)
         change = np.concatenate([[True], a[1:] != a[:-1]])
     else:
         moved = np.moveaxis(a, axis, 0)
+        if moved.shape[0] == 0:
+            empty = to_tensor(a)
+            extras = [to_tensor(np.zeros(0, np.int64))] * (
+                int(return_inverse) + int(return_counts))
+            return empty if not extras else tuple([empty] + extras)
         flat = moved.reshape(moved.shape[0], -1)
         change = np.concatenate([[True],
                                  (flat[1:] != flat[:-1]).any(axis=1)])

@@ -2,3 +2,152 @@
 from . import profiler  # noqa: F401
 from .profiler import RecordEvent  # noqa: F401
 from . import cpp_extension  # noqa: F401
+
+
+# -- reference parity helpers (python/paddle/utils/) -------------------------
+
+
+def run_check():
+    """Install self-check (reference: utils/install_check.py run_check):
+    builds a tiny model, runs one fwd+bwd+update, reports the backend."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    backend = None
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        net = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        float(loss.numpy())
+    except Exception as e:  # pragma: no cover - only on broken installs
+        print(f"PaddlePaddle (TPU build) check FAILED on backend "
+              f"{backend}: {type(e).__name__}: {e}")
+        raise
+    print(f"PaddlePaddle (TPU build) is installed successfully! "
+          f"backend={backend}")
+
+
+def try_import(module_name, err_msg=None):
+    """reference: utils/lazy_import.py."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed "
+            "(this environment does not allow pip install)")
+
+
+def require_version(min_version, max_version=None):
+    """reference: utils/op_version — compare against this build."""
+    import paddle_tpu
+
+    def key(v):
+        import re as _re
+        parts = []
+        for piece in str(v).split(".")[:3]:
+            m = _re.match(r"\d+", piece)
+            parts.append(int(m.group()) if m else 0)
+        return tuple(parts)
+
+    cur = key(paddle_tpu.__version__)
+    if key(min_version) > cur or (max_version and key(max_version) < cur):
+        raise Exception(
+            f"paddle version {paddle_tpu.__version__} outside "
+            f"[{min_version}, {max_version or 'any'}]")
+
+
+def deprecated(update_to="", since="", reason=""):
+    """reference: utils/deprecated.py — warn-once decorator."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        warned = []
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not warned:
+                warned.append(True)
+                msg = f"API '{fn.__name__}' is deprecated since {since}"
+                if update_to:
+                    msg += f", use '{update_to}' instead"
+                if reason:
+                    msg += f" ({reason})"
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+class _UniqueName:
+    """reference: fluid/unique_name.py — per-prefix counters + guard."""
+
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, prefix):
+        n = self._counters.get(prefix, 0)
+        self._counters[prefix] = n + 1
+        return f"{prefix}_{n}"
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def g():
+            saved = self._counters
+            self._counters = {}
+            try:
+                yield
+            finally:
+                self._counters = saved
+
+        return g()
+
+
+unique_name = _UniqueName()
+
+
+class _DlpackNS:
+    """reference: utils/dlpack.py — zero-copy interop via the dlpack
+    protocol (jax arrays speak dlpack natively)."""
+
+    @staticmethod
+    def to_dlpack(x):
+        from ..framework.tensor import Tensor
+        arr = x._data if isinstance(x, Tensor) else x
+        return arr.__dlpack__()
+
+    @staticmethod
+    def from_dlpack(capsule_or_tensor):
+        import jax.numpy as jnp
+
+        from ..framework.tensor import Tensor
+        obj = capsule_or_tensor
+        if hasattr(obj, "__dlpack__") or hasattr(obj, "__dlpack_device__"):
+            arr = jnp.from_dlpack(obj)
+        else:
+            from jax import dlpack as jdl
+            arr = jdl.from_dlpack(obj)
+        return Tensor(arr, _internal=True)
+
+
+dlpack = _DlpackNS()
+
+
+def download(url, path=None, md5sum=None):
+    raise NotImplementedError(
+        "paddle.utils.download: this environment has no network egress; "
+        "place files locally and pass paths directly")

@@ -12,7 +12,9 @@ import numpy as np
 from ..framework.dispatch import primitive, raw
 from ..framework.tensor import Tensor
 
-__all__ = ["yolo_box", "roi_align", "nms", "deform_conv2d", "RoIAlign",
+__all__ = ["yolo_box", "yolo_loss", "roi_align", "roi_pool", "RoIPool",
+           "psroi_pool", "PSRoIPool", "read_file", "decode_jpeg",
+           "nms", "deform_conv2d", "RoIAlign",
            "DeformConv2D", "prior_box", "box_coder", "multiclass_nms",
            "generate_proposals"]
 
@@ -515,3 +517,336 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     if return_rois_num:
         return out, out_s, Tensor(np.asarray(counts, np.int32))
     return out, out_s
+
+
+@primitive("roi_pool_op")
+def _roi_pool(x, boxes, *, output_size, spatial_scale=1.0):
+    """Quantized max pooling over ROIs (reference:
+    operators/roi_pool_op.h — integer bin boundaries, unlike roi_align's
+    bilinear sampling). boxes: [R, 4] (x1, y1, x2, y2); all from batch 0
+    slicewise (the functional splits per image via boxes_num)."""
+    _, c, h, w = x.shape
+    ph, pw = output_size
+    img = x[0]
+
+    def pool_one(box):
+        # reference roi_pool_op.h quantizes with round(), not floor/ceil
+        x1 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1, 1)
+        rh = jnp.maximum(y2 - y1, 1)
+        iy = jnp.arange(h)
+        ix = jnp.arange(w)
+
+        def bin_mask(i, j):
+            hs = y1 + (i * rh) // ph
+            he = y1 + ((i + 1) * rh + ph - 1) // ph
+            ws = x1 + (j * rw) // pw
+            we = x1 + ((j + 1) * rw + pw - 1) // pw
+            row = (iy >= hs) & (iy < jnp.maximum(he, hs + 1))
+            col = (ix >= ws) & (ix < jnp.maximum(we, ws + 1))
+            return row[:, None] & col[None, :]
+
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                m = bin_mask(i, j)
+                v = jnp.where(m[None], img, -jnp.inf).max(axis=(1, 2))
+                outs.append(jnp.where(jnp.any(m), v, 0.0))
+        return jnp.stack(outs, axis=-1).reshape(c, ph, pw)
+
+    return jax.vmap(pool_one)(boxes)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """reference: vision/ops.py roi_pool."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    nums = [int(v) for v in np.asarray(raw(boxes_num)).reshape(-1)]
+    outs = []
+    start = 0
+    for b, n in enumerate(nums):
+        if n == 0:
+            continue
+        outs.append(_roi_pool(x[b:b + 1], boxes[start:start + n],
+                              output_size=tuple(output_size),
+                              spatial_scale=float(spatial_scale)))
+        start += n
+    from ..tensor import concat
+    if not outs:  # no proposals anywhere: empty [0, C, ph, pw]
+        import jax.numpy as _jnp
+        from ..framework.tensor import Tensor
+        return Tensor(_jnp.zeros((0, int(x.shape[1])) + tuple(output_size),
+                                 raw(x).dtype), _internal=True)
+    return concat(outs, axis=0) if len(outs) > 1 else outs[0]
+
+
+class RoIPool:
+    """reference: vision/ops.py RoIPool layer."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._cfg = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._cfg[0], self._cfg[1])
+
+
+@primitive("psroi_pool_op")
+def _psroi_pool(x, boxes, *, output_size, output_channels, spatial_scale):
+    """Position-sensitive ROI average pooling (reference:
+    operators/psroi_pool_op.h): input channels = output_channels*ph*pw;
+    bin (i, j) of output channel k averages input channel k*ph*pw+i*pw+j
+    over that bin's spatial extent."""
+    _, c, h, w = x.shape
+    ph, pw = output_size
+    img = x[0]
+
+    def pool_one(box):
+        x1 = box[0] * spatial_scale
+        y1 = box[1] * spatial_scale
+        x2 = box[2] * spatial_scale
+        y2 = box[3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh = rh / ph
+        bw = rw / pw
+        iy = jnp.arange(h, dtype=jnp.float32)
+        ix = jnp.arange(w, dtype=jnp.float32)
+        blocks = img.reshape(output_channels, ph * pw, h, w)
+        out = jnp.zeros((output_channels, ph, pw), x.dtype)
+        for i in range(ph):
+            for j in range(pw):
+                hs = jnp.floor(y1 + i * bh)
+                he = jnp.ceil(y1 + (i + 1) * bh)
+                ws = jnp.floor(x1 + j * bw)
+                we = jnp.ceil(x1 + (j + 1) * bw)
+                m = ((iy >= hs) & (iy < he))[:, None] & \
+                    ((ix >= ws) & (ix < we))[None, :]
+                cnt = jnp.maximum(m.sum(), 1)
+                # one masked mean per bin across ALL output channels
+                v = jnp.where(m[None], blocks[:, i * pw + j], 0.0) \
+                    .sum(axis=(1, 2)) / cnt
+                out = out.at[:, i, j].set(
+                    jnp.where(jnp.any(m), v, 0.0))
+        return out
+
+    return jax.vmap(pool_one)(boxes)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """reference: vision/ops.py psroi_pool."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    c = int(x.shape[1])
+    if c % (ph * pw):
+        raise ValueError(
+            f"psroi_pool: channels {c} not divisible by {ph}*{pw}")
+    oc = c // (ph * pw)
+    nums = [int(v) for v in np.asarray(raw(boxes_num)).reshape(-1)]
+    outs = []
+    start = 0
+    for b, n in enumerate(nums):
+        if n == 0:
+            continue
+        outs.append(_psroi_pool(x[b:b + 1], boxes[start:start + n],
+                                output_size=tuple(output_size),
+                                output_channels=oc,
+                                spatial_scale=float(spatial_scale)))
+        start += n
+    from ..tensor import concat
+    if not outs:
+        import jax.numpy as _jnp
+        from ..framework.tensor import Tensor
+        return Tensor(_jnp.zeros((0, oc) + tuple(output_size),
+                                 raw(x).dtype), _internal=True)
+    return concat(outs, axis=0) if len(outs) > 1 else outs[0]
+
+
+class PSRoIPool:
+    """reference: vision/ops.py PSRoIPool layer."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._cfg = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._cfg[0], self._cfg[1])
+
+
+def read_file(path, name=None):
+    """reference: vision/ops.py read_file — raw bytes as a uint8 tensor."""
+    from ..framework.tensor import Tensor
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(data, _internal=True)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference: vision/ops.py decode_jpeg (nvjpeg-backed there). Here
+    PIL when available; raises with guidance otherwise."""
+    try:
+        from PIL import Image
+    except ImportError:
+        raise NotImplementedError(
+            "decode_jpeg needs PIL, which this image lacks; decode on the "
+            "host side and feed arrays")
+    import io as _io
+
+    from ..framework.tensor import Tensor
+    buf = _io.BytesIO(np.asarray(raw(x)).tobytes())
+    img = Image.open(buf)
+    if mode == "gray":
+        img = img.convert("L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(np.ascontiguousarray(arr), _internal=True)
+
+
+@primitive("yolov3_loss_op")
+def _yolo_loss(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
+               class_num, ignore_thresh, downsample_ratio,
+               use_label_smooth):
+    """YOLOv3 loss (reference: operators/yolov3_loss_op.h). x: [N,
+    M*(5+C), H, W] raw head outputs; gt_box [N, B, 4] normalized
+    (cx, cy, w, h), zero rows = padding.
+
+    Assignment follows the reference: each gt picks its best-shape anchor
+    over ALL anchors; the gt trains this layer only if that anchor is in
+    anchor_mask. Objectness uses BCE with an ignore mask for predictions
+    overlapping any gt above ignore_thresh; coordinate losses are scaled
+    by (2 - w*h)."""
+    n, _, h, w = x.shape
+    m = len(anchor_mask)
+    c = class_num
+    xr = x.reshape(n, m, 5 + c, h, w)
+    tx, ty = xr[:, :, 0], xr[:, :, 1]
+    tw, th = xr[:, :, 2], xr[:, :, 3]
+    tobj = xr[:, :, 4]
+    tcls = xr[:, :, 5:]
+
+    all_anchors = jnp.asarray(np.asarray(anchors, np.float32)
+                              .reshape(-1, 2))
+    mask_anchors = all_anchors[np.asarray(anchor_mask)]
+    input_size = downsample_ratio * h
+
+    # -- decode predictions to normalized boxes for the ignore mask ------
+    gx = (jax.nn.sigmoid(tx)
+          + jnp.arange(w, dtype=jnp.float32)[None, None, None, :]) / w
+    gy = (jax.nn.sigmoid(ty)
+          + jnp.arange(h, dtype=jnp.float32)[None, None, :, None]) / h
+    gw = jnp.exp(jnp.clip(tw, -10, 10)) * \
+        mask_anchors[None, :, 0, None, None] / input_size
+    gh = jnp.exp(jnp.clip(th, -10, 10)) * \
+        mask_anchors[None, :, 1, None, None] / input_size
+
+    def iou_cwh(ax, ay, aw, ah, bx, by, bw, bh):
+        ix1 = jnp.maximum(ax - aw / 2, bx - bw / 2)
+        iy1 = jnp.maximum(ay - ah / 2, by - bh / 2)
+        ix2 = jnp.minimum(ax + aw / 2, bx + bw / 2)
+        iy2 = jnp.minimum(ay + ah / 2, by + bh / 2)
+        inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+        return inter / jnp.maximum(aw * ah + bw * bh - inter, 1e-10)
+
+    # ignore mask: best IoU of each prediction vs any gt of its image
+    gb = gt_box.astype(jnp.float32)                       # [N, B, 4]
+    valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)           # [N, B]
+    ious = iou_cwh(gx[..., None], gy[..., None], gw[..., None],
+                   gh[..., None],
+                   gb[:, None, None, None, :, 0],
+                   gb[:, None, None, None, :, 1],
+                   gb[:, None, None, None, :, 2],
+                   gb[:, None, None, None, :, 3])
+    ious = jnp.where(valid[:, None, None, None, :], ious, 0.0)
+    ignore = jnp.max(ious, axis=-1) > ignore_thresh       # [N, M, H, W]
+
+    # -- target assignment (host-free, fully vectorized) -----------------
+    # best anchor per gt by shape IoU against ALL anchors
+    gtw = gb[..., 2] * input_size
+    gth = gb[..., 3] * input_size
+    inter = jnp.minimum(gtw[..., None], all_anchors[None, None, :, 0]) * \
+        jnp.minimum(gth[..., None], all_anchors[None, None, :, 1])
+    union = gtw[..., None] * gth[..., None] + \
+        (all_anchors[:, 0] * all_anchors[:, 1])[None, None] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N,B]
+    mask_arr = jnp.asarray(np.asarray(anchor_mask))
+    in_layer = jnp.any(best[..., None] == mask_arr[None, None], axis=-1)
+    slot = jnp.argmax(best[..., None] == mask_arr[None, None], axis=-1)
+    assigned = valid & in_layer                           # [N, B]
+
+    gi = jnp.clip((gb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    scale = 2.0 - gb[..., 2] * gb[..., 3]
+    score = (gt_score.astype(jnp.float32) if gt_score is not None
+             else jnp.ones(gb.shape[:2], jnp.float32))
+
+    def bce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def gather_pred(t):  # t: [N, M, H, W] -> [N, B] at assigned cells
+        bidx = jnp.arange(n)[:, None]
+        return t[bidx, slot, gj, gi]
+
+    tgt_x = gb[..., 0] * w - gi
+    tgt_y = gb[..., 1] * h - gj
+    aw_sel = mask_anchors[slot, 0]
+    ah_sel = mask_anchors[slot, 1]
+    tgt_w = jnp.log(jnp.maximum(gtw / jnp.maximum(aw_sel, 1e-6), 1e-9))
+    tgt_h = jnp.log(jnp.maximum(gth / jnp.maximum(ah_sel, 1e-6), 1e-9))
+
+    wgt = jnp.where(assigned, scale * score, 0.0)
+    loss_xy = jnp.sum(wgt * (bce(gather_pred(tx), tgt_x)
+                             + bce(gather_pred(ty), tgt_y)), axis=1)
+    loss_wh = jnp.sum(wgt * (jnp.abs(gather_pred(tw) - tgt_w)
+                             + jnp.abs(gather_pred(th) - tgt_h)), axis=1)
+
+    # objectness: positives at assigned cells, negatives elsewhere unless
+    # ignored
+    obj_target = jnp.zeros((n, m, h, w))
+    bidx = jnp.arange(n)[:, None] * jnp.ones_like(slot)
+    obj_target = obj_target.at[bidx, slot, gj, gi].max(
+        jnp.where(assigned, score, 0.0))
+    pos = obj_target > 0
+    obj_bce = bce(tobj, obj_target)
+    loss_obj = jnp.sum(jnp.where(pos | ~ignore, obj_bce, 0.0),
+                       axis=(1, 2, 3))
+
+    # classification at assigned cells
+    smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+    lab = jnp.clip(gt_label.astype(jnp.int32), 0, c - 1)
+    onehot = jax.nn.one_hot(lab, c)
+    onehot = onehot * (1.0 - smooth) + smooth / c
+    cls_pred = tcls[jnp.arange(n)[:, None], slot, :, gj, gi]  # [N, B, C]
+    loss_cls = jnp.sum(jnp.where(assigned[..., None],
+                                 bce(cls_pred, onehot), 0.0), axis=(1, 2))
+
+    return loss_xy + loss_wh + loss_obj + loss_cls
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: vision/ops.py yolo_loss over yolov3_loss_op."""
+    if float(scale_x_y) != 1.0:
+        raise NotImplementedError(
+            "yolo_loss scale_x_y != 1.0 is not implemented (yolo_box in "
+            "this module does support it for inference decode)")
+    args = [x, gt_box, gt_label]
+    if gt_score is not None:
+        args.append(gt_score)
+    else:
+        from ..tensor import ones
+        args.append(ones(list(gt_box.shape[:2]), "float32"))
+    return _yolo_loss(*args, anchors=tuple(int(a) for a in anchors),
+                      anchor_mask=tuple(int(a) for a in anchor_mask),
+                      class_num=int(class_num),
+                      ignore_thresh=float(ignore_thresh),
+                      downsample_ratio=int(downsample_ratio),
+                      use_label_smooth=bool(use_label_smooth))

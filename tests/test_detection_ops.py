@@ -187,3 +187,69 @@ class TestNewModelFamilies:
         for f in families:
             assert hasattr(M, f), f
         assert len(families) >= 12
+
+
+class TestRoiPoolFamily:
+    """roi_pool / psroi_pool / yolo_loss / image IO — the last
+    vision.ops names (reference: vision/ops.py roi_pool:RoIPool,
+    psroi_pool, yolo_loss over yolov3_loss_op)."""
+
+    def test_roi_pool_exact_bins(self):
+        from paddle_tpu.vision import ops as V
+        x = paddle.to_tensor(
+            np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8))
+        boxes = paddle.to_tensor(np.array([[0., 0., 4., 4.]], np.float32))
+        nums = paddle.to_tensor(np.array([1], np.int32))
+        out = V.roi_pool(x, boxes, nums, 2, 1.0)
+        # rows 0-3, cols 0-3 of the ramp; per-bin max
+        np.testing.assert_allclose(out.numpy().reshape(-1),
+                                   [9., 11., 25., 27.])
+        layer = V.RoIPool(2, 1.0)
+        np.testing.assert_allclose(layer(x, boxes, nums).numpy(),
+                                   out.numpy())
+
+    def test_psroi_pool_position_sensitive(self):
+        from paddle_tpu.vision import ops as V
+        # channel k*4+i*2+j constant = k*100 + i*10 + j so bin (i,j) of
+        # output channel k must read exactly that constant
+        c = np.zeros((1, 8, 4, 4), np.float32)
+        for k in range(2):
+            for i in range(2):
+                for j in range(2):
+                    c[0, k * 4 + i * 2 + j] = k * 100 + i * 10 + j
+        boxes = paddle.to_tensor(np.array([[0., 0., 4., 4.]], np.float32))
+        nums = paddle.to_tensor(np.array([1], np.int32))
+        out = V.psroi_pool(paddle.to_tensor(c), boxes, nums, 2, 1.0)
+        want = np.array([[[0., 1.], [10., 11.]],
+                         [[100., 101.], [110., 111.]]], np.float32)
+        np.testing.assert_allclose(out.numpy()[0], want)
+
+    def test_yolo_loss_trains_down_and_penalizes_missing_obj(self):
+        from paddle_tpu.vision import ops as V
+        rs = np.random.RandomState(0)
+        N, B, C, H, W = 2, 3, 4, 4, 4
+        head = paddle.framework.Parameter(
+            rs.randn(N, 3 * (5 + C), H, W).astype(np.float32) * 0.1)
+        gtb = np.zeros((N, B, 4), np.float32)
+        gtb[:, 0] = [0.5, 0.5, 0.2, 0.3]
+        gtl = np.zeros((N, B), np.int64)
+        opt = paddle.optimizer.Adam(parameters=[head], learning_rate=0.05)
+        losses = []
+        for _ in range(20):
+            loss = V.yolo_loss(head, paddle.to_tensor(gtb),
+                               paddle.to_tensor(gtl),
+                               [10, 13, 16, 30, 33, 23], [0, 1, 2], C,
+                               0.7, 32).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+    def test_read_file_roundtrip(self, tmp_path):
+        from paddle_tpu.vision import ops as V
+        p = tmp_path / "blob.bin"
+        p.write_bytes(bytes(range(10)))
+        t = V.read_file(str(p))
+        assert t.numpy().tolist() == list(range(10))

@@ -137,3 +137,30 @@ class TestCompatFixes:
         theta = paddle.to_tensor(np.zeros((1, 3, 4), np.float32))
         with pytest.raises(NotImplementedError, match="5-D"):
             F.affine_grid(theta, [1, 1, 2, 4, 4])
+
+
+class TestReviewFixes2:
+    def test_create_parameter_accepts_dtype_object(self):
+        p = paddle.create_parameter([2, 2], paddle.float32)
+        assert "float32" in str(p.dtype)
+
+    def test_unique_consecutive_empty(self):
+        out = paddle.unique_consecutive(
+            paddle.to_tensor(np.zeros(0, np.int64)))
+        assert out.shape == [0]
+        out2, cnt = paddle.unique_consecutive(
+            paddle.to_tensor(np.zeros(0, np.int64)), return_counts=True)
+        assert out2.shape == [0] and cnt.shape == [0]
+
+    def test_require_version_rc_suffix(self):
+        paddle.utils.require_version("0.0.1rc0")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("99.0.0")
+
+    def test_roi_pool_no_proposals(self):
+        from paddle_tpu.vision import ops as V
+        x = paddle.to_tensor(np.zeros((2, 3, 8, 8), np.float32))
+        boxes = paddle.to_tensor(np.zeros((0, 4), np.float32))
+        nums = paddle.to_tensor(np.array([0, 0], np.int32))
+        out = V.roi_pool(x, boxes, nums, 2)
+        assert out.shape == [0, 3, 2, 2]

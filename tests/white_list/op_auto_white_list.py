@@ -40,6 +40,12 @@ WHITE_LIST = {
     "margin_cross_entropy_op": ("dedicated — int labels + cosine-domain "
                                 "inputs; formula tests in "
                                 "test_functional_vision"),
+    "roi_pool_op": ("dedicated — box-coordinate contract; exact-bin test "
+                    "in test_detection_ops.TestRoiPoolFamily"),
+    "psroi_pool_op": ("dedicated — channel-layout contract; "
+                      "position-sensitivity test in test_detection_ops"),
+    "yolov3_loss_op": ("dedicated — gt/anchor assignment contract; "
+                       "training + invariant tests in test_detection_ops"),
     # rng
     "alpha_dropout_op": "rng",
     "bernoulli_op": "rng",
