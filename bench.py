@@ -79,6 +79,7 @@ def _child_main():
             "step_ms": res["step_ms"],
             "batch": res["batch"],
             "seq_len": res["seq_len"],
+            "attn_paths": res.get("attn_paths"),
         }
         try:  # cross-round comparison with the round-1/2 headline
             out["extra"] = {
@@ -109,52 +110,94 @@ def _last_json_line(text: str):
     return None
 
 
+def _probe_tpu(timeout_s=150.0):
+    """Cheap child-process check that the TPU backend comes up at all.
+
+    A wedged tunnel hangs forever inside make_c_api_client, so burning the
+    full bench timeout just to discover that wastes the retry budget; this
+    probe costs at most `timeout_s`. Returns True iff a TPU device
+    initialised in time."""
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+            env=dict(os.environ), capture_output=True, text=True,
+            timeout=timeout_s)
+        return "PLATFORM=tpu" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _run_bench_child(force_cpu, timeout_s=900.0):
+    """Run the bench body in a timed child. Returns (json_line|None, err)."""
+    import subprocess
+    import sys
+
+    extra = {"_PT_BENCH_FORCE_CPU": "1"} if force_cpu else {}
+    env = dict(os.environ, _PT_BENCH_CHILD="1", **extra)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=timeout_s)
+        line = _last_json_line(out.stdout)
+        if line is None:
+            return None, (f"child rc={out.returncode}, no JSON; stderr "
+                          "tail: " + out.stderr[-300:].replace("\n", " "))
+        return line, None
+    except subprocess.TimeoutExpired as e:
+        # the bench may have printed its result before hanging in backend
+        # teardown — salvage captured stdout (bytes even in text mode on
+        # some CPython versions)
+        captured = e.stdout or ""
+        if isinstance(captured, bytes):
+            captured = captured.decode("utf-8", "replace")
+        line = _last_json_line(captured)
+        if line is None:
+            return None, "child timed out (backend hang?)"
+        return line, None
+
+
 def main():
     """Watchdog wrapper: a wedged TPU tunnel makes the first jax device use
     hang forever inside make_c_api_client — no in-process handling can
     recover (round-1 bench emitted no output at all this way). So the bench
-    body runs in a timed CHILD process; if it hangs or dies without output,
-    retry once pinned to CPU; always end with one parseable JSON line."""
-    import subprocess
-    import sys
-
+    body runs in a timed CHILD process. The tunnel wedge is TRANSIENT
+    (round-3 lesson: one attempt + CPU fallback forfeited the round's TPU
+    evidence), so the TPU attempt is retried across several minutes —
+    cheap device probe first, full bench only once a probe succeeds —
+    before pinning to CPU; always ends with one parseable JSON line."""
     if os.environ.get("_PT_BENCH_CHILD") == "1":
         _child_main()
         return
 
-    attempts = [{}, {"_PT_BENCH_FORCE_CPU": "1"}]
+    tpu_tries = int(os.environ.get("PADDLE_TPU_BENCH_TPU_TRIES", "4"))
+    retry_sleep = float(os.environ.get("PADDLE_TPU_BENCH_RETRY_SLEEP", "60"))
     last_err = "no output"
-    for i, extra in enumerate(attempts):
-        env = dict(os.environ, _PT_BENCH_CHILD="1", **extra)
-        line = None
-        try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                capture_output=True, text=True, timeout=900.0)
-            line = _last_json_line(out.stdout)
-            if line is None:
-                last_err = (f"child rc={out.returncode}, no JSON; stderr "
-                            "tail: " + out.stderr[-300:].replace("\n", " "))
-        except subprocess.TimeoutExpired as e:
-            # the bench may have printed its result before hanging in
-            # backend teardown — salvage captured stdout (bytes even in
-            # text mode on some CPython versions)
-            captured = e.stdout or ""
-            if isinstance(captured, bytes):
-                captured = captured.decode("utf-8", "replace")
-            line = _last_json_line(captured)
-            if line is None:
-                last_err = "child timed out (backend hang?)"
-        if line is not None:
-            # a child error JSON is only final on the last attempt: a fast
-            # TPU-side failure should still fall through to the CPU retry
-            if "error" not in json.loads(line) or i == len(attempts) - 1:
-                print(line)
-                return
-            last_err = json.loads(line)["error"]
+    for i in range(tpu_tries):
+        if i:
+            time.sleep(retry_sleep)
+        if not _probe_tpu(float(
+                os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "150"))):
+            last_err = f"tpu probe timed out (attempt {i + 1}/{tpu_tries})"
+            print(f"# bench: {last_err}, retrying", flush=True)
+            continue
+        line, err = _run_bench_child(force_cpu=False)
+        if line is not None and "error" not in json.loads(line):
+            print(line)
+            return
+        # a fast TPU-side failure or hang: keep the error, try again
+        last_err = err or json.loads(line)["error"]
+        print(f"# bench: tpu attempt {i + 1} failed: {last_err}", flush=True)
+    line, err = _run_bench_child(force_cpu=True)
+    if line is not None:
+        print(line)
+        return
     print(json.dumps({
-        "metric": _METRIC, "value": 0.0, "unit": "images/sec/chip",
-        "vs_baseline": 0.0, "error": last_err,
+        "metric": _METRIC, "value": 0.0, "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0, "error": f"{last_err}; cpu fallback: {err}",
     }))
 
 

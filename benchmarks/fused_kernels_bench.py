@@ -63,7 +63,8 @@ def bench_flash_attention(B=8, H=12, T=1024, D=64, dtype=jnp.bfloat16):
             return q
         return step
 
-    tp = timeit(chain(lambda q, k, v: _flash(q, k, v, True, interp)),
+    tp = timeit(chain(lambda q, k, v: _flash(q, k, v, None, True, interp,
+                                             0.0)),
                 q, k, v, iters=3) / CHAIN
     tx = timeit(chain(lambda q, k, v: _xla_attention(q, k, v, True)),
                 q, k, v, iters=3) / CHAIN

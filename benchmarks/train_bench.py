@@ -107,9 +107,14 @@ def bench_gpt2(on_tpu):
         loss, _ = step([x], [y])
         return loss
 
+    from paddle_tpu.ops.pallas_kernels import attention_path_counts
+    attention_path_counts(reset=True)
     for _ in range(warmup):
         loss = one_step()
     float(loss.numpy())
+    # which attention impl the compiled step actually traced (r3 VERDICT:
+    # prove the Pallas flash path engages at the bench shapes)
+    attn_paths = attention_path_counts()
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = one_step()
@@ -128,6 +133,7 @@ def bench_gpt2(on_tpu):
             "unit": "tokens/sec/chip",
             "step_ms": round(dt * 1e3, 2),
             "batch": B, "seq_len": T, "params": n_params,
+            "attn_paths": attn_paths,
             "mfu": _mfu(flops, dt)}
 
 

@@ -68,6 +68,10 @@ define_flag("allocator_strategy", "xla",
             "accepted for parity; XLA/PJRT owns device memory")
 define_flag("tpu_matmul_precision", "default",
             "jax matmul precision: default|high|highest")
+define_flag("flash_dropout_interpret", False,
+            "allow the dropout-enabled flash kernel in interpret mode "
+            "(CPU kernel tests only — the emulator is too slow for train "
+            "loops; on TPU dropout always stays on the flash path)")
 define_flag("use_flash_attention", True,
             "route F.scaled_dot_product_attention to the Pallas flash "
             "kernel when shapes/backend allow")

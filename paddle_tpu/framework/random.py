@@ -39,7 +39,18 @@ class GlobalRNG:
         self._key = jax.random.PRNGKey(self._seed)
 
     def next_key(self):
-        self.key, sub = jax.random.split(self.key)
+        key = self.key
+        # A GSPMD-compiled train step returns the advanced key committed to
+        # its mesh (replicated over all devices). Later EAGER ops mixing
+        # that multi-device key with single-device arrays fail jit's
+        # committed-device check — normalize to the default device outside
+        # traces (8-byte transfer; the compiled step path is untouched:
+        # there the key is a tracer).
+        if not isinstance(key, jax.core.Tracer):
+            devs = getattr(key, "devices", None)
+            if devs is not None and len(devs()) > 1:
+                key = jax.device_put(key, jax.devices()[0])
+        self.key, sub = jax.random.split(key)
         return sub
 
     def state(self):

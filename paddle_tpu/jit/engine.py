@@ -205,7 +205,13 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
                 new_accs.append(list(out[1:]))
         return loss, out_arrs, new_bufs, new_key, new_params, new_accs
 
-    jitted = jax.jit(step_fn, donate_argnums=(0, 3))
+    # donate params (0), buffers (2), opt state (3): all are replaced by
+    # outputs, so XLA reuses their HBM in-place instead of holding both
+    # copies live across the step (r3 VERDICT: missing buffer donation was
+    # an MFU suspect). The rng key (4) is NOT donated — it is 8 bytes, and
+    # get_rng_state() hands out the very same array, which donation would
+    # delete under a checkpointed-reproducibility pattern.
+    jitted = jax.jit(step_fn, donate_argnums=(0, 2, 3))
 
     if mesh is not None:
         _param_sh = [NamedSharding(mesh, s) for s in _pspecs]

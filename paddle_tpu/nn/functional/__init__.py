@@ -829,20 +829,24 @@ def scaled_dot_product_attention(query, key=None, value=None, attn_mask=None,
     """Fused attention entry point. query/key/value: [B, H, T, D].
 
     attn_mask is an ADDITIVE float mask (use
-    nn.transformer._convert_attention_mask for bool/int masks). Routes to the
-    Pallas flash-attention kernel on TPU (ops/pallas_kernels.py) when
-    return_weights=False and dropout is off; otherwise the plain XLA path.
-    Returns (out, weights) — weights is None unless return_weights."""
+    nn.transformer._convert_attention_mask for bool/int masks). Routes to
+    the Pallas flash-attention kernel on TPU (ops/pallas_kernels.py) when
+    return_weights=False and there is no additive mask — INCLUDING training
+    dropout, whose mask is generated inside the kernel (r4); otherwise the
+    plain XLA path. Returns (out, weights) — weights is None unless
+    return_weights."""
     key_t = query if key is None else key
     value_t = key_t if value is None else value
     rng = RNG.next_key() if (dropout_p > 0.0 and training) else None
-    if not return_weights and rng is None:
-        from ...ops.pallas_kernels import flash_attention_or_none
-        out = flash_attention_or_none(query, key_t, value_t, attn_mask,
-                                      is_causal)
+    if not return_weights:
+        from ...ops.pallas_kernels import (flash_attention_or_none,
+                                           note_xla_attention_path)
+        out = flash_attention_or_none(
+            query, key_t, value_t, attn_mask, is_causal,
+            dropout_p=float(dropout_p) if training else 0.0, rng=rng)
         if out is not None:
             return out, None
-    if not return_weights:
+        note_xla_attention_path()
         out = _nn.sdpa(query, key_t, value_t, attn_mask, rng,
                        dropout_p=float(dropout_p) if training else 0.0,
                        causal=bool(is_causal), return_weights=False)

@@ -64,13 +64,51 @@ def _pallas_call(*args, **kwargs):
     return call
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
-                      block_k, causal, q_block, shift):
+def _attn_drop_keep(rng_ref, qi, j, shape, has_rng, slice_axis):
+    """Boolean keep-mask for attention-dropout tile (q-block qi, k-block j)
+    of the current batch·head program; `shape` = (q rows, k cols) of the
+    tile. Shared by the forward and BOTH backward kernels so the keep/scale
+    rule can never diverge between them.
+
+    TPU (`has_rng`): re-seed the hardware PRNG from the
+    (seed, batch·head, qi, j) tuple so the SAME bits are regenerated
+    everywhere regardless of the kernels' different grid/loop orders — the
+    [T, T] mask never touches HBM (same trick as the fused dropout chain
+    below). CPU/interpret: rng_ref is a precomputed bits slab blocked on
+    the grid axis; slice the loop axis (`slice_axis`=1 → k columns, fwd/dq
+    kernels; 0 → q rows, dkv kernel). Exercised by the exact-oracle tests.
+    The threshold comparison is applied by the caller via the returned
+    bits."""
+    if has_rng:
+        from jax.experimental.pallas import tpu as _pltpu
+        _pltpu.prng_seed(rng_ref[0], pl.program_id(0), qi, j)
+        return _pltpu.bitcast(_pltpu.prng_random_bits(shape), jnp.uint32)
+    if slice_axis == 1:
+        return rng_ref[:, pl.dslice(j * shape[1], shape[1])
+                       ].astype(jnp.uint32)
+    return rng_ref[pl.dslice(qi * shape[0], shape[0]), :].astype(jnp.uint32)
+
+
+def _attn_drop_scale(x, bits, p):
+    """where(keep, x/(1-p), 0) with keep ⇔ bits ≥ p·2³² (P(keep) = 1-p)."""
+    thr = jnp.uint32(min(int(p * (2.0 ** 32)), 2 ** 32 - 1))
+    return jnp.where(bits >= thr, x * (1.0 / (1.0 - p)), 0.0)
+
+
+def _flash_fwd_kernel(rng_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      sm_scale, block_k, causal, q_block, shift,
+                      dropout_p=0.0, has_rng=True):
     """One (batch·head, q-block) program: stream K/V blocks, online softmax.
 
     `shift` = Tk - Tq implements bottom-right-aligned causal masking (cached
     decode: a query at row i attends keys [0, i + shift]), matching
-    _xla_attention's tril(k=Tk-Tq) exactly."""
+    _xla_attention's tril(k=Tk-Tq) exactly.
+
+    With `dropout_p` > 0 the dropout mask is applied to the exp-scores used
+    in the PV matmul while the softmax denominator accumulates the UNDROPPED
+    sums — elementwise keep/scale commutes with the final 1/l normalisation,
+    so this equals dropout(softmax(s)) @ v exactly (the reference's fused
+    attention-dropout, operators/fused/fused_attention_op.cu)."""
     qi = pl.program_id(1)
     q = q_ref[...].astype(jnp.float32) * sm_scale        # [bq, d]
     bq, d = q.shape
@@ -93,8 +131,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m_i - m_new)
         l_new = l_i * alpha + jnp.sum(p, axis=1)
+        pd = p
+        if dropout_p > 0.0:
+            bits = _attn_drop_keep(rng_ref, qi, j, (bq, block_k), has_rng,
+                                   slice_axis=1)
+            pd = _attn_drop_scale(p, bits, dropout_p)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            pd, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -116,12 +159,25 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
         lse_ref[...] = jax.lax.broadcast_in_dim(lse, (bq, _LANES), (0,))
 
 
-def _nolse_kernel(kern, q_ref, k_ref, v_ref, o_ref):
-    kern(q_ref, k_ref, v_ref, o_ref, None)
+def _nolse_kernel(kern, rng_ref, q_ref, k_ref, v_ref, o_ref):
+    kern(rng_ref, q_ref, k_ref, v_ref, o_ref, None)
+
+
+def _attn_rng_spec(rng, block_q, Tk, for_dkv=False, block_k=None):
+    """BlockSpec for the dropout rng operand: SMEM scalar seed on TPU, a
+    [B*H, Tq, Tk] bits-array tile on CPU/interpret."""
+    if rng.ndim == 1:  # TPU hardware-PRNG seed
+        from jax.experimental.pallas import tpu as _pltpu
+        return pl.BlockSpec((1,), lambda b, i: (_I0,),
+                            memory_space=_pltpu.SMEM), True
+    if for_dkv:  # dkv kernel: all q rows of one k block
+        return pl.BlockSpec((None, rng.shape[1], block_k),
+                            lambda b, j: (b, _I0, j)), False
+    return pl.BlockSpec((None, block_q, Tk), lambda b, i: (b, i, _I0)), False
 
 
 def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False,
-               need_lse=True):
+               need_lse=True, dropout_p=0.0, rng=None):
     """q/k/v: [B, H, Tq|Tk, D] → (out [B, H, Tq, D], lse [B*H, Tq, 128]).
 
     `need_lse=False` (inference) skips the lse output entirely — no extra
@@ -134,9 +190,13 @@ def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False,
     qr = q.reshape(B * H, Tq, D)
     kr = k.reshape(B * H, Tk, D)
     vr = v.reshape(B * H, Tk, D)
+    if rng is None:
+        rng = jnp.zeros((1,), jnp.int32)
+    rng_spec, has_rng = _attn_rng_spec(rng, block_q, Tk)
     kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
                                block_k=block_k, causal=causal,
-                               q_block=block_q, shift=Tk - Tq)
+                               q_block=block_q, shift=Tk - Tq,
+                               dropout_p=dropout_p, has_rng=has_rng)
     o_spec = pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, _I0))
     o_shape = jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)
     if need_lse:
@@ -153,6 +213,7 @@ def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False,
         kernel,
         grid=(B * H, Tq // block_q),
         in_specs=[
+            rng_spec,
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, _I0)),
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, _I0, _I0)),
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, _I0, _I0)),
@@ -160,18 +221,24 @@ def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False,
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
-    )(qr, kr, vr)
+    )(rng, qr, kr, vr)
     out = outs[0].reshape(B, H, Tq, D)
     return out, (outs[1] if need_lse else None)
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
-                         *, sm_scale, block_k, causal, q_block, shift):
+def _flash_bwd_dq_kernel(rng_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
+                         lse_ref, dq_ref, *, sm_scale, block_k, causal,
+                         q_block, shift, dropout_p=0.0, has_rng=True):
     """dq for one (batch·head, q-block): stream K/V blocks.
 
     FlashAttention-2 backward: p = exp(s·scale − lse), dp = do·vᵀ,
     ds = p·(dp − Δ)·scale with Δ = rowsum(do∘o) (recomputed here — cheaper
-    than a broadcast residual array), dq = Σ_j ds·k."""
+    than a broadcast residual array), dq = Σ_j ds·k.
+
+    Dropout: with pd = D∘p (keep/scale mask D regenerated per tile from the
+    same seed tuple as the forward), out = pd·v gives dpd = do·vᵀ and
+    dp = D∘dpd; the Δ trick still holds because rowsum(dp∘p) =
+    rowsum(dpd∘pd) = rowsum(do∘o)."""
     qi = pl.program_id(1)
     q = q_ref[...].astype(jnp.float32)                    # [bq, d]
     do = do_ref[...].astype(jnp.float32)
@@ -197,6 +264,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         p = jnp.exp(s - lse)                              # masked → 0
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            bits = _attn_drop_keep(rng_ref, qi, j, (bq, block_k), has_rng,
+                                   slice_axis=1)
+            dp = _attn_drop_scale(dp, bits, dropout_p)
         ds = p * (dp - delta) * sm_scale
         return dq_acc + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -213,13 +284,16 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                          dk_ref, dv_ref, *, sm_scale, block_q, causal,
-                          k_block, shift):
+def _flash_bwd_dkv_kernel(rng_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
+                          lse_ref, dk_ref, dv_ref, *, sm_scale, block_q,
+                          causal, k_block, shift, dropout_p=0.0,
+                          has_rng=True):
     """dk/dv for one (batch·head, k-block): stream Q/dO blocks.
 
-    dv = Σ_i pᵀ·do, dk = Σ_i dsᵀ·q; under causal masking q-blocks strictly
-    above the shifted diagonal are skipped via the loop lower bound."""
+    dv = Σ_i pdᵀ·do, dk = Σ_i dsᵀ·q; under causal masking q-blocks strictly
+    above the shifted diagonal are skipped via the loop lower bound. The
+    dropout mask tile (i, ki) is regenerated from the same (seed, b, q-tile,
+    k-tile) tuple the forward used."""
     ki = pl.program_id(1)
     k = k_ref[...].astype(jnp.float32)                    # [bk, d]
     v = v_ref[...].astype(jnp.float32)
@@ -244,11 +318,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 jnp.int32, (block_q, bk), 1)
             s = jnp.where(q_pos + shift >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse)
-        dv_acc = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        pd = p
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            bits = _attn_drop_keep(rng_ref, i, ki, (block_q, bk), has_rng,
+                                   slice_axis=0)
+            pd = _attn_drop_scale(p, bits, dropout_p)
+            dp = _attn_drop_scale(dp, bits, dropout_p)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            pd, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dk_acc = dk_acc + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -269,7 +349,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, do, causal, block_q=128, block_k=128,
-               interpret=False):
+               interpret=False, dropout_p=0.0, rng=None):
     """Pallas flash-attention backward: (dq, dk, dv), O(T) memory — the
     TPU-native counterpart of the reference's fused attention grad
     (operators/fused/fused_attention_op.cu backward)."""
@@ -284,14 +364,21 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q=128, block_k=128,
     vr = v.reshape(B * H, Tk, D)
     orr = o.reshape(B * H, Tq, D)
     dor = do.reshape(B * H, Tq, D)
+    if rng is None:
+        rng = jnp.zeros((1,), jnp.int32)
+    rng_spec_q, has_rng = _attn_rng_spec(rng, block_q, Tk)
+    rng_spec_kv, _ = _attn_rng_spec(rng, block_q, Tk, for_dkv=True,
+                                    block_k=block_k)
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, sm_scale=sm_scale, block_k=block_k,
-        causal=causal, q_block=block_q, shift=shift)
+        causal=causal, q_block=block_q, shift=shift, dropout_p=dropout_p,
+        has_rng=has_rng)
     dq = _pallas_call(
         dq_kernel,
         grid=(B * H, Tq // block_q),
         in_specs=[
+            rng_spec_q,
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, _I0)),
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, _I0, _I0)),
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, _I0, _I0)),
@@ -302,15 +389,17 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q=128, block_k=128,
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, _I0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr, orr, dor, lse)
+    )(rng, qr, kr, vr, orr, dor, lse)
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, sm_scale=sm_scale, block_q=block_q,
-        causal=causal, k_block=block_k, shift=shift)
+        causal=causal, k_block=block_k, shift=shift, dropout_p=dropout_p,
+        has_rng=has_rng)
     dk, dv = _pallas_call(
         dkv_kernel,
         grid=(B * H, Tk // block_k),
         in_specs=[
+            rng_spec_kv,
             pl.BlockSpec((None, Tq, D), lambda b, j: (b, _I0, _I0)),
             pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, _I0)),
             pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, _I0)),
@@ -327,7 +416,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, block_q=128, block_k=128,
             jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
         ],
         interpret=interpret,
-    )(qr, kr, vr, orr, dor, lse)
+    )(rng, qr, kr, vr, orr, dor, lse)
     return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
             dv.reshape(B, H, Tk, D))
 
@@ -345,20 +434,25 @@ def _xla_attention(q, k, v, causal):
                       ).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, rng, causal, interpret, dropout_p):
     return _flash_fwd(q, k, v, causal, interpret=interpret,
-                      need_lse=False)[0]
+                      need_lse=False, dropout_p=dropout_p, rng=rng)[0]
 
 
-def _flash_vjp_fwd(q, k, v, causal, interpret):
-    o, lse = _flash_fwd(q, k, v, causal, interpret=interpret)
-    return o, (q, k, v, o, lse)
+def _flash_vjp_fwd(q, k, v, rng, causal, interpret, dropout_p):
+    o, lse = _flash_fwd(q, k, v, causal, interpret=interpret,
+                        dropout_p=dropout_p, rng=rng)
+    return o, (q, k, v, o, lse, rng)
 
 
-def _flash_vjp_bwd(causal, interpret, res, g):
-    q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, g, causal, interpret=interpret)
+def _flash_vjp_bwd(causal, interpret, dropout_p, res, g):
+    q, k, v, o, lse, rng = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, g, causal, interpret=interpret,
+                            dropout_p=dropout_p, rng=rng)
+    from jax.dtypes import float0
+    drng = None if rng is None else np.zeros(jnp.shape(rng), float0)
+    return dq, dk, dv, drng
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -383,8 +477,11 @@ def _shapes_ok(q, k, causal, interpret):
 
 
 @primitive("flash_attention")
-def _flash_op(q, k, v, *, causal=False, interpret=False):
-    return _flash(q, k, v, causal, interpret)
+def _flash_op(q, k, v, rng, *, causal=False, interpret=False,
+              dropout_p=0.0):
+    if rng is None:
+        rng = jnp.zeros((1,), jnp.int32)
+    return _flash(q, k, v, rng, causal, interpret, dropout_p)
 
 
 # ---------------------------------------------------------------------------
@@ -744,12 +841,40 @@ def fused_adamw_or_none(param, grad, lr, t, m1, m2, *, beta1, beta2,
             m2o.reshape(param.shape))
 
 
-def flash_attention_or_none(query, key, value, attn_mask, is_causal):
+# Which attention implementation actually traced — incremented at trace
+# time, so after one compiled step the counters say whether the hot model
+# really hit the Pallas kernels (VERDICT r3: "log which path ran").
+# Read/reset via attention_path_counts().
+_ATTN_PATHS = {"flash": 0, "flash_dropout": 0, "xla_sdpa": 0}
+
+
+def attention_path_counts(reset=False):
+    out = dict(_ATTN_PATHS)
+    if reset:
+        for k in _ATTN_PATHS:
+            _ATTN_PATHS[k] = 0
+    return out
+
+
+def note_xla_attention_path():
+    _ATTN_PATHS["xla_sdpa"] += 1
+
+
+def flash_attention_or_none(query, key, value, attn_mask, is_causal,
+                            dropout_p=0.0, rng=None):
     """Tensor-level gate: return flash-attention output, or None to signal
-    the caller to take the plain XLA sdpa path."""
+    the caller to take the plain XLA sdpa path.
+
+    Training dropout stays ON the flash path: the keep/scale mask is
+    generated inside the kernel from the hardware PRNG (per-tile seeding,
+    regenerated in backward) — on CPU/interpret the bits slab is
+    precomputed host-side (tiny test shapes only)."""
     if not _HAS_PALLAS or attn_mask is not None:
         return None
     if not flag("use_flash_attention"):
+        return None
+    if dropout_p > 0.0 and (rng is None or dropout_p >= 1.0):
+        # p>=1 drops everything — degenerate; the XLA path returns zeros
         return None
     q, k = raw(query), raw(key)
     if q.ndim != 4 or k.ndim != 4:
@@ -758,5 +883,21 @@ def flash_attention_or_none(query, key, value, attn_mask, is_causal):
     interpret = backend != "tpu"
     if not _shapes_ok(q, k, bool(is_causal), interpret):
         return None
-    return _flash_op(query, key, value, causal=bool(is_causal),
-                     interpret=interpret)
+    if dropout_p > 0.0 and interpret and not flag(
+            "flash_dropout_interpret"):
+        # interpret-mode Pallas is an emulator — fine for kernel tests,
+        # far too slow for a CPU train loop; real TPU always routes here
+        return None
+    rng_arr = None
+    if dropout_p > 0.0:
+        key_arr = rng._data if hasattr(rng, "_data") else rng
+        if interpret:
+            B, H, Tq, _ = q.shape
+            Tk = k.shape[2]
+            rng_arr = jax.random.bits(key_arr, (B * H, Tq, Tk), jnp.uint32)
+        else:
+            rng_arr = jax.random.bits(key_arr, (1,), jnp.uint32
+                                      ).astype(jnp.int32)
+    _ATTN_PATHS["flash_dropout" if dropout_p > 0.0 else "flash"] += 1
+    return _flash_op(query, key, value, rng_arr, causal=bool(is_causal),
+                     interpret=interpret, dropout_p=float(dropout_p))

@@ -27,8 +27,9 @@ class TestFlashBackward:
         k = jnp.asarray(rs.randn(B, H, Tk, D), jnp.float32)
         v = jnp.asarray(rs.randn(B, H, Tk, D), jnp.float32)
         g = jnp.asarray(rs.randn(B, H, Tq, D), jnp.float32)
-        o1, vjp1 = jax.vjp(lambda q, k, v: _flash(q, k, v, causal, True),
-                           q, k, v)
+        o1, vjp1 = jax.vjp(
+            lambda q, k, v: _flash(q, k, v, None, causal, True, 0.0),
+            q, k, v)
         o2, vjp2 = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal),
                            q, k, v)
         np.testing.assert_allclose(o1, o2, atol=2e-5, rtol=2e-5)
@@ -61,14 +62,104 @@ class TestFlashBackward:
         mk = lambda: jnp.asarray(rs.randn(1, 2, 32, 16), jnp.bfloat16)
         q, k, v = mk(), mk(), mk()
         g = jnp.ones((1, 2, 32, 16), jnp.bfloat16)
-        _, vjp1 = jax.vjp(lambda q, k, v: _flash(q, k, v, True, True),
-                          q, k, v)
+        _, vjp1 = jax.vjp(
+            lambda q, k, v: _flash(q, k, v, None, True, True, 0.0),
+            q, k, v)
         _, vjp2 = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, True),
                           q, k, v)
         for a, b in zip(vjp1(g), vjp2(g)):
             np.testing.assert_allclose(np.asarray(a, np.float32),
                                        np.asarray(b, np.float32),
                                        atol=0.05, rtol=0.05)
+
+
+class TestFlashDropout:
+    """Attention dropout ON the flash path (r4): on CPU/interpret the bits
+    slab is passed in explicitly, making the kernel a deterministic function
+    of its inputs — so forward AND backward are checked EXACTLY against a
+    dense oracle applying the same keep/scale mask to softmax(s)."""
+
+    def _oracle(self, q, k, v, bits, p, causal):
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (float(d) ** -0.5)
+        if causal:
+            Tq, Tk = s.shape[-2], s.shape[-1]
+            s = jnp.where(jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq),
+                          s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        B, H, Tq, Tk = s.shape
+        thr = jnp.uint32(min(int(p * 2 ** 32), 2 ** 32 - 1))
+        keep = (bits.reshape(B, H, Tq, Tk) >= thr)
+        wd = jnp.where(keep, w / (1.0 - p), 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", wd, v.astype(jnp.float32)
+                          ).astype(q.dtype)
+
+    @pytest.mark.parametrize("cfg", [
+        (2, 2, 32, 32, 16, True, 0.1), (1, 2, 64, 64, 16, False, 0.5),
+        (1, 1, 16, 48, 8, True, 0.3)])
+    def test_fwd_bwd_exact_vs_oracle(self, cfg):
+        B, H, Tq, Tk, D, causal, p = cfg
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(B, H, Tq, D), jnp.float32)
+        k = jnp.asarray(rs.randn(B, H, Tk, D), jnp.float32)
+        v = jnp.asarray(rs.randn(B, H, Tk, D), jnp.float32)
+        g = jnp.asarray(rs.randn(B, H, Tq, D), jnp.float32)
+        bits = jax.random.bits(jax.random.PRNGKey(3), (B * H, Tq, Tk),
+                               jnp.uint32)
+        o1, vjp1 = jax.vjp(
+            lambda q, k, v: _flash(q, k, v, bits, causal, True, p), q, k, v)
+        o2, vjp2 = jax.vjp(
+            lambda q, k, v: self._oracle(q, k, v, bits, p, causal), q, k, v)
+        np.testing.assert_allclose(o1, o2, atol=3e-5, rtol=3e-5)
+        for a, b in zip(vjp1(g), vjp2(g)):
+            np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+
+    def test_multiblock_dropout(self):
+        B, H, T, D, p = 1, 2, 64, 16, 0.2
+        rs = np.random.RandomState(1)
+        q = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+        k = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+        v = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+        g = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+        bits = jax.random.bits(jax.random.PRNGKey(5), (B * H, T, T),
+                               jnp.uint32)
+        o, lse = _flash_fwd(q, k, v, True, block_q=16, block_k=16,
+                            interpret=True, dropout_p=p, rng=bits)
+        o2, vjp2 = jax.vjp(
+            lambda q, k, v: self._oracle(q, k, v, bits, p, True), q, k, v)
+        np.testing.assert_allclose(o, o2, atol=3e-5, rtol=3e-5)
+        grads = _flash_bwd(q, k, v, o, lse, g, True, block_q=16, block_k=16,
+                           interpret=True, dropout_p=p, rng=bits)
+        for a, b in zip(grads, vjp2(g)):
+            np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+
+    def test_sdpa_routes_dropout_to_flash(self):
+        """F.scaled_dot_product_attention with dropout must now trace the
+        flash kernel (the r3 MFU hole: training attention fell off the
+        Pallas path whenever dropout was on)."""
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.ops.pallas_kernels import attention_path_counts
+        paddle.seed(0)
+        q = paddle.randn([1, 2, 32, 16])
+        set_flags({"FLAGS_flash_dropout_interpret": True})
+        try:
+            attention_path_counts(reset=True)
+            out, _ = F.scaled_dot_product_attention(q, q, q, dropout_p=0.3,
+                                                    is_causal=True,
+                                                    training=True)
+            counts = attention_path_counts()
+            assert counts["flash_dropout"] == 1 and counts["xla_sdpa"] == 0
+            assert out.shape == [1, 2, 32, 16]
+        finally:
+            set_flags({"FLAGS_flash_dropout_interpret": False})
+        # eval mode: no dropout, plain flash
+        attention_path_counts(reset=True)
+        F.scaled_dot_product_attention(q, q, q, dropout_p=0.3,
+                                       is_causal=True, training=False)
+        assert attention_path_counts()["flash"] == 1
 
 
 class TestFusedBiasDropoutResidualLN:
