@@ -45,13 +45,23 @@ def constrain(t: Tensor, spec: P) -> Tensor:
         return t
     names = set()
     for el in spec:
-        if el is None:
+        if el is None or el is P.UNCONSTRAINED:
             continue
         names.update(el if isinstance(el, tuple) else (el,))
     if not all(n in mesh.shape for n in names):
         return t
     arr = jax.lax.with_sharding_constraint(t._data, NamedSharding(mesh, spec))
     return Tensor(arr, _internal=True)
+
+
+def _tail_spec(ndim: int, last) -> P:
+    """Spec constraining ONLY the last dim (`last` = "mp" to keep it
+    sharded, None to force it replicated/psum'ed); every other dim is left
+    UNCONSTRAINED so whatever batch/sequence sharding the engine chose
+    (dp, dp×sharding under ZeRO, sep, …) flows through. A hard `None` here
+    would demand replication of the batch dim — the source of the r3
+    "Involuntary full rematerialization" SPMD warnings."""
+    return P(*([P.UNCONSTRAINED] * (ndim - 1) + [last]))
 
 
 class VocabParallelEmbedding(Layer):
@@ -74,7 +84,7 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         out = F.embedding(x, self.weight)
-        return constrain(out, P())
+        return constrain(out, _tail_spec(out.ndim, None))
 
 
 class ColumnParallelLinear(Layer):
@@ -105,10 +115,8 @@ class ColumnParallelLinear(Layer):
 
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
-        if self.gather_output:
-            return constrain(out, P())
-        spec = [None] * (out.ndim - 1) + [_mp_axis()]
-        return constrain(out, P(*spec))
+        return constrain(out, _tail_spec(
+            out.ndim, None if self.gather_output else _mp_axis()))
 
 
 class RowParallelLinear(Layer):
@@ -138,10 +146,9 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            spec = [None] * (x.ndim - 1) + [_mp_axis()]
-            x = constrain(x, P(*spec))
+            x = constrain(x, _tail_spec(x.ndim, _mp_axis()))
         out = F.linear(x, self.weight, self.bias)
-        return constrain(out, P())
+        return constrain(out, _tail_spec(out.ndim, None))
 
 
 class ParallelCrossEntropy(Layer):
@@ -155,8 +162,7 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):  # noqa: A002
-        spec = [None] * (input.ndim - 1) + [_mp_axis()]
-        logits = constrain(input, P(*spec))
+        logits = constrain(input, _tail_spec(input.ndim, _mp_axis()))
         loss = F.cross_entropy(logits, label, reduction="none",
                                ignore_index=self.ignore_index)
         return loss

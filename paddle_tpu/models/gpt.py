@@ -229,7 +229,11 @@ def _lm_logits(hidden, word_embedding_weight):
     from ..ops import math as m
     from jax.sharding import PartitionSpec as P
     logits = m.matmul(hidden, word_embedding_weight, transpose_y=True)
-    return constrain(logits, P("dp", "sep", "mp"))
+    # batch dim left UNCONSTRAINED: the engine owns the batch layout
+    # (dp, or dp×sharding under ZeRO — jit/engine.py _batch_spec); a bare
+    # "dp" here conflicted with it and forced SPMD full-rematerialization
+    # of every decoder activation (r3 VERDICT)
+    return constrain(logits, P(P.UNCONSTRAINED, "sep", "mp"))
 
 
 class GPTForPretraining(Layer):
