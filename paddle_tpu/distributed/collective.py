@@ -187,6 +187,44 @@ def _eager_shard_map(g: Group, fn, arr, out_rank_dim=True):
     return jax.jit(mapped)(arr)
 
 
+def _cross_process(g: Group) -> bool:
+    """True when the group's ranks live in SEPARATE controller processes
+    (multi-host / launch.py-spawned workers): the single-controller eager
+    convention ("a tensor without a rank dim is replicated") does not hold
+    — each process owns a DIFFERENT value for the same name, so eager
+    collectives must physically exchange across processes (the reference's
+    NCCL ring spanning trainers, c_allreduce_op.h:356)."""
+    if jax.process_count() <= 1:
+        return False
+    me = jax.process_index()
+    return any(d.process_index != me for d in g.devices)
+
+
+def _process_exchange(arr, g: Group):
+    """All-gather a host-local array across the group's processes →
+    np.ndarray [nranks, *S] in rank order, using the cluster
+    jax.distributed set up (the reference's gen_comm_id-bootstrapped
+    rings).
+
+    Only valid when group rank i IS process i (one device per process,
+    process order) — process_allgather stacks per-PROCESS in process
+    order, so any other topology would silently permute or under-count
+    ranks. Other shapes must use the compiled path (shard_map over the
+    group's mesh axis), where XLA owns the rank↔device mapping."""
+    from jax.experimental import multihost_utils
+    if ([d.process_index for d in g.devices]
+            == list(range(jax.process_count()))):
+        # numpy input → host-local gather path (a jax.Array input would
+        # be treated as a global array and rejected untiled)
+        return np.asarray(multihost_utils.process_allgather(
+            np.asarray(arr)))
+    raise NotImplementedError(
+        "eager cross-process collectives require group rank i == process "
+        "i (one device per process); for sub-groups or multi-device "
+        "processes run the collective inside a compiled step (shard_map "
+        "over the group's mesh axis) instead")
+
+
 def _wrap(t):
     return t._data if isinstance(t, Tensor) else jnp.asarray(t)
 
@@ -226,6 +264,19 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                 return jnp.exp(jax.lax.psum(jnp.log(x), g.axis_name))
             return lax_fn(x, g.axis_name)
         return _ret(tensor, _eager_shard_map(g, blk, arr))
+    if _cross_process(g):
+        stacked = _process_exchange(arr, g)   # [nranks, *S] in rank order
+        if op == ReduceOp.SUM:
+            out = stacked.sum(0)
+        elif op == ReduceOp.MAX:
+            out = stacked.max(0)
+        elif op == ReduceOp.MIN:
+            out = stacked.min(0)
+        elif op == ReduceOp.PROD:
+            out = stacked.prod(0)
+        else:  # AVG
+            out = stacked.mean(0)
+        return _ret(tensor, jnp.asarray(out, arr.dtype))
     # replicated eager input: every rank holds `arr`
     if op == ReduceOp.SUM:
         out = arr * g.nranks
@@ -259,6 +310,10 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
             return jax.lax.all_gather(x, g.axis_name, axis=0, tiled=True)
         gathered = _eager_shard_map(g, blk, arr)  # (nranks, *S) replic-per-blk
         parts = [gathered[i] for i in range(g.nranks)]
+    elif _cross_process(g):
+        stacked = _process_exchange(arr, g)   # [nranks, *S] in rank order
+        parts = [jnp.asarray(stacked[i], arr.dtype)
+                 for i in range(g.nranks)]
     else:
         parts = [arr for _ in range(g.nranks)]
     if tensor_list is not None:
@@ -281,6 +336,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
             return jax.lax.all_gather(x, g.axis_name, axis=0,
                                       tiled=True)[src:src + 1]
         return _ret(tensor, _eager_shard_map(g, blk, arr))
+    if _cross_process(g):
+        stacked = _process_exchange(arr, g)
+        return _ret(tensor, jnp.asarray(stacked[src], arr.dtype))
     return _ret(tensor, arr)  # replicated already
 
 
@@ -385,9 +443,21 @@ def p2p_permute(tensor, group=None, perm=None):
 
 def barrier(group=None):
     """reference: barrier op. Eager single-controller: block host on all
-    devices (the only ordering hazard that exists here)."""
-    for d in _get_group(group).devices:
-        pass
+    devices (the only ordering hazard that exists here). Cross-process:
+    a real rendezvous over the coordinator-established mesh."""
+    g = _get_group(group)
+    if _cross_process(g):
+        procs = {d.process_index for d in g.devices}
+        if procs != set(range(jax.process_count())):
+            raise NotImplementedError(
+                "cross-process barrier over a sub-group of processes is "
+                "not supported (sync_global_devices is a whole-cluster "
+                "rendezvous)")
+        from jax.experimental import multihost_utils
+        # stable key: group ids are per-process counters and may diverge
+        # between processes, which would abort the rendezvous
+        multihost_utils.sync_global_devices("paddle_tpu_barrier_world")
+        return
     jax.block_until_ready(jnp.zeros(()))
 
 

@@ -29,10 +29,26 @@ class Place:
         return hash((self._kind, self.device_id))
 
     def jax_device(self):
+        # ADDRESSABLE devices only: a Place names a process-local device
+        # (reference: per-trainer FLAGS_selected_gpus). Under multi-process
+        # (jax.distributed), jax.devices() lists the whole cluster and its
+        # first entry may belong to another process — committing host data
+        # there is impossible.
         import jax
-        devs = [d for d in jax.devices() if _platform_of(d) == self._kind]
-        if not devs:  # fall back to host
-            devs = jax.devices("cpu")
+        devs = [d for d in jax.local_devices()
+                if _platform_of(d) == self._kind]
+        if not devs:
+            # fall back to the host CPU (CPUPlace on an accelerator
+            # backend must stay host-pinned, e.g. tensor.cpu()); the cpu
+            # platform is not in local_devices() when tpu is default
+            try:
+                me = jax.process_index()
+                devs = [d for d in jax.devices("cpu")
+                        if d.process_index == me]
+            except RuntimeError:
+                devs = []
+        if not devs:
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
 

@@ -27,11 +27,15 @@ def with_host_device_count(flags: str, n_devices: int) -> str:
     return (flags + " " + want).strip()
 
 
-def pin_host_platform(n_devices: int = 8):
+def pin_host_platform(n_devices: int = 8, verify: bool = True):
     """Force jax onto the host (CPU) platform with `n_devices` virtual
     devices. Returns the imported jax module. Raises RuntimeError if the
     platform config can no longer be changed (backend already initialized —
-    run in a fresh process)."""
+    run in a fresh process).
+
+    `verify=False` skips the devices() probe — REQUIRED when the caller
+    will run jax.distributed.initialize next (a multi-process rank), which
+    must happen before anything initializes the XLA backend."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = with_host_device_count(
         os.environ.get("XLA_FLAGS", ""), n_devices)
@@ -39,6 +43,8 @@ def pin_host_platform(n_devices: int = 8):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if not verify:
+        return jax
     # config.update is a silent no-op once a backend is up, so verify: if a
     # backend already initialized on another platform, devices() returns it
     # immediately (no tunnel touch) and we must fail loudly rather than let

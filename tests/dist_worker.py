@@ -1,0 +1,107 @@
+"""Per-rank worker for the REAL multi-process distributed tests.
+
+Mirrors the reference's subprocess trainers
+(/root/reference/python/paddle/fluid/tests/unittests/test_dist_base.py:903-983
+and test_collective_base.py:32-80): each rank is a separate OS process; the
+coordinator handshake is jax.distributed.initialize (via init_parallel_env —
+the gen_nccl_id/c_comm_init analogue, distributed/env.py), collectives
+physically cross the process boundary, and the 2-step data-parallel loss
+trajectory must match a single-process full-batch run exactly.
+
+Launched by tests/test_multiprocess_dist.py through
+`python -m paddle_tpu.distributed.launch --nproc_per_node 2` (launch-env
+path) or `paddle.distributed.spawn` (spawn path). Writes one JSON file per
+rank to $PT_DIST_OUT.<rank>.
+"""
+import json
+import os
+import sys
+
+
+def train_dp(rank, world):
+    """2 steps of hand-rolled DP-SGD: local shard grads, cross-process
+    AVG all-reduce, SGD update. Deterministic (seeded init + fixed data)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.Tanh(),
+                               paddle.nn.Linear(16, 1))
+    rs = np.random.RandomState(42)
+    X = rs.randn(8, 8).astype(np.float32)
+    Y = rs.randn(8, 1).astype(np.float32)
+    per = 8 // world
+    xs, ys = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
+    losses = []
+    lr = 0.1
+    for _ in range(2):
+        x, y = paddle.to_tensor(xs), paddle.to_tensor(ys)
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        lt = paddle.to_tensor(loss.numpy())
+        dist.all_reduce(lt, op=dist.ReduceOp.AVG)
+        losses.append(float(lt.numpy()))
+        for p in net.parameters():
+            g = p.grad
+            dist.all_reduce(g, op=dist.ReduceOp.AVG)
+            p.set_value(p.numpy() - lr * g.numpy())
+            p.clear_gradient()
+    return losses
+
+
+def run_rank():
+    from paddle_tpu.framework.platform import pin_host_platform
+    # each rank-process owns ONE cpu device; verify=False because the
+    # backend must not initialize before jax.distributed.initialize
+    pin_host_platform(1, verify=False)
+
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()   # coordinator handshake when world > 1
+    rank, world = dist.get_rank(), dist.get_world_size()
+    res = {"rank": rank, "world": world,
+           "process_count": jax.process_count(),
+           "global_devices": len(jax.devices())}
+
+    # collective handshake: sum of (rank+1)^2 over ranks; bcast from rank 1
+    t = paddle.to_tensor(np.full((4,), float((rank + 1) ** 2), np.float32))
+    dist.all_reduce(t)
+    res["allreduce"] = t.numpy().tolist()
+    b = paddle.to_tensor(np.full((3,), float(rank), np.float32))
+    dist.broadcast(b, src=world - 1)
+    res["broadcast"] = b.numpy().tolist()
+    gathered = dist.all_gather(None, paddle.to_tensor(
+        np.full((2,), float(rank + 10), np.float32)))
+    res["all_gather"] = gathered.numpy().tolist()
+    dist.barrier()
+
+    res["losses"] = train_dp(rank, world)
+    out = os.environ.get("PT_DIST_OUT")
+    if out:
+        with open(f"{out}.{rank}", "w") as f:
+            json.dump(res, f)
+    print("WORKER_OK", rank)
+
+
+def spawn_entry():
+    """Entry for the paddle.distributed.spawn path (module-level so the
+    mp 'spawn' start method can pickle it by reference)."""
+    run_rank()
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "spawn":
+        # parent: exercise the spawn API itself (env plumbing + join)
+        import paddle_tpu.distributed as dist
+        dist.spawn(spawn_entry, nprocs=2)
+        print("SPAWN_PARENT_OK")
+    else:
+        run_rank()
+
+
+if __name__ == "__main__":
+    main()
