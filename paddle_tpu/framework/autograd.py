@@ -7,16 +7,25 @@ walks them in reverse creation order, computing each node's input cotangents
 with a cached, jitted jax.vjp of the op's pure function (the forward is
 recomputed inside the backward executable — primals are the only residuals,
 XLA DCEs the rest).
+
+create_graph=True (reference: PartialGradEngine,
+imperative/partial_grad_engine.cc + test_imperative_double_grad.py) runs
+each node's vjp THROUGH the dispatch layer as a recorded op: cotangents
+stay Tensors, every grad computation lands on the tape, and a second
+backward differentiates through it (vjp-of-vjp) — the gradient-penalty /
+WGAN-GP training pattern.
 """
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import state
-from .dispatch import TapeNode, _bwd_exec, _is_float
+from .dispatch import Primitive, TapeNode, _bwd_exec, _is_float
 from .tensor import Tensor
 
 # Process-global tape (reference: the autograd graph hanging off VarBases).
@@ -36,14 +45,19 @@ def reset_tape():
 
 
 def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
-             retain_graph: bool = False):
+             retain_graph: bool = False, create_graph: bool = False,
+             leaf_sink: Optional[Dict[int, object]] = None):
+    """`leaf_sink` (internal, used by paddle.grad): when given, leaf
+    gradients accumulate into this uid-keyed dict INSTEAD of the tensors'
+    .grad slots — paddle.grad(only_inputs=True) must not touch the .grad
+    of leaves it was not asked about (reference: PartialGradEngine)."""
     if loss.stop_gradient:
         raise RuntimeError(
             "backward() on a tensor with stop_gradient=True — nothing to do")
     if loss._node is None:
         # leaf with requires-grad: its grad is just the seed
         seed = grad_tensor._data if grad_tensor is not None else jnp.ones_like(loss._data)
-        _accumulate_leaf(loss, seed)
+        _accumulate_leaf(loss, seed, leaf_sink)
         return
 
     if grad_tensor is None:
@@ -54,6 +68,10 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
         seed = jnp.ones_like(loss._data)
     else:
         seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    if create_graph:
+        _backward_create_graph(loss, seed, leaf_sink)
+        return
 
     # ---- collect the reachable subgraph (reference: BasicEngine init) ----
     nodes: Dict[int, TapeNode] = {}
@@ -134,7 +152,7 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
             g = next(gi)
             if t is None or g is None or not _is_float(np.dtype(str(g.dtype)) if isinstance(g.dtype, str) else g.dtype):
                 continue
-            _route_grad(t, g, grads)
+            _route_grad(t, g, grads, leaf_sink)
 
         if not retain_graph:
             node.in_arrays = None  # free residuals
@@ -145,7 +163,143 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
         _prune_tape(nodes)
 
 
-def _route_grad(t: Tensor, g, grads: Dict[int, object]):
+@functools.lru_cache(maxsize=4096)
+def _grad_primitive(fn, attr_key, need_mask, out_float_mask, n_in):
+    """A dispatchable op computing one tape node's vjp:
+    (primals…, cotangents…) → filtered input grads. Because it runs
+    through Primitive.__call__, its outputs are tape-recorded and its OWN
+    vjp is jax's vjp-of-vjp — this is what makes create_graph work."""
+    attrs = dict(attr_key)
+
+    def f_float(*arrays):
+        outs = fn(*arrays, **attrs)
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        return tuple(o for o, m in zip(outs, out_float_mask) if m)
+
+    def grad_fn(*ops):
+        primals, cts = ops[:n_in], ops[n_in:]
+        _, vjp_fn = jax.vjp(f_float, *primals)
+        gs = vjp_fn(tuple(cts))
+        return tuple(g for g, m in zip(gs, need_mask) if m)
+
+    return Primitive(f"__grad__{getattr(fn, '__name__', 'op')}", grad_fn,
+                     register=False)
+
+
+def _backward_create_graph(loss: Tensor, seed,
+                           leaf_sink: Optional[Dict[int, object]] = None):
+    """Tensor-cotangent backward: every per-node vjp is executed through
+    the dispatch layer, so the produced grads carry tape nodes and a
+    SECOND backward()/grad() differentiates through them. Residuals are
+    never freed (create_graph implies retain_graph), mirroring the
+    reference's PartialGradEngine create_graph semantics."""
+    nodes: Dict[int, TapeNode] = {}
+    stack = [loss._node]
+    while stack:
+        n = stack.pop()
+        if n.seq in nodes:
+            continue
+        nodes[n.seq] = n
+        for t in n.in_tensors:
+            if t is not None and t._node is not None \
+                    and t._node.seq not in nodes:
+                stack.append(t._node)
+
+    grads: Dict[int, Tensor] = {
+        loss._uid: Tensor(seed, stop_gradient=False, _internal=True)}
+    for node in sorted(nodes.values(), key=lambda n: -n.seq):
+        cts: List[Tensor] = []
+        out_float_mask = []
+        any_ct = False
+        for ref, (shape, dt) in zip(node.out_refs, node.out_avals):
+            isf = _is_float(dt)
+            out_float_mask.append(isf)
+            if not isf:
+                continue
+            t = ref()
+            g = grads.pop(t._uid, None) if t is not None else None
+            if g is None:
+                g = Tensor(jnp.zeros(shape, dt), _internal=True)
+            else:
+                any_ct = True
+            cts.append(g)
+        if not any_ct:
+            continue
+        if node.in_arrays is None:
+            raise RuntimeError(
+                f"Trying to backward through op '{node.name}' whose saved "
+                "activations were freed by a previous backward() — use "
+                "retain_graph=True there, or recompute the value")
+        if node.name in SPARSE_VJPS:
+            import warnings
+            warnings.warn(
+                f"create_graph=True densifies the sparse vjp of op "
+                f"'{node.name}' (row-sparse grads are first-order only)",
+                stacklevel=2)
+        n_in = len(node.in_arrays)
+        attr_key = node.attr_key or ()
+        if attr_key and attr_key[0] == "__raw__":
+            attr_key = tuple(dict(attr_key[1]).items())
+        try:
+            prim = _grad_primitive(node.fn, attr_key, node.need_mask,
+                                   tuple(out_float_mask), n_in)
+        except TypeError:  # unhashable attr values: uncached primitive
+            prim = _grad_primitive.__wrapped__(
+                node.fn, attr_key, node.need_mask, tuple(out_float_mask),
+                n_in)
+            prim.dynamic = True
+        # primal inputs: Tensor identity where we have it (second-order
+        # grads must route back into the SAME tensors), raw array else.
+        # The vjp must see the FORWARD-TIME primals (node.in_arrays), not
+        # whatever the tensor holds now — in-place set_value/optimizer
+        # writes between forward and backward would otherwise shift the
+        # linearization point (the standard path reads in_arrays too).
+        ins = [t if t is not None else a
+               for t, a in zip(node.in_tensors, node.in_arrays)]
+        swapped = []
+        for t, a in zip(node.in_tensors, node.in_arrays):
+            if t is not None and t._data is not a:
+                swapped.append((t, t._data))
+                t._data = a
+        try:
+            outs = prim(*ins, *cts)
+        finally:
+            for t, a in swapped:
+                t._data = a
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        gi = iter(outs)
+        for t, need in zip(node.in_tensors, node.need_mask):
+            if not need:
+                continue
+            g = next(gi)
+            if t is None or not _is_float(g._data.dtype):
+                continue
+            if t._backward_hooks:
+                for hook in list(t._backward_hooks):
+                    out = hook(g)
+                    if out is not None:
+                        g = out
+            if t._node is None or state.STATE.retain_grads:
+                from .selected_rows import SelectedRows
+                if leaf_sink is not None:
+                    prev = leaf_sink.get(t._uid)
+                    if isinstance(prev, SelectedRows):
+                        prev = Tensor(prev.to_dense(), _internal=True)
+                    leaf_sink[t._uid] = g if prev is None else prev + g
+                else:
+                    prev = t._grad
+                    if isinstance(prev, SelectedRows):
+                        prev = Tensor(prev.to_dense(), _internal=True)
+                    t._grad = g if prev is None else prev + g
+            if t._node is not None:
+                prev = grads.get(t._uid)
+                grads[t._uid] = g if prev is None else prev + g
+
+
+def _route_grad(t: Tensor, g, grads: Dict[int, object],
+                leaf_sink: Optional[Dict[int, object]] = None):
     from .selected_rows import SelectedRows
     if isinstance(g, SelectedRows) and (t._backward_hooks or t._node is not None):
         # sparse cotangents are kept factored only on hook-free leaves
@@ -162,14 +316,25 @@ def _route_grad(t: Tensor, g, grads: Dict[int, object]):
         g = gt._data
     if t._node is None or state.STATE.retain_grads:
         # leaf (parameter / input with stop_gradient=False): accumulate .grad
-        _accumulate_leaf(t, g)
+        _accumulate_leaf(t, g, leaf_sink)
     if t._node is not None:
         prev = grads.get(t._uid)
         grads[t._uid] = g if prev is None else prev + g
 
 
-def _accumulate_leaf(t: Tensor, g):
+def _accumulate_leaf(t: Tensor, g, leaf_sink=None):
     from .selected_rows import SelectedRows
+    if leaf_sink is not None:
+        prev = leaf_sink.get(t._uid)
+        if prev is None:
+            leaf_sink[t._uid] = g
+        elif isinstance(g, SelectedRows) or isinstance(prev, SelectedRows):
+            a = prev.to_dense() if isinstance(prev, SelectedRows) else prev
+            b = g.to_dense() if isinstance(g, SelectedRows) else g
+            leaf_sink[t._uid] = a + b
+        else:
+            leaf_sink[t._uid] = prev + g
+        return
     if isinstance(g, SelectedRows):
         if t._grad is None:
             t._grad = g
@@ -196,9 +361,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
     """paddle.grad parity (reference: PartialGradEngine,
-    imperative/partial_grad_engine.cc). v1: computed via a full backward over
-    detached .grad slots; create_graph (higher-order) is handled by jax.grad
-    composition in paddle_tpu.autograd.functional instead."""
+    imperative/partial_grad_engine.cc). Computed via a full backward over
+    detached .grad slots. create_graph=True returns GRAPH-CONNECTED grads
+    (each vjp runs through the dispatch layer and is tape-recorded), so a
+    further backward()/grad() over them yields second derivatives — the
+    test_imperative_double_grad.py / gradient-penalty pattern."""
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
@@ -208,26 +375,27 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     elif isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
 
-    # stash existing .grad, run backward, read, restore
-    stash = [(t, t._grad) for t in inputs]
+    # leaf grads land in a sink dict: paddle.grad must not touch ANY
+    # tensor's .grad slot, inputs' or otherwise (only_inputs semantics —
+    # a first-order grad leaking into a parameter's .grad would corrupt a
+    # later gradient-penalty backward)
+    sink: Dict[int, object] = {}
+    for o, go in zip(outputs, grad_outputs):
+        backward(o, grad_tensor=go, retain_graph=True,
+                 create_graph=create_graph, leaf_sink=sink)
+    results = []
     for t in inputs:
-        t._grad = None
-    try:
-        for o, go in zip(outputs, grad_outputs):
-            backward(o, grad_tensor=go, retain_graph=True)
-        results = []
-        for t in inputs:
-            if t._grad is None:
-                if not allow_unused:
-                    raise RuntimeError(
-                        f"input {t.name} unused in the graph "
-                        "(pass allow_unused=True to get None)")
-                results.append(None)
-            else:
-                results.append(t._grad)
-    finally:
-        for t, g in stash:
-            t._grad = g
+        g = sink.get(t._uid)
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input {t.name} unused in the graph "
+                    "(pass allow_unused=True to get None)")
+            results.append(None)
+        else:
+            from .selected_rows import SelectedRows
+            results.append(g if isinstance(g, (Tensor, SelectedRows))
+                           else Tensor(g, _internal=True))
     if retain_graph is False:
         reset_tape()
     return results

@@ -121,12 +121,16 @@ class Primitive:
     __slots__ = ("name", "fn", "nondiff", "dynamic")
 
     def __init__(self, name: str, fn: Callable, nondiff: bool = False,
-                 dynamic: bool = False):
+                 dynamic: bool = False, register: bool = True):
         self.name = name
         self.fn = fn
         self.nondiff = nondiff
         self.dynamic = dynamic  # dynamic output shape: never jit-cache
-        OPS[name] = self
+        if register:
+            # register=False: internal/ephemeral primitives (e.g. the
+            # autograd create_graph vjp ops) must not pollute the global
+            # name → op table that serialized programs resolve against
+            OPS[name] = self
 
     def __call__(self, *args, **attrs):
         from .tensor import Tensor

@@ -1,0 +1,108 @@
+"""Eager double-backward — create_graph=True (r4, VERDICT item 4).
+
+reference: paddle/fluid/imperative/partial_grad_engine.cc and
+python/paddle/fluid/tests/unittests/test_imperative_double_grad.py.
+Oracles are jax.grad / jax.grad(jax.grad) of the same math.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_grad_create_graph_simple():
+    """d/dx of (dy/dx) for y = x^3: first grad 3x^2, second 6x."""
+    x = paddle.to_tensor(np.array([1.5, -2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = (x * x * x).sum()
+    (gx,) = paddle.grad([y], [x], create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), 3 * x.numpy() ** 2, rtol=1e-6)
+    assert not gx.stop_gradient  # graph-connected
+    (ggx,) = paddle.grad([gx.sum()], [x])
+    np.testing.assert_allclose(ggx.numpy(), 6 * x.numpy(), rtol=1e-6)
+
+
+def test_grad_of_grad_matches_jax():
+    """Nonlinear chain incl. matmul/tanh: ∂/∂x ||∂f/∂x||² vs jax oracle."""
+    rs = np.random.RandomState(0)
+    xv = rs.randn(4, 3).astype(np.float32)
+    wv = rs.randn(3, 3).astype(np.float32)
+
+    def f(x, w):
+        return jnp.sum(jnp.tanh(x @ w) ** 2)
+
+    def gp(x, w):
+        gx = jax.grad(f, argnums=0)(x, w)
+        return jnp.sum(gx ** 2)
+
+    want = jax.grad(gp, argnums=0)(xv, wv)
+
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    w = paddle.to_tensor(wv, stop_gradient=False)
+    y = (paddle.tanh(x.matmul(w)) ** 2).sum()
+    (gx,) = paddle.grad([y], [x], create_graph=True)
+    gp_loss = (gx * gx).sum()
+    (ggx,) = paddle.grad([gp_loss], [x])
+    np.testing.assert_allclose(ggx.numpy(), np.asarray(want), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_double_grad_through_backward():
+    """create_graph grads feed .backward() — second-order grads land in
+    leaf .grad slots (the WGAN-GP call shape)."""
+    x = paddle.to_tensor(np.array([[0.5, -1.0]], np.float32),
+                         stop_gradient=False)
+    w = paddle.to_tensor(np.array([[2.0], [1.0]], np.float32),
+                         stop_gradient=False)
+    y = paddle.nn.functional.sigmoid(x.matmul(w)).sum()
+    (gx,) = paddle.grad([y], [x], create_graph=True)
+    penalty = ((gx * gx).sum() - 1.0) ** 2
+    penalty.backward()
+
+    def pen(xv, wv):
+        def f(xv, wv):
+            return jax.nn.sigmoid(xv @ wv).sum()
+        gx = jax.grad(f, argnums=0)(xv, wv)
+        return (jnp.sum(gx ** 2) - 1.0) ** 2
+
+    want_w = jax.grad(pen, argnums=1)(x.numpy(), w.numpy())
+    np.testing.assert_allclose(w.grad.numpy(), np.asarray(want_w),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_penalty_training_converges():
+    """2-step training with a gradient-penalty term in the loss
+    (reference pattern: WGAN-GP); parity vs a pure-jax training loop."""
+    rs = np.random.RandomState(3)
+    xv = rs.randn(8, 4).astype(np.float32)
+    wv = (rs.randn(4, 1) * 0.5).astype(np.float32)
+    lam, lr = 0.1, 0.05
+
+    def loss_jax(w, x):
+        def critic(x_in, w_in):
+            return jnp.tanh(x_in @ w_in).sum()
+        gx = jax.grad(critic, argnums=0)(x, w)
+        gp = (jnp.sqrt(jnp.sum(gx ** 2, axis=1) + 1e-12) - 1.0) ** 2
+        return critic(x, w) + lam * gp.mean()
+
+    w_ref = jnp.asarray(wv)
+    ref_losses = []
+    for _ in range(2):
+        l, g = jax.value_and_grad(loss_jax)(w_ref, jnp.asarray(xv))
+        ref_losses.append(float(l))
+        w_ref = w_ref - lr * g
+
+    w = paddle.to_tensor(wv, stop_gradient=False)
+    got_losses = []
+    for _ in range(2):
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        critic = paddle.tanh(x.matmul(w)).sum()
+        (gx,) = paddle.grad([critic], [x], create_graph=True)
+        norm = ((gx * gx).sum(axis=1) + 1e-12).sqrt()
+        loss = critic + lam * ((norm - 1.0) ** 2).mean()
+        loss.backward()
+        got_losses.append(float(loss.numpy()))
+        w.set_value(w.numpy() - lr * w.grad.numpy())
+        w.clear_gradient()
+    np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-5)
