@@ -192,11 +192,19 @@ def bench_ernie(on_tpu):
             "mfu": _mfu(flops, dt)}
 
 
-def bench_resnet50(on_tpu):
-    """ResNet-50 static-graph Executor training (BASELINE config 2)."""
+def bench_resnet50(on_tpu, conv_algo="direct"):
+    """ResNet-50 static-graph Executor training (BASELINE config 2).
+
+    conv_algo: 'direct' or 'im2col' (FLAGS_conv_algo) — the r4 comparison
+    settling whether the environment's conv lowering is the ResNet
+    bottleneck (VERDICT item 5)."""
     import paddle_tpu as paddle
     from paddle_tpu import static
+    from paddle_tpu.framework.flags import get_flags, set_flags
     from paddle_tpu.vision.models import resnet50
+
+    prev_algo = get_flags(["FLAGS_conv_algo"])["FLAGS_conv_algo"]
+    set_flags({"FLAGS_conv_algo": conv_algo})
 
     if on_tpu:
         B, hw, steps, warmup = 64, 224, 20, 3
@@ -204,6 +212,9 @@ def bench_resnet50(on_tpu):
         B, hw, steps, warmup = 4, 32, 2, 3  # first TWO runs compile
 
     paddle.enable_static()
+    # fresh default programs: back-to-back runs in one process (the
+    # direct-vs-im2col comparison) must not append to each other's graph
+    static.reset_default_programs()
     try:
         paddle.seed(0)
         img = static.data("image", [-1, 3, hw, hw], "float32")
@@ -238,12 +249,13 @@ def bench_resnet50(on_tpu):
         dt = (time.perf_counter() - t0) / steps
     finally:
         paddle.disable_static()
-
+        set_flags({"FLAGS_conv_algo": prev_algo})
     # ResNet-50 fwd ≈ 4.1 GFLOPs / 224² image (scales with area);
     # train ≈ 3× fwd
     fwd = 4.1e9 * (hw * hw) / (224 * 224)
     flops = 3 * fwd * B
     return {"config": "resnet50_static_train",
+            "conv_algo": conv_algo,
             "throughput": round(B / dt, 1),
             "unit": "images/sec/chip",
             "step_ms": round(dt * 1e3, 2),
@@ -264,6 +276,11 @@ def main():
             continue
         try:
             print(json.dumps(fn(on_tpu)), flush=True)
+            if name == "resnet50" and on_tpu:
+                # r4 conv-path comparison (VERDICT item 5): same config,
+                # matmul-routed convs — recorded next to the direct run
+                print(json.dumps(fn(on_tpu, conv_algo="im2col")),
+                      flush=True)
         except Exception as e:
             print(json.dumps({"config": name,
                               "error": f"{type(e).__name__}: {e}"}),

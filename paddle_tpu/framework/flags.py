@@ -68,6 +68,11 @@ define_flag("allocator_strategy", "xla",
             "accepted for parity; XLA/PJRT owns device memory")
 define_flag("tpu_matmul_precision", "default",
             "jax matmul precision: default|high|highest")
+define_flag("conv_algo", "direct",
+            "convolution lowering: 'direct' (lax.conv -> XLA conv) or "
+            "'im2col' (patches + one MXU matmul; groups=1 only). The "
+            "im2col path exists to bench/bypass environments whose conv "
+            "lowering underperforms (BASELINE.md ResNet-50 investigation)")
 define_flag("flash_dropout_interpret", False,
             "allow the dropout-enabled flash kernel in interpret mode "
             "(CPU kernel tests only — the emulator is too slow for train "

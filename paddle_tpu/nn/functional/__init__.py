@@ -157,10 +157,12 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def _convnd(x, weight, bias, stride, padding, dilation, groups, data_format, n):
     channel_last = data_format[-1] == "C" and len(data_format) > 2
+    from ...framework.flags import flag
     out = _nn.conv(x, weight, stride=_pair(stride, n),
                    padding=_norm_padding(padding, n),
                    dilation=_pair(dilation, n), groups=int(groups),
-                   channel_last=channel_last)
+                   channel_last=channel_last,
+                   algo=str(flag("conv_algo")))
     if bias is not None:
         shape = ((1,) * (n + 1) + (-1,)) if channel_last else ((1, -1) + (1,) * n)
         out = _m.add(out, _mp.reshape(bias, shape))

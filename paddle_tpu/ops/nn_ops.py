@@ -195,15 +195,51 @@ def _conv_dn(ndim, channel_last):
     return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
 
 
+def _conv_im2col(x, w, stride, pad, dilation, channel_last):
+    """Convolution as one big matmul: extract patches (a conv against an
+    identity kernel — cheap, bandwidth-bound) then contract all (cin·kh·kw)
+    taps in a single MXU-shaped dot. Flag-gated alternative to the direct
+    lax.conv lowering (FLAGS_conv_algo=im2col) — the r3 ResNet number
+    suggested the tunnel's conv lowering runs ~100x below matmul peak; this
+    path answers whether a matmul-routed conv recovers it (reference
+    analogue: the im2col path in conv_op.cc / math/im2col.cc that cuDNN
+    replaced)."""
+    nd = x.ndim
+    spec = _conv_dn(nd, channel_last)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
+    nsp = nd - 2
+    k = [w.shape[dn.rhs_spec[2 + i]] for i in range(nsp)]
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=tuple(stride), padding=pad,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=dn)
+    # patches features = (cin, *k) flattened, in the layout's feature dim
+    cin = x.shape[dn.lhs_spec[1]]
+    cout = w.shape[dn.rhs_spec[0]]
+    # weight → [cout, cin*prod(k)]: move O first, I and taps after, in the
+    # same (cin, *k) order as the patches features
+    perm = (dn.rhs_spec[0], dn.rhs_spec[1]) + tuple(dn.rhs_spec[2:])
+    w2 = jnp.transpose(w, perm).reshape(cout, -1)
+    if channel_last:   # patches [N, *sp, cin*k]
+        out = jnp.einsum("...f,of->...o", patches, w2,
+                         preferred_element_type=jnp.float32)
+    else:              # patches [N, cin*k, *sp]
+        out = jnp.einsum("nf...,of->no...", patches, w2,
+                         preferred_element_type=jnp.float32)
+    return out.astype(x.dtype) if x.dtype != jnp.bfloat16 else out
+
+
 @primitive("conv2d_op")
 def conv(x, w, *, stride=(1, 1), padding=(0, 0), dilation=(1, 1), groups=1,
-         channel_last=False):
+         channel_last=False, algo="direct"):
     nd = x.ndim
     spec = _conv_dn(nd, channel_last)
     if isinstance(padding, str):
         pad = padding  # 'SAME' / 'VALID'
     else:
         pad = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    if algo == "im2col" and groups == 1:
+        return _conv_im2col(x, w, stride, pad, dilation, channel_last)
     dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
     out = lax.conv_general_dilated(
         x, w, window_strides=tuple(stride), padding=pad,
