@@ -27,6 +27,15 @@ __all__ = [
     "gather", "elementwise_add", "elementwise_sub", "elementwise_mul",
     "elementwise_div", "accuracy", "data", "sequence_pool", "sequence_conv",
     "sequence_softmax", "l2_normalize", "clip", "pad", "label_smooth",
+    # r4 long-tail (misc_ops / detection)
+    "affine_channel", "edit_distance", "ctc_greedy_decoder",
+    "iou_similarity", "box_clip", "sigmoid_focal_loss", "bipartite_match",
+    "target_assign", "mine_hard_examples", "matrix_nms",
+    "anchor_generator", "density_prior_box", "distribute_fpn_proposals",
+    "collect_fpn_proposals", "polygon_box_transform",
+    "box_decoder_and_assign", "retinanet_detection_output", "prior_box",
+    "box_coder", "multiclass_nms", "generate_proposals", "yolo_box",
+    "yolov3_loss",
 ]
 
 # parameter-creating layers are cached per PROGRAM (WeakKeyDictionary:
@@ -148,6 +157,56 @@ def dropout(x, dropout_prob, is_test=False, seed=None,
             if dropout_implementation == "downgrade_in_infer"
             else dropout_implementation)
     return F.dropout(x, p=dropout_prob, training=not is_test, mode=mode)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    """reference: fluid/layers/nn.py:12813 over affine_channel_op.cc."""
+    from ..ops.misc_ops import affine_channel as _op
+    out = _op(x, scale, bias, data_layout=data_layout)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """reference: fluid/layers/loss.py:363."""
+    return F.edit_distance(input, label, normalized, ignored_tokens,
+                           input_length, label_length)
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """reference: fluid/layers/nn.py ctc_greedy_decoder (padded mode)."""
+    return F.ctc_greedy_decoder(input, blank, input_length, padding_value)
+
+
+# reference: fluid/layers/detection.py — the detection surface is
+# star-imported into fluid.layers; implementations live in
+# vision/ops.py + vision/detection_extra.py.
+from ..vision import ops as _vo  # noqa: E402
+
+iou_similarity = _vo.iou_similarity
+box_clip = _vo.box_clip
+sigmoid_focal_loss = _vo.sigmoid_focal_loss
+bipartite_match = _vo.bipartite_match
+target_assign = _vo.target_assign
+mine_hard_examples = _vo.mine_hard_examples
+matrix_nms = _vo.matrix_nms
+anchor_generator = _vo.anchor_generator
+density_prior_box = _vo.density_prior_box
+distribute_fpn_proposals = _vo.distribute_fpn_proposals
+collect_fpn_proposals = _vo.collect_fpn_proposals
+polygon_box_transform = _vo.polygon_box_transform
+box_decoder_and_assign = _vo.box_decoder_and_assign
+retinanet_detection_output = _vo.retinanet_detection_output
+prior_box = _vo.prior_box
+box_coder = _vo.box_coder
+multiclass_nms = _vo.multiclass_nms
+generate_proposals = _vo.generate_proposals
+yolo_box = _vo.yolo_box
+yolov3_loss = _vo.yolo_loss
 
 
 def softmax(input, axis=-1, name=None):

@@ -747,6 +747,47 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
     return Tensor(out.reshape(nt, c, h, w), _internal=True)
 
 
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance metric (reference: fluid/layers/loss.py:363
+    over edit_distance_op.cc). Padded int64 inputs [B, T] with optional
+    per-row lengths; returns (distance [B,1] f32, sequence_num [1] f32)."""
+    from ...ops.misc_ops import edit_distance_arrays
+    from ...framework.dispatch import raw
+    d, n = edit_distance_arrays(
+        raw(input), raw(label),
+        None if input_length is None else raw(input_length),
+        None if label_length is None else raw(label_length),
+        normalized=normalized, ignored_tokens=ignored_tokens)
+    return Tensor(d, _internal=True), Tensor(n, _internal=True)
+
+
+def ctc_align(x, input_length, blank=0, merge_repeated=True,
+              padding_value=0, name=None):
+    """Merge repeats then remove blanks (reference: ctc_align_op.cc).
+    x: [B, T] int predictions; returns (aligned [B, T], out_lengths
+    [B, 1])."""
+    from ...ops.misc_ops import ctc_align as _op
+    return _op(x, input_length, blank=int(blank),
+               merge_repeated=bool(merge_repeated),
+               padding_value=int(padding_value))
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode: per-step argmax then ctc_align (reference:
+    fluid/layers/nn.py ctc_greedy_decoder padded-tensor mode).
+    input: [B, T, C] probs; returns (decoded [B, T], out_lengths [B,1])."""
+    idx = _m.argmax(input, axis=-1)
+    if input_length is None:
+        import numpy as _np
+        B, T = input.shape[0], input.shape[1]
+        input_length = Tensor(_np.full((B, 1), T, _np.int64),
+                              _internal=True)
+    return ctc_align(idx, input_length, blank=blank,
+                     padding_value=padding_value)
+
+
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False, name=None):
     """CTC loss (reference: nn/functional/loss.py ctc_loss over

@@ -13,6 +13,7 @@ from ..ops import manipulation as _mp
 from ..ops import nn_ops as _nn
 from ..ops import random_ops as _r
 from ..ops import linalg as _la
+from ..ops import misc_ops as _misc
 
 # ---- re-exports -----------------------------------------------------------
 # math
@@ -38,6 +39,7 @@ log = _m.log
 log2 = _m.log2
 log10 = _m.log10
 log1p = _m.log1p
+frexp = _misc.frexp
 sqrt = _m.sqrt
 rsqrt = _m.rsqrt
 square = _m.square
@@ -481,7 +483,7 @@ def _patch():
         "matmul": _m.matmul, "dot": _m.dot, "mm": _m.matmul, "bmm": _m.bmm,
         "abs": _m.abs_, "neg": _m.neg, "sign": _m.sign,
         "exp": _m.exp, "log": _m.log, "log2": _m.log2, "log10": _m.log10,
-        "log1p": _m.log1p, "sqrt": _m.sqrt, "rsqrt": _m.rsqrt,
+        "log1p": _m.log1p, "frexp": _misc.frexp, "sqrt": _m.sqrt, "rsqrt": _m.rsqrt,
         "square": _m.square, "reciprocal": _m.reciprocal,
         "sin": _m.sin, "cos": _m.cos, "tan": _m.tan, "tanh": _nn.tanh,
         "asin": _m.asin, "acos": _m.acos, "atan": _m.atan,

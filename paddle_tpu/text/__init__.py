@@ -6,5 +6,29 @@ else deterministic synthetic corpora with the reference's shapes/dtypes
 from .datasets import (Conll05st, Imdb, Imikolov,  # noqa: F401
                        Movielens, UCIHousing, WMT14, WMT16)
 
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """paddle.text.viterbi_decode parity (reference:
+    python/paddle/text/viterbi_decode.py:23 over viterbi_decode_op).
+    Returns (scores [B], paths [B, max(lengths)] int64)."""
+    from ..ops.misc_ops import viterbi_decode as _op
+    return _op(potentials, transition_params, lengths,
+               include_bos_eos_tag=bool(include_bos_eos_tag))
+
+
+class ViterbiDecoder:
+    """paddle.text.ViterbiDecoder parity — callable layer facade."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
 __all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens",
-           "UCIHousing", "WMT14", "WMT16"]
+           "UCIHousing", "WMT14", "WMT16", "viterbi_decode",
+           "ViterbiDecoder"]

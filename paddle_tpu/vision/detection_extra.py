@@ -1,0 +1,573 @@
+"""Detection op long-tail (r4, VERDICT item 6) — the next tier of
+/root/reference/paddle/fluid/operators/detection/ beyond the core 12 in
+vision/ops.py.
+
+Design split, matching the reference's own placement: the differentiable
+tensor math (iou_similarity, sigmoid_focal_loss, box_clip, affine/decode
+transforms, anchor/prior generators) runs as jnp primitives — XLA/MXU
+path with jax autodiff; the inherently sequential/greedy label-assignment
+and NMS-family ops (bipartite_match, mine_hard_examples, matrix_nms,
+FPN distribute/collect) are host numpy, exactly like the reference pins
+them to CPUPlace (e.g. bipartite_match_op.cc GetExpectedKernelType).
+LoD inputs become padded tensors + per-image counts (repo convention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import primitive, raw
+from ..framework.tensor import Tensor
+
+
+# ---------------------------------------------------------------------------
+# differentiable tensor math (jnp primitives)
+
+
+@primitive("iou_similarity_op")
+def _iou_similarity(x, y, *, box_normalized=True):
+    """reference: detection/iou_similarity_op.h — pairwise IoU [N, M]."""
+    off = 0.0 if box_normalized else 1.0
+    ax = (x[:, 2] - x[:, 0] + off) * (x[:, 3] - x[:, 1] + off)   # [N]
+    ay = (y[:, 2] - y[:, 0] + off) * (y[:, 3] - y[:, 1] + off)   # [M]
+    ix1 = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    iy1 = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    ix2 = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    iy2 = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    return inter / (ax[:, None] + ay[None, :] - inter + 1e-10)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    return _iou_similarity(x, y, box_normalized=bool(box_normalized))
+
+
+@primitive("box_clip_op")
+def _box_clip(input, im_info):  # noqa: A002
+    """reference: detection/box_clip_op.h ClipTiledBoxes (bbox_util.h:157)
+    — boxes [N, 4] (or [B, N, 4]), im_info [3] (or [B, 3]) = (h, w, scale);
+    clip to the unscaled image minus the 1-pixel offset."""
+    im_h = jnp.round(im_info[..., 0] / im_info[..., 2])
+    im_w = jnp.round(im_info[..., 1] / im_info[..., 2])
+    if input.ndim == 3:   # [B, N, 4]
+        im_h, im_w = im_h[:, None], im_w[:, None]
+    x1 = jnp.clip(input[..., 0], 0.0, im_w - 1.0)
+    y1 = jnp.clip(input[..., 1], 0.0, im_h - 1.0)
+    x2 = jnp.clip(input[..., 2], 0.0, im_w - 1.0)
+    y2 = jnp.clip(input[..., 3], 0.0, im_h - 1.0)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def box_clip(input, im_info, name=None):  # noqa: A002
+    return _box_clip(input, im_info)
+
+
+@primitive("sigmoid_focal_loss_op")
+def _sigmoid_focal_loss(x, label, fg_num, *, gamma=2.0, alpha=0.25):
+    """reference: detection/sigmoid_focal_loss_op.h — exact port; labels
+    are 1-based (0 = background, -1 = ignore), x [N, C] logits."""
+    N, C = x.shape
+    g = label.reshape(N, 1).astype(jnp.int32)
+    d = jnp.arange(1, C + 1, dtype=jnp.int32)[None, :]
+    c_pos = (g == d).astype(x.dtype)
+    c_neg = ((g != -1) & (g != d)).astype(x.dtype)
+    fg = jnp.maximum(fg_num.reshape(()).astype(x.dtype), 1.0)
+    s_pos = alpha / fg
+    s_neg = (1.0 - alpha) / fg
+    p = jax.nn.sigmoid(x)
+    tiny = jnp.asarray(np.finfo(np.float32).tiny, x.dtype)
+    term_pos = jnp.power(1.0 - p, gamma) * jnp.log(jnp.maximum(p, tiny))
+    # numerically-stable log(1-p) as in the reference kernel
+    term_neg = jnp.power(p, gamma) * (
+        -1.0 * x * (x >= 0) - jnp.log1p(jnp.exp(x - 2.0 * x * (x >= 0))))
+    return -c_pos * term_pos * s_pos - c_neg * term_neg * s_neg
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    return _sigmoid_focal_loss(x, label, fg_num, gamma=float(gamma),
+                               alpha=float(alpha))
+
+
+@primitive("polygon_box_transform_op", nondiff=True)
+def _polygon_box_transform(input):  # noqa: A002
+    """reference: detection/polygon_box_transform_op.cc — geometry-shift
+    channels to absolute coordinates on the 4x-downsampled grid; even
+    channels are x offsets, odd are y."""
+    B, G, H, W = input.shape
+    wpos = 4.0 * jnp.arange(W, dtype=input.dtype)[None, None, None, :]
+    hpos = 4.0 * jnp.arange(H, dtype=input.dtype)[None, None, :, None]
+    even = (jnp.arange(G) % 2 == 0)[None, :, None, None]
+    return jnp.where(even, wpos - input, hpos - input)
+
+
+def polygon_box_transform(input, name=None):  # noqa: A002
+    return _polygon_box_transform(input)
+
+
+@primitive("box_decoder_and_assign_op", nondiff=True)
+def _box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                            *, box_clip=4.135):
+    """reference: detection/box_decoder_and_assign_op.h — per-class decode
+    of [N, C*4] deltas against priors (+1-pixel convention), then assign
+    each roi its best non-background class's box."""
+    N = prior_box.shape[0]
+    C = box_score.shape[1]
+    pw = prior_box[:, 2] - prior_box[:, 0] + 1.0
+    ph = prior_box[:, 3] - prior_box[:, 1] + 1.0
+    pcx = prior_box[:, 0] + pw / 2.0
+    pcy = prior_box[:, 1] + ph / 2.0
+    t = target_box.reshape(N, C, 4)
+    var = prior_box_var.reshape(4)
+    dw = jnp.minimum(var[2] * t[..., 2], box_clip)
+    dh = jnp.minimum(var[3] * t[..., 3], box_clip)
+    cx = var[0] * t[..., 0] * pw[:, None] + pcx[:, None]
+    cy = var[1] * t[..., 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    dec = jnp.stack([cx - w / 2.0, cy - h / 2.0,
+                     cx + w / 2.0 - 1.0, cy + h / 2.0 - 1.0], axis=-1)
+    # best non-background class (j > 0) per roi; fall back to the prior
+    fg_scores = box_score[:, 1:]
+    has_fg = C > 1
+    if has_fg:
+        max_j = jnp.argmax(fg_scores, axis=1) + 1
+        max_s = jnp.max(fg_scores, axis=1)
+        assigned = jnp.take_along_axis(
+            dec, max_j[:, None, None].repeat(4, axis=2), axis=1)[:, 0]
+        assign = jnp.where((max_s > -1)[:, None], assigned, prior_box)
+    else:
+        assign = prior_box
+    return dec.reshape(N, C * 4), assign
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135, name=None):
+    return _box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                                   box_score, box_clip=float(box_clip))
+
+
+@primitive("anchor_generator_op", nondiff=True)
+def _anchor_generator(input, *, anchor_sizes, aspect_ratios, variances,  # noqa: A002
+                      stride, offset=0.5):
+    """reference: detection/anchor_generator_op.h — exact port of the
+    per-cell anchor construction; anchors [H, W, A, 4] + variances."""
+    H, W = input.shape[2], input.shape[3]
+    sw, sh = float(stride[0]), float(stride[1])
+    dt = input.dtype if jnp.issubdtype(input.dtype, jnp.floating) \
+        else jnp.float32
+    xs = jnp.arange(W, dtype=dt) * sw + offset * (sw - 1)   # [W]
+    ys = jnp.arange(H, dtype=dt) * sh + offset * (sh - 1)   # [H]
+    whs = []
+    for ar in aspect_ratios:
+        area = sw * sh
+        base_w = np.round(np.sqrt(area / ar))
+        base_h = np.round(base_w * ar)
+        for size in anchor_sizes:
+            whs.append((size / sw * base_w, size / sh * base_h))
+    wh = jnp.asarray(whs, dt)                               # [A, 2]
+    A = wh.shape[0]
+    xc = jnp.broadcast_to(xs[None, :, None], (H, W, A))
+    yc = jnp.broadcast_to(ys[:, None, None], (H, W, A))
+    aw = jnp.broadcast_to(wh[None, None, :, 0], (H, W, A))
+    ah = jnp.broadcast_to(wh[None, None, :, 1], (H, W, A))
+    anchors = jnp.stack([
+        xc - 0.5 * (aw - 1), yc - 0.5 * (ah - 1),
+        xc + 0.5 * (aw - 1), yc + 0.5 * (ah - 1)], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, dt),
+                           (H, W, wh.shape[0], 4))
+    return anchors, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,  # noqa: A002
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None,
+                     offset=0.5, name=None):
+    return _anchor_generator(
+        input, anchor_sizes=tuple(float(s) for s in anchor_sizes),
+        aspect_ratios=tuple(float(a) for a in aspect_ratios),
+        variances=tuple(float(v) for v in variance),
+        stride=tuple(float(s) for s in stride), offset=float(offset))
+
+
+@primitive("density_prior_box_op", nondiff=True)
+def _density_prior_box(input, image, *, densities, fixed_sizes,  # noqa: A002
+                       fixed_ratios, variances, clip=False,
+                       step_w=0.0, step_h=0.0, offset=0.5):
+    """reference: detection/density_prior_box_op.h — SSD density priors,
+    normalized to the image; exact port of the grid construction."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    dt = jnp.float32
+    sw = iw / fw if step_w == 0 else step_w
+    sh = ih / fh if step_h == 0 else step_h
+    step_avg = int((sw + sh) * 0.5)
+
+    cx = (jnp.arange(fw, dtype=dt) + offset) * sw     # [W]
+    cy = (jnp.arange(fh, dtype=dt) + offset) * sh     # [H]
+    boxes_per_cell = []
+    for size, density in zip(fixed_sizes, densities):
+        shift = step_avg // density
+        for ratio in fixed_ratios:
+            bw = size * float(np.sqrt(ratio))
+            bh = size / float(np.sqrt(ratio))
+            for di in range(density):
+                for dj in range(density):
+                    ox = -step_avg / 2.0 + shift / 2.0 + dj * shift
+                    oy = -step_avg / 2.0 + shift / 2.0 + di * shift
+                    boxes_per_cell.append((ox, oy, bw, bh))
+    off = jnp.asarray(boxes_per_cell, dt)             # [P, 4]
+    P = off.shape[0]
+    cxg = cx[None, :, None]                           # [1, W, 1]
+    cyg = cy[:, None, None]                           # [H, 1, 1]
+    x1 = jnp.maximum((cxg + off[None, None, :, 0] - off[None, None, :, 2]
+                      / 2.0) / iw, 0.0)
+    y1 = jnp.maximum((cyg + off[None, None, :, 1] - off[None, None, :, 3]
+                      / 2.0) / ih, 0.0)
+    x2 = jnp.minimum((cxg + off[None, None, :, 0] + off[None, None, :, 2]
+                      / 2.0) / iw, 1.0)
+    y2 = jnp.minimum((cyg + off[None, None, :, 1] + off[None, None, :, 3]
+                      / 2.0) / ih, 1.0)
+    boxes = jnp.stack([jnp.broadcast_to(x1, (fh, fw, P)),
+                       jnp.broadcast_to(y1, (fh, fw, P)),
+                       jnp.broadcast_to(x2, (fh, fw, P)),
+                       jnp.broadcast_to(y2, (fh, fw, P))], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, dt), (fh, fw, P, 4))
+    return boxes, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,  # noqa: A002
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    boxes, var = _density_prior_box(
+        input, image, densities=tuple(int(d) for d in densities),
+        fixed_sizes=tuple(float(s) for s in fixed_sizes),
+        fixed_ratios=tuple(float(r) for r in fixed_ratios),
+        variances=tuple(float(v) for v in variance), clip=bool(clip),
+        step_w=float(steps[0]), step_h=float(steps[1]),
+        offset=float(offset))
+    if flatten_to_2d:
+        n = int(np.prod(boxes.shape[:-1]))
+        boxes = boxes.reshape([n, 4])
+        var = var.reshape([n, 4])
+    return boxes, var
+
+
+# ---------------------------------------------------------------------------
+# host-side greedy/assignment ops (numpy — reference pins these to CPU)
+
+
+def _np_jaccard(a, b, normalized):
+    off = 0.0 if normalized else 1.0
+    iw = min(a[2], b[2]) - max(a[0], b[0]) + off
+    ih = min(a[3], b[3]) - max(a[1], b[1]) + off
+    if iw <= 0 or ih <= 0:
+        return 0.0
+    inter = iw * ih
+    ua = ((a[2] - a[0] + off) * (a[3] - a[1] + off)
+          + (b[2] - b[0] + off) * (b[3] - b[1] + off) - inter)
+    return inter / ua
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """reference: detection/bipartite_match_op.cc greedy global matcher
+    (non-LoD single-instance form). Returns (match_indices [1, M] int32,
+    match_dist [1, M] f32)."""
+    dist = np.asarray(raw(dist_matrix))
+    R, M = dist.shape
+    match_indices = np.full((M,), -1, np.int32)
+    match_dist = np.zeros((M,), np.float32)
+    row_used = np.zeros((R,), bool)
+    eps = 1e-6
+    while True:
+        best = (-1, -1, -1.0)
+        for j in range(M):
+            if match_indices[j] != -1:
+                continue
+            for i in range(R):
+                if row_used[i] or dist[i, j] < eps:
+                    continue
+                if dist[i, j] > best[2]:
+                    best = (i, j, dist[i, j])
+        if best[0] < 0:
+            break
+        match_indices[best[1]] = best[0]
+        match_dist[best[1]] = best[2]
+        row_used[best[0]] = True
+    if match_type == "per_prediction":
+        thr = 0.5 if dist_threshold is None else float(dist_threshold)
+        for j in range(M):
+            if match_indices[j] == -1:
+                i = int(np.argmax(dist[:, j]))
+                if dist[i, j] >= thr:
+                    match_indices[j] = i
+                    match_dist[j] = dist[i, j]
+    return (Tensor(match_indices[None, :], _internal=True),
+            Tensor(match_dist[None, :], _internal=True))
+
+
+def target_assign(input, matched_indices, mismatch_value=0,  # noqa: A002
+                  negative_indices=None, name=None):
+    """reference: detection/target_assign_op.h (padded form): input
+    [B, P, K] per-image entity targets, matched_indices [B, M] int32 →
+    (out [B, M, K], out_weight [B, M, 1])."""
+    inp = np.asarray(raw(input))
+    mi = np.asarray(raw(matched_indices))
+    B, M = mi.shape
+    K = inp.shape[-1]
+    out = np.full((B, M, K), mismatch_value, inp.dtype)
+    wt = np.zeros((B, M, 1), np.float32)
+    for b in range(B):
+        pos = mi[b] > -1
+        out[b, pos] = inp[b, mi[b, pos]]
+        wt[b, pos] = 1.0
+    if negative_indices is not None:
+        neg = np.asarray(raw(negative_indices))
+        for b in range(B):
+            for j in neg[b]:
+                if j >= 0:
+                    out[b, j] = mismatch_value
+                    wt[b, j] = 1.0
+    return Tensor(out, _internal=True), Tensor(wt, _internal=True)
+
+
+def mine_hard_examples(cls_loss, loc_loss=None, match_indices=None,
+                       match_dist=None, neg_pos_ratio=3.0,
+                       neg_dist_threshold=0.5, sample_size=None,
+                       mining_type="max_negative", name=None):
+    """reference: detection/mine_hard_examples_op.cc — OHEM. Returns
+    (updated_match_indices [B, P] int32, neg_indices [B, P] padded with -1,
+    neg_count [B])."""
+    cl = np.asarray(raw(cls_loss))
+    ll = None if loc_loss is None else np.asarray(raw(loc_loss))
+    mi = np.asarray(raw(match_indices)).copy()
+    md = np.asarray(raw(match_dist))
+    B, P = mi.shape
+    neg_out = np.full((B, P), -1, np.int32)
+    neg_cnt = np.zeros((B,), np.int32)
+    for n in range(B):
+        cand = []
+        for m in range(P):
+            if mining_type == "max_negative":
+                ok = mi[n, m] == -1 and md[n, m] < neg_dist_threshold
+            else:  # hard_example
+                ok = True
+            if ok:
+                loss = cl[n, m]
+                if mining_type == "hard_example" and ll is not None:
+                    loss = cl[n, m] + ll[n, m]
+                cand.append((loss, m))
+        neg_sel = len(cand)
+        if mining_type == "max_negative":
+            num_pos = int((mi[n] != -1).sum())
+            neg_sel = min(int(num_pos * neg_pos_ratio), neg_sel)
+        elif sample_size is not None:
+            neg_sel = min(int(sample_size), neg_sel)
+        cand.sort(key=lambda t: -t[0])
+        sel = {m for _, m in cand[:neg_sel]}
+        if mining_type == "hard_example":
+            negs = []
+            for m in range(P):
+                if mi[n, m] > -1:
+                    if m not in sel:
+                        mi[n, m] = -1
+                else:
+                    if m in sel:
+                        negs.append(m)
+        else:
+            negs = sorted(sel)
+        neg_out[n, :len(negs)] = negs
+        neg_cnt[n] = len(negs)
+    return (Tensor(mi, _internal=True), Tensor(neg_out, _internal=True),
+            Tensor(neg_cnt, _internal=True))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """reference: detection/matrix_nms_op.cc — parallel soft-NMS with
+    matrix IoU decay. bboxes [B, M, 4], scores [B, C, M]; returns
+    (out [R, 6] = (label, decayed_score, x1, y1, x2, y2), rois_num [B],
+    index [R, 1] optional)."""
+    bb = np.asarray(raw(bboxes))
+    sc = np.asarray(raw(scores))
+    B, C, M = sc.shape
+    all_out, all_idx, nums = [], [], []
+    for b in range(B):
+        dets, idxs = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[b, c]
+            perm = [i for i in range(M) if s[i] > score_threshold]
+            perm.sort(key=lambda i: -s[i])
+            if nms_top_k > -1:
+                perm = perm[:nms_top_k]
+            if not perm:
+                continue
+            iou_max = [0.0]
+            ious = {}
+            for i in range(1, len(perm)):
+                mx = 0.0
+                for j in range(i):
+                    iou = _np_jaccard(bb[b, perm[i]], bb[b, perm[j]],
+                                      normalized)
+                    ious[(i, j)] = iou
+                    mx = max(mx, iou)
+                iou_max.append(mx)
+            if s[perm[0]] > post_threshold:
+                dets.append((c, s[perm[0]], *bb[b, perm[0]]))
+                idxs.append(b * M + perm[0])
+            for i in range(1, len(perm)):
+                min_decay = 1.0
+                for j in range(i):
+                    iou, mx = ious[(i, j)], iou_max[j]
+                    if use_gaussian:
+                        decay = np.exp((mx * mx - iou * iou)
+                                       * gaussian_sigma)
+                    else:
+                        decay = (1.0 - iou) / (1.0 - mx) if mx < 1 else 0.0
+                    min_decay = min(min_decay, decay)
+                ds = min_decay * s[perm[i]]
+                if ds <= post_threshold:
+                    continue
+                dets.append((c, ds, *bb[b, perm[i]]))
+                idxs.append(b * M + perm[i])
+        order = sorted(range(len(dets)), key=lambda k: -dets[k][1])
+        if keep_top_k > -1:
+            order = order[:keep_top_k]
+        all_out.extend(dets[k] for k in order)
+        all_idx.extend(idxs[k] for k in order)
+        nums.append(len(order))
+    out = (np.asarray(all_out, np.float32) if all_out
+           else np.zeros((0, 6), np.float32))
+    res = [Tensor(out, _internal=True)]
+    if return_rois_num:
+        res.append(Tensor(np.asarray(nums, np.int32), _internal=True))
+    if return_index:
+        res.append(Tensor(np.asarray(all_idx, np.int32).reshape(-1, 1),
+                          _internal=True))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """reference: detection/distribute_fpn_proposals_op.h — route each roi
+    to level clip(refer_level + log2(sqrt(area)/refer_scale)). Returns
+    (multi_rois list, restore_index [N, 1], per-level counts list when
+    rois_num given)."""
+    rois = np.asarray(raw(fpn_rois))
+    N = rois.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    area = np.where((w < 0) | (h < 0), 0.0, (w + off) * (h + off))
+    scale = np.sqrt(area)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    num_level = max_level - min_level + 1
+    multi = []
+    counts = []
+    order = []
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == L)[0]
+        multi.append(Tensor(rois[sel], _internal=True))
+        counts.append(len(sel))
+        order.extend(sel.tolist())
+    restore = np.empty((N, 1), np.int32)
+    for new_pos, orig in enumerate(order):
+        restore[orig, 0] = new_pos
+    restore_t = Tensor(restore, _internal=True)
+    if rois_num is not None:
+        nums = [Tensor(np.asarray([c], np.int32), _internal=True)
+                for c in counts]
+        return multi, restore_t, nums
+    return multi, restore_t
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """reference: detection/collect_fpn_proposals_op.h — concat all
+    levels, keep the post_nms_top_n highest-scoring rois, returned
+    score-descending (the reference's stable score sort followed by a
+    batch-id sort leaves score order within each image; single-image
+    padded form here)."""
+    rois = np.concatenate([np.asarray(raw(r)) for r in multi_rois], axis=0)
+    scores = np.concatenate(
+        [np.asarray(raw(s)).reshape(-1) for s in multi_scores], axis=0)
+    keep = np.argsort(-scores, kind="stable")[:int(post_nms_top_n)]
+    out = Tensor(rois[keep], _internal=True)
+    if rois_num_per_level is not None:
+        return out, Tensor(np.asarray([len(keep)], np.int32),
+                           _internal=True)
+    return out
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0, name=None):
+    """reference: detection/retinanet_detection_output_op.cc — per-level
+    threshold + top-k, decode against anchors (decode_center_size with
+    the +1-pixel convention), clip to image, then multiclass NMS.
+    Single-image padded form: bboxes/scores/anchors are lists per level.
+    Returns [R, 6] = (label, score, x1, y1, x2, y2)."""
+    from .ops import multiclass_nms
+    im = np.asarray(raw(im_info)).reshape(-1)
+    all_boxes, all_scores, all_labels = [], [], []
+    for bb_t, sc_t, an_t in zip(bboxes, scores, anchors):
+        bb = np.asarray(raw(bb_t))      # [A, 4] deltas
+        sc = np.asarray(raw(sc_t))      # [A, C] sigmoid scores
+        an = np.asarray(raw(an_t)).reshape(-1, 4)
+        A, C = sc.shape
+        flat = sc.reshape(-1)
+        sel = np.nonzero(flat > score_threshold)[0]
+        if len(sel) > nms_top_k:
+            sel = sel[np.argsort(-flat[sel], kind="stable")[:nms_top_k]]
+        a_idx = sel // C
+        cls = sel % C
+        aw = an[a_idx, 2] - an[a_idx, 0] + 1.0
+        ah = an[a_idx, 3] - an[a_idx, 1] + 1.0
+        acx = an[a_idx, 0] + aw / 2.0
+        acy = an[a_idx, 1] + ah / 2.0
+        d = bb[a_idx]
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w = np.exp(d[:, 2]) * aw
+        h = np.exp(d[:, 3]) * ah
+        # map back to the ORIGINAL (unscaled) image before clipping, as
+        # the reference kernel does (pred / im_scale, clip to dim/scale-1)
+        s = im[2]
+        x1 = np.clip((cx - w / 2.0) / s, 0, im[1] / s - 1)
+        y1 = np.clip((cy - h / 2.0) / s, 0, im[0] / s - 1)
+        x2 = np.clip((cx + w / 2.0 - 1) / s, 0, im[1] / s - 1)
+        y2 = np.clip((cy + h / 2.0 - 1) / s, 0, im[0] / s - 1)
+        all_boxes.append(np.stack([x1, y1, x2, y2], -1))
+        all_scores.append(flat[sel])
+        all_labels.append(cls)
+    boxes = np.concatenate(all_boxes, 0)
+    scs = np.concatenate(all_scores, 0)
+    lbl = np.concatenate(all_labels, 0)
+    # multiclass NMS over the merged candidates: [1, M, 4] + [1, C, M]
+    C = max(int(lbl.max()) + 1, 1) if len(lbl) else 1
+    M = len(boxes)
+    if M == 0:
+        return Tensor(np.zeros((0, 6), np.float32), _internal=True)
+    sc_mat = np.zeros((1, C + 1, M), np.float32)
+    sc_mat[0, lbl + 1, np.arange(M)] = scs
+    out, _ = multiclass_nms(
+        Tensor(boxes[None], _internal=True),
+        Tensor(sc_mat, _internal=True),
+        score_threshold=score_threshold, nms_top_k=-1,
+        keep_top_k=int(keep_top_k), nms_threshold=float(nms_threshold),
+        nms_eta=float(nms_eta), background_label=0, normalized=False,
+        return_index=False)
+    return out

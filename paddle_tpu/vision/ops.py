@@ -16,7 +16,23 @@ __all__ = ["yolo_box", "yolo_loss", "roi_align", "roi_pool", "RoIPool",
            "psroi_pool", "PSRoIPool", "read_file", "decode_jpeg",
            "nms", "deform_conv2d", "RoIAlign",
            "DeformConv2D", "prior_box", "box_coder", "multiclass_nms",
-           "generate_proposals"]
+           "generate_proposals",
+           # r4 detection long-tail (detection_extra.py)
+           "iou_similarity", "box_clip", "sigmoid_focal_loss",
+           "bipartite_match", "target_assign", "mine_hard_examples",
+           "matrix_nms", "anchor_generator", "density_prior_box",
+           "distribute_fpn_proposals", "collect_fpn_proposals",
+           "polygon_box_transform", "box_decoder_and_assign",
+           "retinanet_detection_output"]
+
+from .detection_extra import (anchor_generator, bipartite_match,  # noqa: E402,F401
+                              box_clip, box_decoder_and_assign,
+                              collect_fpn_proposals, density_prior_box,
+                              distribute_fpn_proposals, iou_similarity,
+                              matrix_nms, mine_hard_examples,
+                              polygon_box_transform,
+                              retinanet_detection_output,
+                              sigmoid_focal_loss, target_assign)
 
 
 @primitive("roi_align", dynamic=True)

@@ -1,0 +1,313 @@
+"""Detection op long-tail (r4): iou_similarity, box_clip,
+sigmoid_focal_loss, bipartite_match, target_assign, mine_hard_examples,
+matrix_nms, anchor_generator, density_prior_box, distribute/collect FPN
+proposals, polygon_box_transform, box_decoder_and_assign,
+retinanet_detection_output. Oracles: reference numpy test oracles
+(test_anchor_generator_op.py) and hand-verified cases of the reference
+kernels."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestIouSimilarity:
+    def test_values_and_normalized(self):
+        x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+        y = np.array([[0, 0, 2, 2], [10, 10, 11, 11]], np.float32)
+        out = V.iou_similarity(T(x), T(y), box_normalized=True)
+        # IoU(x0,y0)=1; IoU(x1,y0): inter 1, union 4+4-1=7
+        np.testing.assert_allclose(out.numpy(),
+                                   [[1.0, 0.0], [1 / 7, 0.0]], atol=1e-6)
+        # pixel convention (+1): areas 9, inter 2x2=4 -> 4/(9+9-4)
+        out = V.iou_similarity(T(x), T(x[:1]), box_normalized=False)
+        np.testing.assert_allclose(out.numpy()[0], [1.0], atol=1e-6)
+        np.testing.assert_allclose(out.numpy()[1], [4 / 14], atol=1e-6)
+
+
+class TestBoxClip:
+    def test_clip_and_scale(self):
+        boxes = np.array([[-2, -3, 9, 4], [1, 1, 2, 2]], np.float32)
+        im = np.array([6.0, 8.0, 1.0], np.float32)  # h=6, w=8
+        out = V.box_clip(T(boxes), T(im))
+        np.testing.assert_allclose(out.numpy(),
+                                   [[0, 0, 7, 4], [1, 1, 2, 2]])
+        # scale=2 -> effective image 3x4
+        im2 = np.array([6.0, 8.0, 2.0], np.float32)
+        out = V.box_clip(T(boxes), T(im2))
+        np.testing.assert_allclose(out.numpy(),
+                                   [[0, 0, 3, 2], [1, 1, 2, 2]])
+
+
+class TestSigmoidFocalLoss:
+    def _oracle(self, x, label, fg, gamma, alpha):
+        N, C = x.shape
+        out = np.zeros_like(x)
+        fgn = max(fg, 1)
+        for i in range(N):
+            for d in range(C):
+                g = label[i, 0]
+                c_pos = float(g == d + 1)
+                c_neg = float((g != -1) and (g != d + 1))
+                p = 1 / (1 + np.exp(-x[i, d]))
+                term_pos = (1 - p) ** gamma * np.log(max(p, 1e-38))
+                xx = x[i, d]
+                term_neg = p ** gamma * (
+                    -xx * (xx >= 0) - np.log1p(np.exp(xx - 2 * xx * (xx >= 0))))
+                out[i, d] = (-c_pos * term_pos * alpha / fgn
+                             - c_neg * term_neg * (1 - alpha) / fgn)
+        return out
+
+    def test_vs_kernel_oracle_and_grad(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(5, 4).astype(np.float32)
+        label = np.array([[1], [4], [0], [-1], [2]], np.int32)
+        fg = np.array([3], np.int32)
+        xt = T(x)
+        xt.stop_gradient = False
+        out = V.sigmoid_focal_loss(xt, T(label), T(fg), gamma=2.0,
+                                   alpha=0.25)
+        want = self._oracle(x, label, 3, 2.0, 0.25)
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-6)
+        out.sum().backward()
+        assert np.isfinite(xt.grad.numpy()).all()
+
+
+class TestBipartiteMatch:
+    def test_greedy_global(self):
+        dist = np.array([[0.1, 0.9, 0.3],
+                         [0.8, 0.2, 0.2]], np.float32)
+        idx, d = V.bipartite_match(T(dist))
+        # global greedy: (0,1)=0.9 first, then (1,0)=0.8; col 2 unmatched
+        np.testing.assert_array_equal(idx.numpy(), [[1, 0, -1]])
+        np.testing.assert_allclose(d.numpy(), [[0.8, 0.9, 0.0]])
+
+    def test_per_prediction(self):
+        dist = np.array([[0.1, 0.9, 0.6],
+                         [0.8, 0.2, 0.2]], np.float32)
+        idx, d = V.bipartite_match(T(dist), match_type="per_prediction",
+                                   dist_threshold=0.5)
+        # col 2 now matched to argmax row 0 (0.6 >= 0.5)
+        np.testing.assert_array_equal(idx.numpy(), [[1, 0, 0]])
+        np.testing.assert_allclose(d.numpy(), [[0.8, 0.9, 0.6]])
+
+
+class TestTargetAssign:
+    def test_assign_and_weights(self):
+        inp = np.arange(2 * 3 * 4, dtype=np.float32).reshape(2, 3, 4)
+        mi = np.array([[2, -1], [0, 1]], np.int32)
+        out, wt = V.target_assign(T(inp), T(mi), mismatch_value=7)
+        np.testing.assert_allclose(out.numpy()[0, 0], inp[0, 2])
+        np.testing.assert_allclose(out.numpy()[0, 1], [7] * 4)
+        np.testing.assert_allclose(out.numpy()[1, 0], inp[1, 0])
+        np.testing.assert_allclose(wt.numpy()[:, :, 0],
+                                   [[1, 0], [1, 1]])
+
+    def test_negative_indices(self):
+        inp = np.ones((1, 2, 1), np.float32)
+        mi = np.array([[-1, 0, -1]], np.int32)
+        neg = np.array([[0, 2]], np.int32)
+        out, wt = V.target_assign(T(inp), T(mi), mismatch_value=0,
+                                  negative_indices=T(neg))
+        np.testing.assert_allclose(wt.numpy()[0, :, 0], [1, 1, 1])
+        np.testing.assert_allclose(out.numpy()[0, :, 0], [0, 1, 0])
+
+
+class TestMineHardExamples:
+    def test_max_negative(self):
+        cls_loss = np.array([[5.0, 1.0, 3.0, 2.0]], np.float32)
+        mi = np.array([[0, -1, -1, -1]], np.int32)
+        md = np.array([[0.9, 0.1, 0.2, 0.8]], np.float32)
+        upd, neg, cnt = V.mine_hard_examples(
+            T(cls_loss), match_indices=T(mi), match_dist=T(md),
+            neg_pos_ratio=2.0, neg_dist_threshold=0.5)
+        # eligible negatives: cols 1,2 (dist<0.5, unmatched); 1 pos * 2 = 2
+        # hardest by cls_loss: col 2 (3.0), col 1 (1.0)
+        np.testing.assert_array_equal(cnt.numpy(), [2])
+        np.testing.assert_array_equal(sorted(neg.numpy()[0, :2]), [1, 2])
+        np.testing.assert_array_equal(upd.numpy(), mi)
+
+
+class TestMatrixNMS:
+    def test_decay_keeps_separated_boxes(self):
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10, 10],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        out, nums = V.matrix_nms(T(boxes), T(scores), score_threshold=0.1,
+                                 post_threshold=0.1, nms_top_k=-1,
+                                 keep_top_k=-1, background_label=0)
+        o = out.numpy()
+        assert nums.numpy().tolist() == [o.shape[0]]
+        # top box kept at full score; far box barely decayed
+        np.testing.assert_allclose(o[0, 1], 0.9, atol=1e-6)
+        far = o[np.isclose(o[:, 2], 20.0)]
+        np.testing.assert_allclose(far[0, 1], 0.7, atol=1e-3)
+        # heavily-overlapped second box decayed below its raw score
+        mid = o[np.isclose(o[:, 2], 0.5)]
+        assert mid.size == 0 or mid[0, 1] < 0.8
+
+    def test_gaussian_and_index(self):
+        boxes = np.array([[[0, 0, 4, 4], [0, 0, 4, 4]]], np.float32)
+        scores = np.zeros((1, 2, 2), np.float32)
+        scores[0, 1] = [0.9, 0.5]
+        out, nums, idx = V.matrix_nms(
+            T(boxes), T(scores), score_threshold=0.1, post_threshold=0.0,
+            nms_top_k=-1, keep_top_k=-1, use_gaussian=True,
+            gaussian_sigma=2.0, background_label=0, return_index=True)
+        # identical boxes: decay = exp((max_iou^2 - iou^2)*sigma) with
+        # iou=1, max_iou(prev)=0 -> second score = 0.5*exp(-2)
+        o = out.numpy()
+        np.testing.assert_allclose(sorted(o[:, 1])[-1], 0.9, atol=1e-6)
+        np.testing.assert_allclose(sorted(o[:, 1])[0],
+                                   0.5 * np.exp(-2.0), rtol=1e-5)
+        assert idx.numpy().shape == (2, 1)
+
+
+class TestAnchorGenerator:
+    def test_vs_reference_oracle(self):
+        # oracle: reference test_anchor_generator_op.py
+        def oracle(feat, anchor_sizes, aspect_ratios, variances, stride,
+                   offset):
+            H, W = feat.shape[2], feat.shape[3]
+            A = len(aspect_ratios) * len(anchor_sizes)
+            out = np.zeros((H, W, A, 4), np.float32)
+            for h in range(H):
+                for w in range(W):
+                    x_ctr = w * stride[0] + offset * (stride[0] - 1)
+                    y_ctr = h * stride[1] + offset * (stride[1] - 1)
+                    idx = 0
+                    for ar in aspect_ratios:
+                        for size in anchor_sizes:
+                            area = stride[0] * stride[1]
+                            base_w = np.round(np.sqrt(area / ar))
+                            base_h = np.round(base_w * ar)
+                            bw = size / stride[0] * base_w
+                            bh = size / stride[1] * base_h
+                            out[h, w, idx] = [x_ctr - 0.5 * (bw - 1),
+                                              y_ctr - 0.5 * (bh - 1),
+                                              x_ctr + 0.5 * (bw - 1),
+                                              y_ctr + 0.5 * (bh - 1)]
+                            idx += 1
+            var = np.tile(variances, (H, W, A, 1)).astype(np.float32)
+            return out, var
+
+        feat = np.zeros((1, 8, 3, 5), np.float32)
+        args = dict(anchor_sizes=[64.0, 128.0], aspect_ratios=[0.5, 1.0],
+                    variance=[0.1, 0.1, 0.2, 0.2], stride=[16.0, 16.0],
+                    offset=0.5)
+        anchors, var = V.anchor_generator(T(feat), **args)
+        want_a, want_v = oracle(feat, args["anchor_sizes"],
+                                args["aspect_ratios"], args["variance"],
+                                args["stride"], args["offset"])
+        np.testing.assert_allclose(anchors.numpy(), want_a, rtol=1e-5)
+        np.testing.assert_allclose(var.numpy(), want_v, rtol=1e-6)
+
+
+class TestDensityPriorBox:
+    def test_shapes_and_bounds(self):
+        feat = np.zeros((1, 2, 4, 4), np.float32)
+        img = np.zeros((1, 3, 32, 32), np.float32)
+        boxes, var = V.density_prior_box(
+            T(feat), T(img), densities=[2, 1], fixed_sizes=[4.0, 8.0],
+            fixed_ratios=[1.0], clip=True)
+        P = 1 * (2 * 2) + 1 * (1 * 1)
+        assert boxes.shape == [4, 4, P, 4]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+        assert (b[..., 2] >= b[..., 0]).all()
+        # flatten_to_2d
+        b2, v2 = V.density_prior_box(
+            T(feat), T(img), densities=[2], fixed_sizes=[4.0],
+            fixed_ratios=[1.0], flatten_to_2d=True)
+        assert b2.shape == [4 * 4 * 4, 4] and v2.shape == [4 * 4 * 4, 4]
+
+
+class TestFpnProposals:
+    def test_distribute_and_restore(self):
+        rois = np.array([[0, 0, 16, 16],      # sqrt(area)=16 -> low level
+                         [0, 0, 224, 224],    # refer scale
+                         [0, 0, 500, 500]], np.float32)
+        multi, restore = V.distribute_fpn_proposals(
+            T(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        sizes = [m.shape[0] for m in multi]
+        assert sum(sizes) == 3
+        # restore index maps original rois to their position in concat
+        cat = np.concatenate([m.numpy() for m in multi if m.shape[0]], 0)
+        r = restore.numpy()[:, 0]
+        np.testing.assert_allclose(cat[r], rois)
+
+    def test_collect_top_n(self):
+        r1 = np.array([[0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
+        r2 = np.array([[4, 4, 5, 5]], np.float32)
+        s1 = np.array([0.9, 0.2], np.float32)
+        s2 = np.array([0.8], np.float32)
+        out = V.collect_fpn_proposals([T(r1), T(r2)], [T(s1), T(s2)],
+                                      min_level=2, max_level=3,
+                                      post_nms_top_n=2)
+        np.testing.assert_allclose(out.numpy(),
+                                   [[0, 0, 1, 1], [4, 4, 5, 5]])
+
+
+class TestPolygonBoxTransform:
+    def test_formula(self):
+        x = np.zeros((1, 2, 2, 3), np.float32)
+        out = V.polygon_box_transform(T(x)).numpy()
+        # even channel: 4*w - 0 ; odd channel: 4*h - 0
+        np.testing.assert_allclose(out[0, 0], [[0, 4, 8], [0, 4, 8]])
+        np.testing.assert_allclose(out[0, 1], [[0, 0, 0], [4, 4, 4]])
+
+
+class TestBoxDecoderAndAssign:
+    def test_decode_and_assign(self):
+        prior = np.array([[0, 0, 9, 9]], np.float32)      # w=h=10
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        target = np.zeros((1, 8), np.float32)             # 2 classes
+        score = np.array([[0.2, 0.8]], np.float32)
+        dec, assign = V.box_decoder_and_assign(T(prior), T(var), T(target),
+                                               T(score))
+        # zero deltas decode back to the prior box
+        np.testing.assert_allclose(dec.numpy().reshape(2, 4)[1],
+                                   [0, 0, 9, 9], atol=1e-5)
+        np.testing.assert_allclose(assign.numpy()[0], [0, 0, 9, 9],
+                                   atol=1e-5)
+
+
+class TestRetinanetDetectionOutput:
+    def test_smoke_and_ordering(self):
+        rs = np.random.RandomState(0)
+        anchors = np.array([[0, 0, 15, 15], [8, 8, 23, 23],
+                            [16, 16, 31, 31]], np.float32)
+        deltas = (rs.randn(3, 4) * 0.1).astype(np.float32)
+        scores = rs.rand(3, 2).astype(np.float32)
+        im_info = np.array([64.0, 64.0, 1.0], np.float32)
+        out = V.retinanet_detection_output(
+            [T(deltas)], [T(scores)], [T(anchors)], T(im_info),
+            score_threshold=0.05, nms_top_k=10, keep_top_k=5,
+            nms_threshold=0.3)
+        o = out.numpy()
+        assert o.shape[1] == 6 and o.shape[0] <= 5
+        assert (o[:, 1] >= 0).all() and (o[:, 0] >= 1).all()
+        assert (o[:, 2:] >= 0).all()
+
+    def test_im_scale_unscales_boxes(self):
+        """Decoded boxes map back to the ORIGINAL image: with scale=2 the
+        coordinates halve and clip to dim/scale - 1 (reference kernel
+        divides predictions by im_scale before clipping)."""
+        anchors = np.array([[0, 0, 31, 31]], np.float32)
+        deltas = np.zeros((1, 4), np.float32)
+        scores = np.array([[0.9]], np.float32)
+        out1 = V.retinanet_detection_output(
+            [T(deltas)], [T(scores)], [T(anchors)],
+            T(np.array([64.0, 64.0, 1.0], np.float32)))
+        out2 = V.retinanet_detection_output(
+            [T(deltas)], [T(scores)], [T(anchors)],
+            T(np.array([64.0, 64.0, 2.0], np.float32)))
+        np.testing.assert_allclose(out2.numpy()[0, 2:],
+                                   out1.numpy()[0, 2:] / 2.0, atol=1e-5)

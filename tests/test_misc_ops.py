@@ -1,0 +1,202 @@
+"""Long-tail op sweep: edit_distance, viterbi_decode, affine_channel,
+ctc_align, frexp (r4, VERDICT item 6). Oracles: ports of the reference
+numpy test oracles (test_viterbi_decode_op.py Decoder,
+test_affine_channel_op.py affine_channel) and the reference docstring
+examples."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestFrexp:
+    def test_vs_numpy(self):
+        x = np.array([4.0, 0.5, -3.5, 0.0, 1e-8], np.float32)
+        m, e = paddle.frexp(paddle.to_tensor(x))
+        mn, en = np.frexp(x)
+        np.testing.assert_allclose(m.numpy(), mn, rtol=1e-6)
+        np.testing.assert_allclose(e.numpy(), en.astype(np.float32))
+
+    def test_roundtrip_and_method(self):
+        x = paddle.to_tensor(np.array([[3.75, -0.1]], np.float32))
+        m, e = x.frexp()
+        np.testing.assert_allclose((m * (2.0 ** e)).numpy(), x.numpy(),
+                                   rtol=1e-6)
+
+
+class TestAffineChannel:
+    @pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+    def test_forward_and_grad(self, layout):
+        rs = np.random.RandomState(0)
+        C = 3
+        xv = rs.randn(2, C, 4, 5).astype(np.float32) if layout == "NCHW" \
+            else rs.randn(2, 4, 5, C).astype(np.float32)
+        sv = rs.rand(C).astype(np.float32) + 0.5
+        bv = rs.randn(C).astype(np.float32)
+        import paddle_tpu.fluid as fluid
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        s = paddle.to_tensor(sv, stop_gradient=False)
+        b = paddle.to_tensor(bv, stop_gradient=False)
+        out = fluid.layers.affine_channel(x, s, b, data_layout=layout)
+        # oracle: reference test_affine_channel_op.py
+        shape = (1, C, 1, 1) if layout == "NCHW" else (1, 1, 1, C)
+        want = xv * sv.reshape(shape) + bv.reshape(shape)
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5)
+        out.sum().backward()
+        np.testing.assert_allclose(s.grad.numpy(),
+                                   xv.sum(tuple(i for i in range(4)
+                                                if shape[i] == 1)),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(b.grad.numpy(),
+                                   np.full((C,), xv.size / C, np.float32))
+
+    def test_2d(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        s = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        b = paddle.to_tensor(np.array([0.5, 0.0, -1.0], np.float32))
+        import paddle_tpu.fluid as fluid
+        out = fluid.layers.affine_channel(x, s, b)
+        np.testing.assert_allclose(out.numpy(),
+                                   [[1.5, 2.0, 2.0]] * 2, rtol=1e-6)
+
+
+class TestEditDistance:
+    def test_reference_docstring_example(self):
+        inp = paddle.to_tensor(np.array(
+            [[1, 2, 3], [4, 5, 6], [4, 4, 4], [1, 1, 1]], np.int64))
+        lab = paddle.to_tensor(np.array(
+            [[1, 3, 4, 1], [4, 5, 8, 1], [7, 7, 7, 1], [1, 1, 1, 1]],
+            np.int64))
+        il = paddle.to_tensor(np.array([3, 3, 3, 3], np.int64))
+        ll = paddle.to_tensor(np.array([4, 4, 4, 4], np.int64))
+        d, n = F.edit_distance(inp, lab, normalized=False,
+                               input_length=il, label_length=ll)
+        np.testing.assert_allclose(d.numpy(), [[3.], [2.], [4.], [1.]])
+        np.testing.assert_allclose(n.numpy(), [4.0])
+        d, _ = F.edit_distance(inp, lab, normalized=True,
+                               input_length=il, label_length=ll)
+        np.testing.assert_allclose(d.numpy(), [[0.75], [0.5], [1.], [0.25]])
+
+    def test_ignored_tokens_and_lengths(self):
+        inp = paddle.to_tensor(np.array([[1, 9, 2, 0]], np.int64))
+        lab = paddle.to_tensor(np.array([[1, 2, 9, 9]], np.int64))
+        d, _ = F.edit_distance(inp, lab, normalized=False,
+                               ignored_tokens=[9],
+                               input_length=paddle.to_tensor(
+                                   np.array([3], np.int64)),
+                               label_length=paddle.to_tensor(
+                                   np.array([2], np.int64)))
+        # hyp [1,2] vs ref [1,2] -> 0
+        np.testing.assert_allclose(d.numpy(), [[0.0]])
+
+
+class TestCtcAlign:
+    def test_reference_docstring_case(self):
+        # reference ctc_align_op.cc padded example: blank=0, merge=True
+        x = paddle.to_tensor(np.array(
+            [[0, 1, 1, 2, 0, 4, 0], [0, 4, 5, 0, 6, 6, 0]], np.int64))
+        lens = paddle.to_tensor(np.array([[7], [7]], np.int64))
+        out, ol = F.ctc_align(x, lens, blank=0, merge_repeated=True,
+                              padding_value=0)
+        # adjacent repeats merge even across rows' blanks: row 2's "6 6"
+        # collapses (ctc_align_op.h: prev_token tracks every input step)
+        np.testing.assert_array_equal(out.numpy()[:, :4],
+                                      [[1, 2, 4, 0], [4, 5, 6, 0]])
+        np.testing.assert_array_equal(ol.numpy(), [[3], [3]])
+
+    def test_no_merge_and_padding(self):
+        x = paddle.to_tensor(np.array([[2, 2, 0, 3]], np.int64))
+        lens = paddle.to_tensor(np.array([[4]], np.int64))
+        out, ol = F.ctc_align(x, lens, blank=0, merge_repeated=False,
+                              padding_value=-1)
+        np.testing.assert_array_equal(out.numpy(), [[2, 2, 3, -1]])
+        np.testing.assert_array_equal(ol.numpy(), [[3]])
+
+    def test_greedy_decoder(self):
+        probs = np.zeros((1, 4, 3), np.float32)
+        probs[0, :, :] = [[0.1, 0.8, 0.1], [0.1, 0.8, 0.1],
+                          [0.9, 0.05, 0.05], [0.1, 0.1, 0.8]]
+        out, ol = F.ctc_greedy_decoder(paddle.to_tensor(probs), blank=0)
+        np.testing.assert_array_equal(out.numpy()[0, :2], [1, 2])
+        np.testing.assert_array_equal(ol.numpy(), [[2]])
+
+
+class _RefDecoder:
+    """Port of the reference numpy oracle
+    (test_viterbi_decode_op.py Decoder)."""
+
+    def __init__(self, transitions, use_tag=True):
+        self.transitions = transitions
+        self.use_tag = use_tag
+        self.start_idx, self.stop_idx = -1, -2
+
+    def __call__(self, inputs, length):
+        bs, seq_len, n_label = inputs.shape
+        inputs_t = np.transpose(inputs, (1, 0, 2))
+        trans_exp = np.expand_dims(self.transitions, axis=0)
+        historys = []
+        left_length = np.array(length)
+        max_seq_len = np.amax(left_length)
+        left_length = np.expand_dims(left_length, 1)
+        alpha = np.full((bs, n_label), -1e4, dtype='float32') \
+            if self.use_tag else np.zeros((bs, n_label), dtype='float32')
+        alpha[:, -1] = 0
+        for i, logit in enumerate(inputs_t[:max_seq_len]):
+            if i == 0 and not self.use_tag:
+                alpha = logit
+                left_length = left_length - 1
+                continue
+            alpha_exp = np.expand_dims(alpha, 2)
+            alpha_trn_sum = alpha_exp + trans_exp
+            max_res = np.amax(alpha_trn_sum, 1), np.argmax(alpha_trn_sum, 1)
+            historys = historys + [max_res[1]] if i >= 1 else []
+            alpha_nxt = max_res[0] + logit
+            mask = (left_length > 0)
+            alpha = mask * alpha_nxt + (1 - mask) * alpha
+            if self.use_tag:
+                alpha += (left_length == 1) * trans_exp[:, self.stop_idx]
+            left_length = left_length - 1
+        scores, last_ids = np.amax(alpha, 1), np.argmax(alpha, 1)
+        left_length = left_length[:, 0]
+        last_ids_update = last_ids * (left_length >= 0)
+        batch_path = [last_ids_update]
+        batch_offset = np.arange(bs) * n_label
+        for hist in reversed(historys):
+            left_length = left_length + 1
+            gather_idx = batch_offset + last_ids
+            last_ids_update = np.take(hist, gather_idx) * (left_length > 0)
+            mask = (left_length == 0)
+            last_ids_update = last_ids_update * (1 - mask) + last_ids * mask
+            batch_path.insert(0, last_ids_update)
+            last_ids = last_ids_update + (left_length < 0) * last_ids
+        return scores, np.stack(batch_path, 1)
+
+
+class TestViterbiDecode:
+    @pytest.mark.parametrize("use_tag", [True, False])
+    def test_vs_reference_oracle(self, use_tag):
+        rs = np.random.RandomState(0)
+        B, T, C = 4, 8, 10
+        pots = rs.randn(B, T, C).astype(np.float32)
+        trans = rs.randn(C, C).astype(np.float32)
+        lens = rs.randint(1, T + 1, (B,)).astype(np.int64)
+        want_s, want_p = _RefDecoder(trans, use_tag)(pots, lens)
+        s, p = paddle.text.viterbi_decode(
+            paddle.to_tensor(pots), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=use_tag)
+        np.testing.assert_allclose(s.numpy(), want_s, rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(p.numpy(), want_p)
+
+    def test_decoder_layer(self):
+        rs = np.random.RandomState(1)
+        pots = rs.randn(2, 5, 4).astype(np.float32)
+        trans = rs.randn(4, 4).astype(np.float32)
+        lens = np.array([5, 3], np.int64)
+        dec = paddle.text.ViterbiDecoder(paddle.to_tensor(trans),
+                                         include_bos_eos_tag=False)
+        s, p = dec(paddle.to_tensor(pots), paddle.to_tensor(lens))
+        want_s, want_p = _RefDecoder(trans, False)(pots, lens)
+        np.testing.assert_allclose(s.numpy(), want_s, rtol=1e-5)
+        np.testing.assert_array_equal(p.numpy(), want_p)

@@ -332,6 +332,89 @@ SPECS = {
     "exp": dict(in_=[_SGN]),
 }
 
+
+def BOXES(n, scale=1.0):
+    """float32 [n, 4] valid (x1<x2, y1<y2) boxes."""
+    def make(rs):
+        xy = rs.rand(n, 2) * scale
+        wh = 0.1 * scale + rs.rand(n, 2) * scale
+        return np.concatenate([xy, xy + wh], 1).astype(np.float32)
+    return make
+
+
+def CONST(arr):
+    def make(rs):
+        return arr.copy()
+    return make
+
+
+# r4 long-tail (misc_ops.py / vision/detection_extra.py)
+SPECS.update({
+    "affine_channel_op": dict(in_=[U(-1, 1, (2, 3, 4, 4)),
+                                   U(0.5, 1.5, (3,)), U(-0.5, 0.5, (3,))]),
+    # frexp: mantissa/exponent are smooth only within one binade — keep
+    # inputs inside (0.5, 1) so FD never straddles a power of two
+    "frexp_op": dict(in_=[U(0.55, 0.95)]),
+    "iou_similarity_op": dict(in_=[BOXES(4), BOXES(3)], grad=False),
+    "box_clip_op": dict(
+        in_=[BOXES(5, 6.0), CONST(np.asarray([8.0, 8.0, 1.0], np.float32))],
+        grad=False),
+    "sigmoid_focal_loss_op": dict(
+        in_=[U(-2, 2, (4, 5)),
+             CONST(np.asarray([[1], [-1], [0], [5]], np.int32)),
+             CONST(np.asarray([3], np.int32))], grad=[0]),
+    "polygon_box_transform_op": dict(in_=[U(-1, 1, (1, 4, 3, 3))]),
+    "box_decoder_and_assign_op": dict(
+        in_=[BOXES(4, 6.0), U(0.1, 0.3, (4,)), U(-0.5, 0.5, (4, 12)),
+             U(0, 1, (4, 3))]),
+    "anchor_generator_op": dict(
+        in_=[U(-1, 1, (1, 2, 3, 4))],
+        attrs=dict(anchor_sizes=(32.0,), aspect_ratios=(1.0, 2.0),
+                   variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0))),
+    "density_prior_box_op": dict(
+        in_=[U(-1, 1, (1, 2, 3, 4)), U(-1, 1, (1, 3, 24, 32))],
+        attrs=dict(densities=(2,), fixed_sizes=(8.0,),
+                   fixed_ratios=(1.0,), variances=(0.1, 0.1, 0.2, 0.2))),
+    "ctc_align_op": dict(
+        in_=[I64(4, (2, 6)), CONST(np.full((2, 1), 6, np.int64))]),
+})
+
+
+def CPLX(shape=(4, 6)):
+    """complex64 maker (fft family). Grads are skipped automatically:
+    _is_float is False for complex dtypes, so these sweep forward-only
+    (eager-vs-traced agreement) — the r3 white list exempted the whole
+    family; now only the loss-weighting limitation is out of scope while
+    the two-execution-paths check runs for every fft op."""
+    def make(rs):
+        return (rs.randn(*shape) + 1j * rs.randn(*shape)
+                ).astype(np.complex64)
+    return make
+
+
+_R46 = U(-1.5, 1.5, (4, 6))
+SPECS.update({
+    # complex/fft family: forward-only sweep with complex inputs
+    "fft": dict(in_=[CPLX()]), "ifft": dict(in_=[CPLX()]),
+    "fft2": dict(in_=[CPLX()]), "ifft2": dict(in_=[CPLX()]),
+    "fftn": dict(in_=[CPLX()]), "ifftn": dict(in_=[CPLX()]),
+    "hfft": dict(in_=[CPLX()]), "ihfft": dict(in_=[_R46], grad=False, bf16=False),
+    # rfft family consumes REAL input (complex out -> grads auto-skipped
+    # via grad=False since the loss weighting is real-only)
+    "rfft": dict(in_=[_R46], grad=False, bf16=False),
+    "rfft2": dict(in_=[_R46], grad=False, bf16=False),
+    "rfftn": dict(in_=[_R46], grad=False, bf16=False),
+    "irfft": dict(in_=[CPLX()]), "irfft2": dict(in_=[CPLX()]),
+    "irfftn": dict(in_=[CPLX()]),
+    "fftshift": dict(in_=[CPLX()]), "ifftshift": dict(in_=[CPLX()]),
+    "conj": dict(in_=[CPLX()]), "angle": dict(in_=[CPLX()]),
+    "as_real_op": dict(in_=[CPLX()]),
+    "as_complex_op": dict(in_=[U(-1.5, 1.5, (4, 3, 2))], grad=False,
+                      bf16=False),
+    "complex_op": dict(in_=[_R46, _R46], grad=False,
+                   bf16=False),
+})
+
 DOMAIN_POS = {"log", "log10", "log1p", "log2", "sqrt", "rsqrt", "digamma",
               "lgamma", "reciprocal", "cumprod"}
 for _n in DOMAIN_POS:

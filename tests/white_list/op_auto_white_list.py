@@ -79,17 +79,6 @@ WHITE_LIST = {
     "multiplex": "list",
     "stack_op": "list; covered in test_tensor",
     # complex dtypes
-    "as_complex_op": "complex",
-    "as_real_op": "complex",
-    "complex_op": "complex",
-    "conj": "complex",
-    "angle": "complex",
-    "fft": "complex", "fft2": "complex", "fftn": "complex",
-    "ifft": "complex", "ifft2": "complex", "ifftn": "complex",
-    "rfft": "complex", "rfft2": "complex", "rfftn": "complex",
-    "irfft": "complex", "irfft2": "complex", "irfftn": "complex",
-    "hfft": "complex", "ihfft": "complex",
-    "fftshift": "complex", "ifftshift": "complex",
     # factories (no tensor inputs)
     "arange": "factory",
     "eye_op": "factory",
@@ -106,4 +95,8 @@ WHITE_LIST = {
     "flash_attention": "dedicated: test_pallas_fused grad parity",
     "masked_sdpa": "dedicated: sparse_attention tests in test_api_breadth",
     "batch_norm_train_stats": "dedicated: running-stats semantics in test_nn; y independent of run_mean/var inputs",
+    "viterbi_decode_op": ("dynamic — path output trimmed to max(lengths) "
+                          "via a host sync, so the op cannot run under "
+                          "the traced leg; reference-oracle parity in "
+                          "test_misc_ops.TestViterbiDecode"),
 }
