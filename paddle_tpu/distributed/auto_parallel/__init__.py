@@ -148,3 +148,4 @@ def shard_op(op_fn, process_mesh: Optional[ProcessMesh] = None,
 from .reshard import reshard, reshard_state_dict  # noqa: E402,F401
 from .cost_model import (CostModel, ClusterSpec, CommModel,  # noqa: E402,F401
                          estimate_jaxpr_cost, search_hybrid_config)
+from .planner import Planner, ShardingPlan  # noqa: E402,F401

@@ -270,8 +270,8 @@ def search_hybrid_config(train_flops: float, hbm_bytes: float,
                          max_mp: Optional[int] = None,
                          cluster: Optional[ClusterSpec] = None,
                          hbm_per_chip: float = 16e9,
-                         train_state_multiplier: float = 4.0
-                         ) -> List[ConfigCost]:
+                         train_state_multiplier: float = 4.0,
+                         n_layers: int = 12) -> List[ConfigCost]:
     """Rank all (dp, mp, pp) factorizations of n_devices by estimated step
     time, dropping configs whose per-chip train state (params + grads +
     fp32 moments ~= multiplier x params) exceeds HBM. Reference analogue:
@@ -287,6 +287,7 @@ def search_hybrid_config(train_flops: float, hbm_bytes: float,
         ranked.append(model.estimate_step(
             train_flops, hbm_bytes, param_bytes, activation_bytes,
             dp=dp, mp=mp, pp=pp,
-            micro_batches=micro_batches if pp > 1 else 1))
+            micro_batches=micro_batches if pp > 1 else 1,
+            n_layers=n_layers))
     ranked.sort(key=lambda c: c.step_time)
     return ranked

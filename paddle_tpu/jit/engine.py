@@ -270,6 +270,41 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
     return call
 
 
+def forward_jaxpr(network, inputs):
+    """jax.make_jaxpr of network(*inputs) under the engine's
+    functionalization protocol (params/buffers/RNG as traced inputs,
+    state restored afterwards). Shared by the auto-parallel planner's
+    cost measurement — ONE copy of the swap-and-restore trace harness."""
+    params = [p for _, p in network.named_parameters()]
+    buffers = [b for _, b in network.named_buffers()]
+    mutable = params + buffers
+
+    def fwd(parrs, barrs, key, in_arrs):
+        saved = [m._data for m in mutable]
+        saved_key = RNG.key
+        try:
+            for m, a in zip(params, parrs):
+                m._data = a
+            for b, a in zip(buffers, barrs):
+                b._data = a
+            RNG.key = key
+            ts = [Tensor(a, _internal=True) for a in in_arrs]
+            with state.trace_guard(), state.no_grad_guard():
+                out = network(*ts)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o._data for o in outs]
+        finally:
+            for m, a in zip(mutable, saved):
+                m._data = a
+            RNG.key = saved_key
+
+    in_arrs = [x._data if isinstance(x, Tensor) else np.asarray(x)
+               for x in inputs]
+    return jax.make_jaxpr(fwd)(
+        [p._data for p in params], [b._data for b in buffers],
+        RNG.key, in_arrs)
+
+
 def make_eval_step(network, loss_fn=None, mesh=None):
     """Compile forward (+loss) for evaluation."""
     if mesh is None:

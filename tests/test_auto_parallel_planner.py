@@ -1,0 +1,118 @@
+"""Auto-parallel planner (r4, VERDICT item 7): cost-model-gated config
+choice + sharding completion, the TPU-native completion.py/partitioner.py
+(reference: python/paddle/distributed/auto_parallel/). Runs on the
+8-device virtual CPU mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_parallel import (ClusterSpec, Planner,
+                                                  ShardingPlan)
+from paddle_tpu.jit.engine import make_train_step
+from paddle_tpu.models import GPTPretrainingCriterion, gpt_tiny
+
+
+def _mlp():
+    paddle.seed(0)
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(32, 64), paddle.nn.GELU(),
+        paddle.nn.Linear(64, 32), paddle.nn.GELU(),
+        paddle.nn.Linear(32, 8))
+
+
+class TestPlannerDecisions:
+    def test_mlp_picks_pure_dp(self):
+        """Tiny params + batch-heavy compute: the cost model must rank
+        pure data parallelism first (known-best: no comm per layer, no
+        bubble)."""
+        net = _mlp()
+        x = paddle.randn([64, 32])
+        plan = Planner().plan(net, [x], n_devices=8)
+        assert (plan.config.dp, plan.config.mp, plan.config.pp) == (8, 1, 1)
+        # every param replicated in the completed specs
+        assert all(len([e for e in s if e]) == 0
+                   for s in plan.param_specs.values())
+
+    def test_memory_gate_forces_model_parallelism(self):
+        """Same model, but HBM too small to replicate the train state:
+        the memory gate must reject dp-only configs and the planner must
+        choose mp/pp sharding — the cost model output GATES the decision."""
+        net = _mlp()
+        x = paddle.randn([64, 32])
+        params = sum(int(np.prod(p.shape)) for p in net.parameters())
+        state_bytes = 4.0 * params * 4  # multiplier x f32 params
+        plan = Planner(hbm_per_chip=state_bytes / 2).plan(
+            net, [x], n_devices=8)
+        assert plan.config.mp * plan.config.pp >= 2
+        dp_only = [c for c in plan.ranked
+                   if c.mp == 1 and c.pp == 1 and c.dp == 8]
+        assert not dp_only  # dp-only was filtered by the HBM gate
+
+    def test_infeasible_raises(self):
+        net = _mlp()
+        x = paddle.randn([64, 32])
+        with pytest.raises(ValueError, match="memory.*gate|gate"):
+            Planner(hbm_per_chip=1.0).plan(net, [x], n_devices=8)
+
+    def test_gpt_ranking_prefers_dp_at_toy_scale(self):
+        """Toy GPT on 8 chips: dp-heavy configs must outrank mp-heavy
+        ones (per-layer collectives dominate at tiny hidden sizes) —
+        mirrors the reference planner preferring DP until memory binds."""
+        net = gpt_tiny(vocab_size=128, hidden_size=64, num_layers=2,
+                       num_heads=4, intermediate_size=128,
+                       max_position_embeddings=64)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (8, 16)).astype(
+                np.int64))
+        plan = Planner().plan(net, [ids], n_devices=8, allow_pp=False)
+        assert plan.config.dp == 8 and plan.config.mp == 1
+        # the ranking itself is cost-ordered
+        times = [c.step_time for c in plan.ranked]
+        assert times == sorted(times)
+
+
+class TestCompletionAndApply:
+    def test_mlp_completion_alternates_megatron_pairs(self):
+        net = _mlp()
+        x = paddle.randn([64, 32])
+        params = sum(int(np.prod(p.shape)) for p in net.parameters())
+        plan = Planner(hbm_per_chip=4.0 * params * 2).plan(
+            net, [x], n_devices=8, allow_pp=False)
+        assert plan.config.mp > 1
+        specs = plan.param_specs
+        names = [n for n in specs if n.endswith("weight")]
+        names.sort(key=lambda n: int(n.split(".")[0]))
+        # Megatron alternation: col (None, mp), row (mp, None), col ...
+        from jax.sharding import PartitionSpec as P
+        assert specs[names[0]] == P(None, "mp")
+        assert specs[names[1]] == P("mp", None)
+        assert specs[names[2]] == P(None, "mp")
+
+    def test_apply_and_train_on_virtual_mesh(self):
+        """The plan must actually compile + run: attach specs + mesh,
+        train one step through the GSPMD engine, params physically
+        sharded per plan."""
+        net = _mlp()
+        x = paddle.randn([64, 32])
+        params = sum(int(np.prod(p.shape)) for p in net.parameters())
+        plan = Planner(hbm_per_chip=4.0 * params * 2, micro_batches=1).plan(
+            net, [x], n_devices=8, allow_pp=False)
+        plan.apply(net)
+        assert net._pt_mesh is not None
+        opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                                   learning_rate=0.1)
+        step = make_train_step(net, lambda o, y: ((o - y) ** 2).mean(), opt)
+        y = paddle.randn([64, 8])
+        loss, _ = step([x], [y])
+        assert np.isfinite(float(loss.numpy()))
+        # a column-parallel weight is physically sharded over mp
+        w0 = net[0].weight
+        spec = w0._data.sharding.spec
+        assert "mp" in str(spec)
+
+    def test_plan_summary_mentions_config(self):
+        net = _mlp()
+        x = paddle.randn([64, 32])
+        plan = Planner().plan(net, [x], n_devices=8)
+        s = plan.summary()
+        assert "dp=8" in s and "candidate" in s
