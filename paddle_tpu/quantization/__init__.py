@@ -12,6 +12,8 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+import numpy as np
+
 import jax.numpy as jnp
 from jax import lax
 
@@ -22,7 +24,8 @@ from ..nn.layer_base import Layer
 __all__ = ["fake_quantize_dequantize_abs_max",
            "fake_channel_wise_quantize_dequantize_abs_max",
            "QuantizedLinear", "QuantizedConv2D", "ImperativeQuantAware",
-           "PTQ", "export_quantized_model"]
+           "PTQ", "export_quantized_model",
+           "Int8Linear", "Int8Conv2D", "convert_to_int8"]
 
 
 @primitive("fake_quantize_dequantize_abs_max")
@@ -160,26 +163,92 @@ class ImperativeQuantAware:
 
 
 class PTQ:
-    """Post-training quantization via abs-max calibration (reference:
-    slim/quantization/post_training_quantization.py). sample_data hooks
-    every quantizable layer and records the abs-max of its INPUT over the
-    calibration set; quantize() bakes those as fixed activation scales."""
+    """Post-training quantization with a CHOICE of activation observers
+    (reference: slim/quantization/post_training_quantization.py `algo`:
+    abs_max / moving_average / hist percentile / mse). sample_data hooks
+    every quantizable layer and observes its INPUT over the calibration
+    set; quantize() bakes the observed scales as fixed activation scales.
 
-    def __init__(self, activation_bits=8, weight_bits=8):
+    algo:
+      abs_max                  — running max of |x| (default; outlier-
+                                 sensitive but never clips)
+      moving_average_abs_max   — EMA of per-batch abs-max (reference
+                                 moving_rate semantics)
+      percent                  — per-batch |x| percentile (hist_percent
+                                 analogue); clips outliers
+      mse                      — scale minimizing quantization MSE over
+                                 retained samples (grid over fractions of
+                                 abs-max)
+    """
+
+    _ALGOS = ("abs_max", "moving_average_abs_max", "percent", "mse")
+
+    def __init__(self, activation_bits=8, weight_bits=8, algo="abs_max",
+                 percentile=0.9999, moving_rate=0.9,
+                 sample_cap=1 << 16):
+        if algo not in self._ALGOS:
+            raise ValueError(f"PTQ algo {algo!r} not in {self._ALGOS}")
         self.ab = activation_bits
         self.wb = weight_bits
+        self.algo = algo
+        self.percentile = percentile
+        self.moving_rate = moving_rate
+        self.sample_cap = sample_cap
         self._scales: Dict[str, float] = {}
+        self._samples: Dict[str, list] = {}
+
+    def _observe(self, path: str, absx):
+        if self.algo == "abs_max":
+            self._scales[path] = max(self._scales.get(path, 0.0),
+                                     float(absx.max()))
+        elif self.algo == "moving_average_abs_max":
+            m = float(absx.max())
+            prev = self._scales.get(path)
+            self._scales[path] = m if prev is None else \
+                self.moving_rate * prev + (1.0 - self.moving_rate) * m
+        elif self.algo == "percent":
+            p = float(np.percentile(absx, self.percentile * 100.0))
+            self._scales[path] = max(self._scales.get(path, 0.0), p)
+        else:  # mse: retain (capped) samples for the search at quantize()
+            buf = self._samples.setdefault(path, [])
+            flat = absx.reshape(-1)
+            if flat.size > self.sample_cap:
+                idx = np.random.RandomState(0).choice(
+                    flat.size, self.sample_cap, replace=False)
+                flat = flat[idx]
+            buf.append(flat)
+
+    def _finalize_mse(self):
+        n = float(2 ** (self.ab - 1) - 1)
+        for path, chunks in self._samples.items():
+            samples = np.concatenate(chunks)
+            amax = float(samples.max()) if samples.size else 1.0
+            best, best_err = amax, np.inf
+            for frac in np.linspace(0.3, 1.0, 15):
+                s = max(frac * amax, 1e-9)
+                step = s / n
+                q = np.clip(np.round(samples / step), -n, n) * step
+                err = float(((q - samples) ** 2).mean())
+                if err < best_err:
+                    best, best_err = s, err
+            self._scales[path] = best
 
     def sample_data(self, model: Layer, inputs: List[Tensor]):
-        """Run calibration batches; returns {layer_path: abs_max}."""
+        """Run calibration batches; returns {layer_path: act_scale}."""
         hooks = []
+
+        device_reduce = self.algo in ("abs_max", "moving_average_abs_max")
 
         def make_hook(path):
             def hook(layer, ins):
-                x = ins[0]
-                self._scales[path] = max(
-                    self._scales.get(path, 0.0),
-                    float(jnp.max(jnp.abs(x._data))))
+                if device_reduce:
+                    # max-based observers: reduce ON DEVICE, transfer one
+                    # scalar (a full activation D2H per batch would
+                    # dominate calibration time on TPU)
+                    m = float(jnp.max(jnp.abs(ins[0]._data)))
+                    self._observe(path, np.asarray([m]))
+                else:
+                    self._observe(path, np.abs(np.asarray(ins[0]._data)))
             return hook
 
         for path, sub in model.named_sublayers():
@@ -191,6 +260,8 @@ class PTQ:
         finally:
             for h in hooks:
                 h.remove()
+        if self.algo == "mse":
+            self._finalize_mse()
         return dict(self._scales)
 
     def quantize(self, model: Layer):
@@ -250,3 +321,6 @@ def export_quantized_model(model: Layer, path_prefix: str, input_spec):
         if was_training:
             model.train()
     return path_prefix
+
+
+from .int8 import Int8Conv2D, Int8Linear, convert_to_int8  # noqa: E402,F401

@@ -66,3 +66,180 @@ def test_qat_export_conv(tmp_path):
     finally:
         paddle.disable_static()
     np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+
+
+class TestObserverChoices:
+    """PTQ activation observers (r4): abs_max / moving_average / percent /
+    mse (reference: post_training_quantization.py algo choices)."""
+
+    def _calib(self, with_outlier=False):
+        batches = [np.random.RandomState(i).randn(16, 8).astype(np.float32)
+                   for i in range(4)]
+        if with_outlier:
+            batches[1][0, 0] = 100.0
+        import paddle_tpu as paddle
+        return [paddle.to_tensor(b) for b in batches]
+
+    def _net(self):
+        paddle.seed(3)
+        return paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+
+    def test_absmax_tracks_outlier_percent_clips_it(self):
+        from paddle_tpu.quantization import PTQ
+        net = self._net()
+        calib = self._calib(with_outlier=True)
+        s_max = PTQ(algo="abs_max").sample_data(net, calib)["0"]
+        s_pct = PTQ(algo="percent", percentile=0.99).sample_data(
+            net, calib)["0"]
+        assert s_max >= 100.0          # outlier dominates abs_max
+        assert s_pct < 10.0            # percentile observer clips it
+
+    def test_moving_average_between_min_and_max(self):
+        from paddle_tpu.quantization import PTQ
+        net = self._net()
+        calib = self._calib()
+        s_ma = PTQ(algo="moving_average_abs_max").sample_data(
+            net, calib)["0"]
+        maxes = [float(np.abs(c.numpy()).max()) for c in calib]
+        assert min(maxes) * 0.5 <= s_ma <= max(maxes)
+
+    def test_mse_picks_grid_argmin(self):
+        """The mse observer must return the scale minimizing quantization
+        MSE over its candidate grid (fractions of abs-max) — i.e. never a
+        worse choice than any other candidate, abs_max included."""
+        from paddle_tpu.quantization import PTQ
+        net = self._net()
+        calib = self._calib(with_outlier=True)
+        ptq = PTQ(algo="mse")
+        s_mse = ptq.sample_data(net, calib)["0"]
+        samples = np.concatenate(ptq._samples["0"]).astype(np.float64)
+        amax = samples.max()
+
+        def err(s):
+            step = max(s / 127.0, 1e-9)
+            q = np.clip(np.round(samples / step), -127, 127) * step
+            return ((q - samples) ** 2).mean()
+
+        for frac in np.linspace(0.3, 1.0, 15):
+            assert err(s_mse) <= err(frac * amax) * (1 + 1e-9)
+
+    def test_bad_algo_raises(self):
+        from paddle_tpu.quantization import PTQ
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="algo"):
+            PTQ(algo="nope")
+
+
+class TestInt8Path:
+    """TRUE int8 inference (r4): int8 weights + int8 matmul/int32
+    accumulator, through eager AND the saved-program predictor
+    (reference: ConvertToInt8Pass + int8 deploy)."""
+
+    def test_int8_linear_close_to_fp32(self):
+        from paddle_tpu.quantization import PTQ, convert_to_int8
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(16, 4))
+        calib = [paddle.to_tensor(
+            np.random.RandomState(i).randn(8, 8).astype(np.float32))
+            for i in range(4)]
+        scales = PTQ().sample_data(net, calib)
+        x = paddle.to_tensor(np.random.RandomState(7).randn(8, 8)
+                             .astype(np.float32))
+        ref = net(x).numpy()
+        qnet = convert_to_int8(net, act_scales=scales)
+        out = qnet(x).numpy()
+        # int8 weights actually stored as int8
+        assert qnet[0].weight_int8.numpy().dtype == np.int8
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.05, rel
+
+    def test_int8_conv_close_to_fp32(self):
+        from paddle_tpu.quantization import PTQ, convert_to_int8
+        paddle.seed(1)
+        net = paddle.nn.Sequential(paddle.nn.Conv2D(2, 4, 3, padding=1),
+                                   paddle.nn.ReLU())
+        calib = [paddle.to_tensor(
+            np.random.RandomState(i).rand(2, 2, 6, 6).astype(np.float32))
+            for i in range(3)]
+        scales = PTQ().sample_data(net, calib)
+        x = paddle.to_tensor(np.random.RandomState(5).rand(2, 2, 6, 6)
+                             .astype(np.float32))
+        ref = net(x).numpy()
+        qnet = convert_to_int8(net, act_scales=scales)
+        out = qnet(x).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.05, rel
+
+    def test_int8_predictor_roundtrip(self, tmp_path):
+        """int8 weights survive export; the loaded program serves int8
+        compute through the Executor/predictor path."""
+        from paddle_tpu.quantization import PTQ, convert_to_int8
+        paddle.seed(2)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(16, 4))
+        calib = [paddle.to_tensor(
+            np.random.RandomState(i).randn(8, 8).astype(np.float32))
+            for i in range(3)]
+        scales = PTQ().sample_data(net, calib)
+        qnet = convert_to_int8(net, act_scales=scales)
+        x = paddle.to_tensor(np.random.RandomState(11).randn(4, 8)
+                             .astype(np.float32))
+        ref = qnet(x).numpy()
+        path = export_quantized_model(qnet, str(tmp_path / "int8model"),
+                                      [((-1, 8), "float32")])
+        meta = pickle.load(open(path + ".pdmodel", "rb"))
+        assert any(o["op_type"] == "int8_linear" for o in meta["ops"])
+        params = pickle.load(open(path + ".pdiparams", "rb"))
+        int8_params = [v for v in params.values()
+                       if np.asarray(v).dtype == np.int8]
+        assert int8_params, "no int8 weights in the artifact"
+        paddle.enable_static()
+        try:
+            prog, feeds, fetches = static.load_inference_model(path)
+            exe = static.Executor()
+            outs = exe.run(prog, feed={feeds[0]: x.numpy()},
+                           fetch_list=fetches)
+        finally:
+            paddle.disable_static()
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+
+
+class TestLeNetAccuracyDrop:
+    def test_int8_accuracy_close_to_fp32(self):
+        """Accuracy-drop gate on LeNet/MNIST (reference: the slim PTQ
+        acceptance tests): int8 accuracy within 2 points of fp32."""
+        import os
+        os.environ.setdefault("PADDLE_TPU_SYNTH_SAMPLES", "512")
+        from paddle_tpu.quantization import PTQ, convert_to_int8
+        from paddle_tpu.vision.datasets import MNIST
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(0)
+        model = paddle.Model(LeNet())
+        opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                    learning_rate=1e-3)
+        model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                      paddle.metric.Accuracy())
+        train = MNIST(mode="train")
+        model.fit(train, epochs=1, batch_size=64, verbose=0)
+
+        test = MNIST(mode="test")
+        n = min(256, len(test))
+        xs = np.stack([test[i][0] for i in range(n)]).astype(np.float32)
+        ys = np.asarray([int(test[i][1]) for i in range(n)])
+
+        net = model.network
+        net.eval()
+        logits = net(paddle.to_tensor(xs)).numpy()
+        acc_fp32 = float((logits.argmax(1) == ys).mean())
+
+        calib = [paddle.to_tensor(xs[i:i + 64]) for i in range(0, 192, 64)]
+        ptq = PTQ()
+        scales = ptq.sample_data(net, calib)
+        qnet = convert_to_int8(net, act_scales=scales)
+        qlogits = qnet(paddle.to_tensor(xs)).numpy()
+        acc_int8 = float((qlogits.argmax(1) == ys).mean())
+        assert acc_int8 >= acc_fp32 - 0.02, (acc_fp32, acc_int8)

@@ -99,4 +99,10 @@ WHITE_LIST = {
                           "via a host sync, so the op cannot run under "
                           "the traced leg; reference-oracle parity in "
                           "test_misc_ops.TestViterbiDecode"),
+    "int8_linear": ("dedicated — int8 weight + per-channel scale "
+                    "contract; fp32-closeness + predictor roundtrip in "
+                    "test_quant_export.TestInt8Path"),
+    "int8_conv2d": ("dedicated — int8 weight + im2col int8 matmul "
+                    "contract; fp32-closeness in "
+                    "test_quant_export.TestInt8Path"),
 }
