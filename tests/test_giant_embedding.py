@@ -1,0 +1,128 @@
+"""Giant-embedding demo (r4, VERDICT item 9) — cashing the parameter-
+server cut's claim.
+
+The reference scales embedding tables past one device with the brpc
+parameter server (reference: paddle/fluid/distributed/service/
+brpc_ps_server.h, table/common_sparse_table.h — the table lives on PS
+shards, trainers pull/push sparse rows). README's documented cut claims
+GSPMD-sharded embeddings subsume this; these tests SHOW it on the
+8-device virtual mesh:
+
+  * a table bigger than any single device's budget lives vocab-sharded —
+    each device physically holds ~1/8 of the rows;
+  * lookups compile to masked local gathers + psum over the mesh (what
+    the PS 'pull' was), with parity against a replicated table;
+  * updates are SPARSE: a SelectedRows gradient touches only the looked-
+    up rows (the PS 'push'), rows outside the batch are bit-identical
+    after the step, and the table STAYS sharded through the update.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import SelectedRows, nn
+
+VOCAB = 1 << 17          # 131072 rows
+DIM = 64                 # x 64 f32 = 32 MB table
+# the demo's "device budget": a single device may hold at most 1/4 of
+# the table — replication would bust it, vocab-sharding fits easily
+DEVICE_BUDGET_BYTES = VOCAB * DIM * 4 // 4
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:8]), ("mp",))
+
+
+def _sharded_embedding(seed=0):
+    paddle.seed(seed)
+    emb = nn.Embedding(VOCAB, DIM, sparse=True)
+    mesh = _mesh()
+    emb.weight._data = jax.device_put(
+        emb.weight._data, NamedSharding(mesh, P("mp", None)))
+    return emb, mesh
+
+
+def _on_mesh(arr, mesh):
+    """Mesh-resident (replicated) input tensor: eager ops mixing the
+    sharded table with single-device-committed arrays would fail XLA's
+    committed-device check — inputs join the table's mesh instead."""
+    t = paddle.to_tensor(arr)
+    t._data = jax.device_put(t._data, NamedSharding(mesh, P()))
+    return t
+
+
+class TestGiantEmbeddingSharded:
+    def test_table_exceeds_single_device_budget_but_fits_sharded(self):
+        emb, mesh = _sharded_embedding()
+        total = VOCAB * DIM * 4
+        assert total > DEVICE_BUDGET_BYTES  # replicated would not fit
+        shards = emb.weight._data.addressable_shards
+        assert len(shards) == 8
+        per_dev = [int(np.prod(s.data.shape)) * 4 for s in shards]
+        # every device holds exactly 1/8 of the rows — under budget
+        assert all(b == total // 8 for b in per_dev)
+        assert max(per_dev) < DEVICE_BUDGET_BYTES
+
+    def test_sharded_lookup_matches_replicated(self):
+        emb, _ = _sharded_embedding(seed=3)
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, VOCAB, (4, 16)).astype(np.int64)
+        out = emb(_on_mesh(ids, _mesh()))
+        want = np.asarray(emb.weight.numpy())[ids]
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-6)
+
+    def test_sparse_update_touches_only_looked_up_rows(self):
+        """The PS 'push': SelectedRows grad -> row-wise optimizer update;
+        untouched rows bit-identical, table still sharded."""
+        emb, mesh = _sharded_embedding(seed=1)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=[emb.weight])
+        rs = np.random.RandomState(2)
+        ids = rs.randint(0, VOCAB, (8, 4)).astype(np.int64)
+        before = np.asarray(emb.weight.numpy()).copy()
+
+        loss = (emb(_on_mesh(ids, mesh)) ** 2).sum()
+        loss.backward()
+        g = emb.weight.grad
+        assert isinstance(g, SelectedRows)          # sparse push payload
+        assert len(set(np.asarray(g.rows).tolist())) <= ids.size
+        opt.step()
+        opt.clear_grad()
+
+        after = np.asarray(emb.weight.numpy())
+        touched = np.unique(ids)
+        untouched = np.setdiff1d(np.arange(VOCAB), touched)
+        # rows outside the batch: bit-identical (no dense write happened)
+        sample = untouched[:: max(1, len(untouched) // 4096)]
+        np.testing.assert_array_equal(after[sample], before[sample])
+        # rows in the batch actually moved
+        assert np.abs(after[touched] - before[touched]).max() > 0
+        # the table never densified onto one device
+        sh = emb.weight._data.sharding
+        assert isinstance(sh, NamedSharding) and sh.spec[0] == "mp"
+
+    def test_training_converges_on_sharded_table(self):
+        """2-layer embedding classifier trains on the sharded table —
+        the end-to-end capability the PS existed for."""
+        emb, mesh = _sharded_embedding(seed=4)
+        paddle.seed(5)
+        head = nn.Linear(DIM, 2)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.05,
+            parameters=[emb.weight] + list(head.parameters()))
+        rs = np.random.RandomState(6)
+        ids = rs.randint(0, VOCAB, (32,)).astype(np.int64)
+        labels = (ids % 2).astype(np.int64)
+        losses = []
+        for _ in range(12):
+            logits = head(emb(_on_mesh(ids, mesh)))
+            loss = paddle.nn.functional.cross_entropy(
+                logits, _on_mesh(labels, mesh))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5, losses
