@@ -3,9 +3,11 @@ from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
 from .pipeline_parallel import PipelineParallel
+from .compiled_pipeline import CompiledPipeline1F1B
 from .parallel_layers import TensorParallel, ShardingParallel
 
 __all__ = [
+    "CompiledPipeline1F1B",
     "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
     "ParallelCrossEntropy", "RNGStatesTracker", "get_rng_state_tracker",
     "model_parallel_random_seed", "LayerDesc", "SharedLayerDesc",

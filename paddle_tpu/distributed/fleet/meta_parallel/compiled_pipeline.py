@@ -1,0 +1,137 @@
+"""Compiled pipeline schedule: the WHOLE 1F1B lives inside one XLA
+program (r4, VERDICT item 10).
+
+The host-scheduled engine (pipeline_parallel.py) dispatches one
+executable per stage per micro-batch — faithful to the reference's
+SectionWorker (reference: paddle/fluid/framework/section_worker.cc:138-189
+RunFThenB/Run1F1B) but host-bound: at pp≥4 with many micro-batches the
+python loop and per-call latency become the bubble. This variant is the
+TPU-native alternative: stage weights STACK over the "pp" mesh axis,
+micro-batches stream through a lax.scan, and activations hand off
+between stages with lax.ppermute inside shard_map — so XLA owns the
+entire schedule and overlaps compute with the ICI sends. Differentiating
+THROUGH the scanned pipeline yields the reverse-schedule backward in the
+same compiled program (ppermute's vjp is the reverse permute), i.e.
+forward+backward pipelining with zero host involvement.
+
+Constraint (inherent to the stacked formulation): all stages run the
+SAME block function over identically-shaped weights — the uniform
+partition case (N identical transformer blocks), which is what
+compiled-schedule pipelining is for. Heterogeneous stages (embedding /
+head) stay on the host-scheduled engine, which remains the default.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["CompiledPipeline1F1B"]
+
+
+class CompiledPipeline1F1B:
+    """One-XLA-program GPipe/1F1B over a uniform block pipeline.
+
+    block_fn(stage_params, x) -> y        pure jax, shape-preserving
+    loss_fn(y, label) -> scalar           pure jax
+    stacked_params: pytree whose leaves have leading dim n_stages
+                    (stage i's weights at index i), sharded P("pp", ...).
+
+    step(micro_x [n_micro, mb, ...], micro_y [n_micro, ...]) returns
+    (mean micro loss, grads pytree stacked like the params).
+    """
+
+    def __init__(self, block_fn: Callable, loss_fn: Callable,
+                 n_stages: int, n_micro: int,
+                 mesh: Optional[Mesh] = None):
+        if n_micro < 1 or n_stages < 2:
+            raise ValueError("need n_micro >= 1 and n_stages >= 2")
+        self.block_fn = block_fn
+        self.loss_fn = loss_fn
+        self.pp = n_stages
+        self.n_micro = n_micro
+        self.mesh = mesh or Mesh(
+            np.asarray(jax.devices()[:n_stages]), ("pp",))
+        if "pp" not in self.mesh.shape:
+            raise ValueError(
+                f"mesh must have a 'pp' axis; got {self.mesh.axis_names}")
+        if self.mesh.shape["pp"] != n_stages:
+            raise ValueError(
+                f"mesh pp axis {self.mesh.shape['pp']} != {n_stages}")
+        self._jitted = None
+        self._built_treedef = None
+
+    # -- schedule (runs per-device inside shard_map) -----------------------
+    def _pipeline(self, w_local, micro_x, micro_y):
+        pp, n_micro = self.pp, self.n_micro
+        stage = jax.lax.axis_index("pp")
+        # un-stack this device's stage weights (leading dim 1 locally)
+        w = jax.tree_util.tree_map(lambda a: a[0], w_local)
+        fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+
+        def tick(carry, t):
+            act_in, loss_acc = carry
+            # stage 0 injects micro-batch t; later stages consume the
+            # activation ppermuted from their predecessor. Out-of-range
+            # ticks compute on stale data but only ever feed other
+            # out-of-range ticks — the loss mask keeps them out of the
+            # value AND the gradient.
+            x0 = micro_x[jnp.clip(t, 0, n_micro - 1)]
+            x = jnp.where(stage == 0, x0, act_in)
+            y = self.block_fn(w, x)
+            m = t - (pp - 1)
+            valid = ((stage == pp - 1) & (m >= 0) & (m < n_micro))
+            lbl = micro_y[jnp.clip(m, 0, n_micro - 1)]
+            loss_acc = loss_acc + jnp.where(
+                valid, self.loss_fn(y, lbl), 0.0)
+            act_out = jax.lax.ppermute(y, "pp", fwd_perm)
+            return (act_out, loss_acc), None
+
+        init = (jnp.zeros_like(micro_x[0]), jnp.float32(0.0))
+        (_, loss_acc), _ = jax.lax.scan(
+            tick, init, jnp.arange(n_micro + pp - 1))
+        # only the last stage accumulated loss; share it with everyone
+        return jax.lax.psum(loss_acc, "pp") / n_micro
+
+    @staticmethod
+    def _stack_spec(a) -> P:
+        """One formula for the stacked-weight layout: stage dim over
+        'pp', the rest replicated (shared by place() and the shard_map
+        in_specs — they must never drift apart)."""
+        return P("pp", *([None] * (a.ndim - 1)))
+
+    def _build(self, stacked_params):
+        stack_specs = jax.tree_util.tree_map(self._stack_spec,
+                                             stacked_params)
+        mapped = jax.shard_map(
+            self._pipeline, mesh=self.mesh,
+            in_specs=(stack_specs, P(), P()),
+            out_specs=P(), check_vma=False)
+
+        def value_and_grad(w, micro_x, micro_y):
+            return jax.value_and_grad(
+                lambda w_: mapped(w_, micro_x, micro_y))(w)
+
+        self._jitted = jax.jit(value_and_grad)
+        self._built_treedef = jax.tree_util.tree_structure(stacked_params)
+
+    def place(self, stacked_params):
+        """Commit the stacked weights onto the pp mesh (stage i's block
+        physically resident on device i)."""
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                a, NamedSharding(self.mesh, self._stack_spec(a))),
+            stacked_params)
+
+    def step(self, stacked_params, micro_x, micro_y):
+        """(mean micro loss, stacked grads). Compile once per params tree
+        structure; the schedule, collectives, and the reverse-pipeline
+        backward are all inside the one executable."""
+        treedef = jax.tree_util.tree_structure(stacked_params)
+        if self._jitted is None or treedef != self._built_treedef:
+            self._build(stacked_params)
+        return self._jitted(stacked_params, micro_x, micro_y)

@@ -1,0 +1,161 @@
+"""Compiled (shard_map + ppermute + scan) 1F1B vs the host-scheduled
+pipeline engine (r4, VERDICT item 10) — loss and per-stage gradients must
+agree on the virtual mesh. Host engine stays the default
+(fleet.distributed_model); the compiled schedule is the pp>=4 option.
+reference semantics: paddle/fluid/framework/section_worker.cc:138-189."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet.meta_parallel.compiled_pipeline import (
+    CompiledPipeline1F1B)
+from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+    LayerDesc, PipelineLayer)
+
+H = 16           # block width
+PP = 4           # stages
+N_MICRO = 4
+MB = 2           # micro-batch size
+
+
+class Block(paddle.nn.Layer):
+    """Shape-preserving block: tanh(x @ W + b)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(H, H)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+def _block_fn(params, x):
+    W, b = params
+    return jnp.tanh(x @ W + b)
+
+
+def _mse(y, label):
+    return ((y - label) ** 2).mean()
+
+
+def _make_weights(seed=0):
+    rs = np.random.RandomState(seed)
+    Ws = rs.randn(PP, H, H).astype(np.float32) * 0.3
+    bs = rs.randn(PP, H).astype(np.float32) * 0.1
+    return Ws, bs
+
+
+def _host_engine_loss_and_grads(Ws, bs, x, y):
+    """Run the SAME pipeline through the host-scheduled fleet engine."""
+    dist.fleet._state.initialized = False
+    from paddle_tpu.distributed import collective
+    collective.destroy_process_group()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": PP, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": N_MICRO,
+                                 "micro_batch_size": MB}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    pipe = PipelineLayer([LayerDesc(Block) for _ in range(PP)],
+                         num_stages=PP,
+                         loss_fn=lambda o, l: _mse(o, l))
+    model = dist.fleet.distributed_model(pipe)
+    for s, blk in enumerate(pipe.run_function):
+        blk.fc.weight.set_value(Ws[s])
+        blk.fc.bias.set_value(bs[s])
+
+    loss = model.train_batch([paddle.to_tensor(x), paddle.to_tensor(y)],
+                             optimizer=None)
+    gW = np.stack([np.asarray(blk.fc.weight.grad.numpy())
+                   for blk in pipe.run_function])
+    gb = np.stack([np.asarray(blk.fc.bias.grad.numpy())
+                   for blk in pipe.run_function])
+    return float(loss.numpy()), gW, gb
+
+
+def _oracle_loss_and_grads(Ws, bs, x, y):
+    """Dense single-program oracle: the whole pipeline as a plain chain,
+    micro-averaged MSE; grads by jax.grad."""
+    def f(stack):
+        Ws_, bs_ = stack
+        total = 0.0
+        for m in range(N_MICRO):
+            h = x[m]
+            for s in range(PP):
+                h = jnp.tanh(h @ Ws_[s] + bs_[s])
+            total = total + _mse(h, y[m])
+        return total / N_MICRO
+
+    loss, grads = jax.value_and_grad(f)((jnp.asarray(Ws), jnp.asarray(bs)))
+    return float(loss), np.asarray(grads[0]), np.asarray(grads[1])
+
+
+@pytest.fixture(scope="module")
+def data():
+    rs = np.random.RandomState(7)
+    x = rs.randn(N_MICRO, MB, H).astype(np.float32)
+    y = rs.randn(N_MICRO, MB, H).astype(np.float32)
+    return x, y
+
+
+class TestCompiledPipelineParity:
+    def test_matches_dense_oracle(self, data):
+        x, y = data
+        Ws, bs = _make_weights()
+        eng = CompiledPipeline1F1B(_block_fn, _mse, PP, N_MICRO)
+        w = eng.place((jnp.asarray(Ws), jnp.asarray(bs)))
+        loss, grads = eng.step(w, jnp.asarray(x), jnp.asarray(y))
+        oloss, ogW, ogb = _oracle_loss_and_grads(Ws, bs, x, y)
+        np.testing.assert_allclose(float(loss), oloss, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads[0]), ogW, rtol=1e-4,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads[1]), ogb, rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_matches_host_scheduled_engine(self, data):
+        """The VERDICT parity bar: compiled schedule vs the (default)
+        host-scheduled 1F1B engine, same weights, same micro-batches."""
+        x, y = data
+        Ws, bs = _make_weights(seed=1)
+        hloss, hgW, hgb = _host_engine_loss_and_grads(
+            Ws, bs, x.reshape(N_MICRO * MB, H), y.reshape(N_MICRO * MB, H))
+        eng = CompiledPipeline1F1B(_block_fn, _mse, PP, N_MICRO)
+        w = eng.place((jnp.asarray(Ws), jnp.asarray(bs)))
+        closs, cgrads = eng.step(w, jnp.asarray(x), jnp.asarray(y))
+        np.testing.assert_allclose(float(closs), hloss, rtol=1e-5)
+        # host engine accumulates SUM of (1/n)-scaled micro grads == the
+        # compiled engine's grad of mean micro loss
+        np.testing.assert_allclose(np.asarray(cgrads[0]), hgW, rtol=1e-4,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(cgrads[1]), hgb, rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_stage_weights_physically_partitioned(self, data):
+        Ws, bs = _make_weights()
+        eng = CompiledPipeline1F1B(_block_fn, _mse, PP, N_MICRO)
+        w = eng.place((jnp.asarray(Ws), jnp.asarray(bs)))
+        shards = w[0].addressable_shards
+        per_dev = {s.device.id: s.data.shape for s in shards}
+        # each pp device holds exactly ONE stage's block
+        assert all(shape == (1, H, H) for shape in per_dev.values())
+        assert len(per_dev) == PP
+
+    def test_training_loop_converges(self, data):
+        """SGD on the compiled engine's grads drives the loss down —
+        usable as a real training path."""
+        x, y = data
+        Ws, bs = _make_weights(seed=2)
+        eng = CompiledPipeline1F1B(_block_fn, _mse, PP, N_MICRO)
+        w = eng.place((jnp.asarray(Ws), jnp.asarray(bs)))
+        losses = []
+        for _ in range(20):
+            loss, grads = eng.step(w, jnp.asarray(x), jnp.asarray(y))
+            losses.append(float(loss))
+            w = jax.tree_util.tree_map(lambda p, g: p - 0.2 * g, w, grads)
+        assert losses[-1] < losses[0] * 0.7, losses
