@@ -1,0 +1,246 @@
+"""FleetExecutor actor runtime: Carrier / Interceptor / MessageBus.
+
+TPU-native analogue of the reference's (embryonic, 613-LoC) actor
+execution runtime (reference:
+paddle/fluid/distributed/fleet_executor/carrier.h:31,
+interceptor.h:32 — per-interceptor mailbox + polling thread,
+message_bus.h:36 — id→carrier routing over brpc,
+interceptor_message.proto — STOP / DATA_IS_READY / DATA_IS_USELESS).
+
+The reference drives multi-program DAGs (sections of a pipeline) as
+actors exchanging readiness messages. Here the data plane is XLA (the
+compiled engines in meta_parallel/), so this runtime keeps the CONTROL
+plane: interceptors are mailbox-driven actors on threads, the carrier
+owns and routes between them, and the message bus spans carriers — the
+same shape, minus brpc (cross-host control traffic belongs to the
+jax.distributed coordinator, not a second RPC stack).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["MessageType", "InterceptorMessage", "TaskNode", "Interceptor",
+           "ComputeInterceptor", "Carrier", "MessageBus"]
+
+
+class MessageType:
+    """reference: interceptor_message.proto MessageType."""
+
+    STOP = 1
+    DATA_IS_READY = 2
+    DATA_IS_USELESS = 3
+    ERROR = 4
+    RESET = 5
+
+
+@dataclass
+class InterceptorMessage:
+    """reference: interceptor_message.proto InterceptorMessage."""
+
+    src_id: int = -1
+    dst_id: int = -1
+    message_type: int = MessageType.DATA_IS_READY
+    payload: Any = None
+    scope_idx: int = 0
+
+
+@dataclass
+class TaskNode:
+    """reference: task_node.h — what an interceptor executes + its DAG
+    edges (upstream/downstream interceptor ids)."""
+
+    task_id: int
+    run: Optional[Callable[[Any], Any]] = None
+    upstream: list = field(default_factory=list)
+    downstream: list = field(default_factory=list)
+    max_run_times: int = 1
+
+
+class Interceptor:
+    """Mailbox-driven actor (reference: interceptor.h:32 — remote
+    mailbox + PoolTheMailbox thread). Subclass or pass a handler:
+    handle(msg) runs on the interceptor's own thread."""
+
+    def __init__(self, interceptor_id: int, node: Optional[TaskNode] = None,
+                 handler: Optional[Callable] = None):
+        self.interceptor_id = interceptor_id
+        self.node = node
+        self._handler = handler
+        self.carrier: Optional["Carrier"] = None
+        self._mailbox: "queue.Queue[InterceptorMessage]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    # -- carrier-facing ----------------------------------------------------
+    def enqueue_message(self, msg: InterceptorMessage) -> bool:
+        """reference: EnqueueRemoteInterceptorMessage."""
+        self._mailbox.put(msg)
+        return True
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._pool_the_mailbox, daemon=True,
+            name=f"interceptor-{self.interceptor_id}")
+        self._thread.start()
+
+    def join(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- actor body --------------------------------------------------------
+    def _pool_the_mailbox(self):
+        """reference: Interceptor::PoolTheMailbox — block on the mailbox,
+        dispatch each message, exit on STOP."""
+        while not self._stopped.is_set():
+            msg = self._mailbox.get()
+            if msg.message_type == MessageType.STOP:
+                self._stopped.set()
+                self.handle(msg)
+                break
+            try:
+                self.handle(msg)
+            except Exception as e:  # propagate as an ERROR message
+                if self.carrier is not None:
+                    self.carrier.on_error(self.interceptor_id, e)
+                self._stopped.set()
+                break
+
+    def handle(self, msg: InterceptorMessage):
+        if self._handler is not None:
+            self._handler(self, msg)
+
+    def send(self, dst_id: int, message_type: int, payload=None):
+        """Route through the carrier/message bus (reference:
+        Interceptor::Send -> MessageBus)."""
+        assert self.carrier is not None, "interceptor not registered"
+        self.carrier.send(InterceptorMessage(
+            src_id=self.interceptor_id, dst_id=dst_id,
+            message_type=message_type, payload=payload))
+
+
+class ComputeInterceptor(Interceptor):
+    """reference: compute_interceptor.cc — on DATA_IS_READY run the task
+    node's body and notify downstream; forward STOP down the DAG."""
+
+    def handle(self, msg: InterceptorMessage):
+        if msg.message_type == MessageType.STOP:
+            for d in (self.node.downstream if self.node else []):
+                self.send(d, MessageType.STOP)
+            return
+        if msg.message_type != MessageType.DATA_IS_READY:
+            return
+        out = self.node.run(msg.payload) if (self.node and self.node.run) \
+            else msg.payload
+        for d in (self.node.downstream if self.node else []):
+            self.send(d, MessageType.DATA_IS_READY, payload=out)
+        # tell upstream its buffer can be reused
+        if msg.src_id >= 0 and self.node and msg.src_id in self.node.upstream:
+            self.send(msg.src_id, MessageType.DATA_IS_USELESS)
+
+
+class Carrier:
+    """Owns this rank's interceptors, creates them from the task DAG, and
+    routes local messages (reference: carrier.h:31)."""
+
+    def __init__(self, rank: int = 0):
+        self.rank = rank
+        self._interceptors: Dict[int, Interceptor] = {}
+        self.bus: Optional["MessageBus"] = None
+        self._error: Optional[BaseException] = None
+
+    def create_interceptors(self, id_to_node: Dict[int, TaskNode],
+                            cls=ComputeInterceptor):
+        for iid, node in id_to_node.items():
+            self.add_interceptor(cls(iid, node))
+        return self
+
+    def add_interceptor(self, interceptor: Interceptor):
+        if interceptor.interceptor_id in self._interceptors:
+            raise ValueError(
+                f"duplicate interceptor id {interceptor.interceptor_id}")
+        interceptor.carrier = self
+        self._interceptors[interceptor.interceptor_id] = interceptor
+        return interceptor
+
+    def get_interceptor(self, interceptor_id: int) -> Interceptor:
+        return self._interceptors[interceptor_id]
+
+    def enqueue_interceptor_message(self, msg: InterceptorMessage) -> bool:
+        it = self._interceptors.get(msg.dst_id)
+        if it is None:
+            return False
+        return it.enqueue_message(msg)
+
+    def send(self, msg: InterceptorMessage):
+        if msg.dst_id in self._interceptors:
+            self.enqueue_interceptor_message(msg)
+        elif self.bus is not None:
+            self.bus.send(msg)
+        else:
+            raise KeyError(f"no route to interceptor {msg.dst_id}")
+
+    def on_error(self, interceptor_id: int, exc: BaseException):
+        """A failed actor poisons the carrier: record the error and STOP
+        every other interceptor so wait() returns promptly instead of
+        timing out per surviving thread (and leaking them)."""
+        self._error = exc
+        for iid, it in self._interceptors.items():
+            if iid != interceptor_id:
+                it.enqueue_message(InterceptorMessage(
+                    dst_id=iid, message_type=MessageType.STOP))
+
+    def start(self):
+        for it in self._interceptors.values():
+            it.start()
+        return self
+
+    def _dag_roots(self):
+        roots = [iid for iid, it in self._interceptors.items()
+                 if it.node is not None and not it.node.upstream]
+        return roots or list(self._interceptors)
+
+    def stop(self, entry_ids=None):
+        """Send STOP to the entry interceptors — by default the DAG roots
+        (no upstream), so the stop PROPAGATES down after any in-flight
+        DATA messages already queued ahead of it — and join everyone.
+        Pass entry_ids explicitly to abort specific actors immediately."""
+        targets = entry_ids if entry_ids is not None else self._dag_roots()
+        for iid in targets:
+            self.enqueue_interceptor_message(
+                InterceptorMessage(dst_id=iid,
+                                   message_type=MessageType.STOP))
+        self.wait()
+
+    def wait(self, timeout=30.0):
+        for it in self._interceptors.values():
+            it.join(timeout)
+        if self._error is not None:
+            raise RuntimeError(
+                "interceptor failed") from self._error
+
+
+class MessageBus:
+    """Routes messages between carriers by interceptor id (reference:
+    message_bus.h:36 — there over brpc endpoints; here between in-process
+    carriers, the control-plane scope of the TPU build)."""
+
+    def __init__(self):
+        self._route: Dict[int, Carrier] = {}
+
+    def register_carrier(self, carrier: Carrier,
+                         interceptor_ids) -> "MessageBus":
+        carrier.bus = self
+        for iid in interceptor_ids:
+            if iid in self._route:
+                raise ValueError(f"interceptor id {iid} already routed")
+            self._route[iid] = carrier
+        return self
+
+    def send(self, msg: InterceptorMessage) -> bool:
+        carrier = self._route.get(msg.dst_id)
+        if carrier is None:
+            raise KeyError(f"message bus: unknown dst {msg.dst_id}")
+        return carrier.enqueue_interceptor_message(msg)

@@ -57,6 +57,13 @@ class _CompiledProgram:
         if train:
             targets.add(program.optimize_directive[1].name)
         targets |= {name for _, name in program.buffer_updates}
+        # fetching a pass-removed var goes through its alias: keep the
+        # alias TARGET alive through the prune
+        aliases = getattr(program, "aliases", {})
+        for name in list(targets):
+            kind_ref = aliases.get(name)
+            if kind_ref is not None and kind_ref[0] != "const":
+                targets.add(kind_ref[1])
         self.ops, needed = prune_ops(program.ops, targets)
         self.rng_names = [n for n in program.rng_inputs if n in needed]
         self.buffer_updates = [(b, n) for b, n in program.buffer_updates
@@ -64,6 +71,7 @@ class _CompiledProgram:
         cap_ids = list(program.captured)
         self.cap_tensors = [program.captured[i] for i in cap_ids]
         self.cap_names = [program.capture_names[i] for i in cap_ids]
+        self.aliases = dict(getattr(program, "aliases", {}))
         if train:
             opt, loss_var = program.optimize_directive
             self.optimizer = opt
@@ -103,6 +111,13 @@ class _CompiledProgram:
             if not isinstance(outs, tuple):
                 outs = (outs,)
             env.update(zip(op.out_names, outs))
+        # vars removed by rewrite passes stay fetchable via their alias
+        for name, (kind, ref) in self.aliases.items():
+            if name not in env:
+                if kind == "const":
+                    env[name] = ref
+                elif ref in env:
+                    env[name] = env[ref]
         return env
 
     def _fetch(self, env):

@@ -78,6 +78,37 @@ def _rewire(ops, mapping):
                       for kind, ref in op.in_refs]
 
 
+def _resolve_chains(mapping):
+    """Chase removed-op chains so every mapping entry points at a
+    surviving ref (removed op feeding removed op). Shared by every
+    removal pass — hand-rolling this per pass is how dangling refs
+    happen."""
+    for k in list(mapping):
+        kind, ref = mapping[k]
+        while kind != "const" and ref in mapping:
+            kind, ref = mapping[ref]
+        mapping[k] = (kind, ref)
+    return mapping
+
+
+def _remove_and_rewire(program, mapping, drop_ids=None):
+    """Apply a removal pass's {removed_out: surviving_in_ref} mapping:
+    resolve chains, drop the ops, rewire consumers, and record ALIASES on
+    the program so a later fetch of a removed var still resolves (the
+    reference's delete passes protect the fetch set instead; here any var
+    can be fetched at run time)."""
+    _resolve_chains(mapping)
+    if drop_ids is None:
+        removed = set(mapping)
+        program.ops = [o for o in program.ops
+                       if not (set(o.out_names) & removed)]
+    else:
+        program.ops = [o for o in program.ops if id(o) not in drop_ids]
+    _rewire(program.ops, mapping)
+    program.aliases.update(mapping)
+    return program
+
+
 @register_pass("delete_dropout_pass")
 class DeleteDropoutPass(PassBase):
     """Remove dropout ops for inference programs, rewiring consumers to the
@@ -87,23 +118,12 @@ class DeleteDropoutPass(PassBase):
 
     def apply(self, program):
         mapping = {}
-        kept = []
         for op in program.ops:
             if op.op_type in self._DROPOUT_TYPES:
                 # out -> whatever fed the dropout's x
                 mapping[op.out_names[0]] = op.in_refs[0]
-            else:
-                kept.append(op)
-        # chase chains (dropout feeding dropout)
-        for k in list(mapping):
-            kind, ref = mapping[k]
-            while kind != "const" and ref in mapping:
-                kind, ref = mapping[ref]
-            mapping[k] = (kind, ref)
-        program.ops = kept
-        _rewire(program.ops, mapping)
         # stale rng feed vars are pruned by _CompiledProgram's backward slice
-        return program
+        return _remove_and_rewire(program, mapping)
 
 
 def _wrap_bf16(fn):
@@ -154,6 +174,135 @@ def _wrap_fake_quant(fn, weight_bits=8, activation_bits=8):
              for i, a in enumerate(arrays)]
         return fn(*q, **attrs)
     return wrapped
+
+
+@register_pass("identity_scale_clean_pass")
+class IdentityScaleCleanPass(PassBase):
+    """Remove no-op identity and scale(1.0, +0) ops, rewiring consumers
+    (reference: ir/identity_scale_op_clean_pass.cc) — loaded inference
+    programs accumulate these from API shims."""
+
+    def apply(self, program):
+        mapping = {}
+        for op in program.ops:
+            is_noop = (op.op_type == "identity"
+                       or (op.op_type in ("scale", "scale_op")
+                           and float(op.attrs.get("scale", 1.0)) == 1.0
+                           and float(op.attrs.get("bias", 0.0)) == 0.0))
+            if is_noop and len(op.out_names) == 1 and op.in_refs:
+                mapping[op.out_names[0]] = op.in_refs[0]
+        return _remove_and_rewire(program, mapping)
+
+
+@register_pass("transpose_cancel_pass")
+class TransposeCancelPass(PassBase):
+    """Cancel transpose pairs that compose to the identity permutation
+    (reference family: ir/transpose_flatten_concat_fuse_pass.cc and the
+    layout-clean passes) — a structural rewrite XLA only performs after
+    materializing both ops."""
+
+    def apply(self, program):
+        producer = {}
+        for op in program.ops:
+            for n in op.out_names:
+                producer[n] = op
+        # consumer count per var: only single-consumer chains are safe
+        uses: Dict[str, int] = {}
+        for op in program.ops:
+            for kind, ref in op.in_refs:
+                if kind != "const":
+                    uses[ref] = uses.get(ref, 0) + 1
+        mapping, drop = {}, set()
+        for op in program.ops:
+            if op.op_type != "transpose2":
+                continue
+            kind, ref = op.in_refs[0]
+            prev = producer.get(ref) if kind != "const" else None
+            if prev is None or prev.op_type != "transpose2" \
+                    or uses.get(ref, 0) != 1 or id(prev) in drop:
+                continue
+            p1 = list(prev.attrs.get("perm", ()))
+            p2 = list(op.attrs.get("perm", ()))
+            if len(p1) == len(p2) and \
+                    [p1[i] for i in p2] == list(range(len(p1))):
+                # pair output == pair input; chained pairs resolve
+                # transitively because the mapping target may itself be
+                # an earlier pair's (mapped) output
+                mapping[op.out_names[0]] = prev.in_refs[0]
+                drop.update((id(prev), id(op)))
+        return _remove_and_rewire(program, mapping, drop_ids=drop)
+
+
+# NOTE: the reference's constant_folding_pass (ir/constant_folding_pass.cc)
+# has no pass here BY CONSTRUCTION: stage_op runs var-free ops eagerly at
+# build time (program.py:265), so a staged program can never contain an op
+# whose inputs are all constants — folding happens at trace time.
+
+
+@register_pass("scale_merge_pass")
+class ScaleMergePass(PassBase):
+    """Collapse consecutive scale ops into one:
+    (x·s1+b1)·s2+b2 = x·(s1·s2) + (b1·s2+b2) (reference family:
+    ir/simplify_with_basic_ops_pass.cc arithmetic merges) — loss-scaling
+    and normalization shims stack these."""
+
+    _SCALE = ("scale", "scale_op")
+
+    def apply(self, program):
+        producer = {}
+        for op in program.ops:
+            for n in op.out_names:
+                producer[n] = op
+        uses: Dict[str, int] = {}
+        for op in program.ops:
+            for kind, ref in op.in_refs:
+                if kind != "const":
+                    uses[ref] = uses.get(ref, 0) + 1
+
+        def canon(op):
+            """(s, b) such that op == x·s + b."""
+            s = float(op.attrs.get("scale", 1.0))
+            b = float(op.attrs.get("bias", 0.0))
+            if not op.attrs.get("bias_after_scale", True):
+                b = s * b
+            return s, b
+
+        drop = set()
+        mapping: Dict[str, tuple] = {}
+        for op in program.ops:
+            if op.op_type not in self._SCALE or id(op) in drop:
+                continue
+            kind, ref = op.in_refs[0]
+            prev = producer.get(ref) if kind != "const" else None
+            if prev is None or prev.op_type not in self._SCALE \
+                    or uses.get(ref, 0) != 1 or id(prev) in drop:
+                continue
+            s1, b1 = canon(prev)
+            s2, b2 = canon(op)
+            op.attrs = dict(op.attrs, scale=s1 * s2, bias=b1 * s2 + b2,
+                            bias_after_scale=True)
+            op.in_refs = [prev.in_refs[0]]
+            drop.add(id(prev))
+            producer.pop(prev.out_names[0], None)
+        program.ops = [o for o in program.ops if id(o) not in drop]
+        return program
+
+
+@register_pass("delete_quant_pass")
+class DeleteQuantPass(PassBase):
+    """Strip serialized fake-quant(-dequant) ops, rewiring consumers to
+    the raw inputs (reference: ir/delete_quant_dequant_op_pass.cc) —
+    turns a quantized artifact back into its fp32-equivalent program."""
+
+    _PREFIX = "fake_quantize"
+
+    def apply(self, program):
+        mapping = {}
+        for op in program.ops:
+            if op.op_type.startswith(self._PREFIX) \
+                    or op.op_type.startswith("fake_channel_wise_quantize"):
+                mapping[op.out_names[0]] = op.in_refs[0]
+        return _remove_and_rewire(program, mapping)
 
 
 @register_pass("quant_insert_pass")

@@ -104,6 +104,10 @@ class Program:
         self.rng_inputs: List[str] = []  # var names fed fresh PRNG keys/run
         self.buffer_updates: List[Tuple[Tensor, str]] = []  # (buffer, var)
         self._feed_order: List[str] = []
+        # var aliases left by op-REMOVAL passes: removed_out -> (kind, ref)
+        # so a later fetch of the removed var still resolves (the
+        # reference's delete-passes protect the fetch set instead)
+        self.aliases: Dict[str, Tuple[str, object]] = {}
 
     # -- reference-API surface ----------------------------------------------
     def global_block(self):
@@ -127,6 +131,7 @@ class Program:
         p.version = self.version
         p._feed_order = list(self._feed_order)
         p.rng_inputs = list(self.rng_inputs)
+        p.aliases = dict(self.aliases)
         if not for_test:
             p.ops = list(self.ops)
             p.buffer_updates = list(self.buffer_updates)

@@ -118,3 +118,106 @@ class TestPasses:
         static.PassManager(["delete_dropout_pass"]).apply(prog)
         with pytest.raises(KeyError):
             static.apply_pass(prog, "no_such_pass")
+
+
+class TestNewRewritePasses:
+    """r4 pass-breadth additions: identity/scale clean, transpose-pair
+    cancellation, constant folding, fake-quant deletion (reference:
+    ir/identity_scale_op_clean_pass.cc, constant_folding_pass.cc,
+    delete_quant_dequant_op_pass.cc)."""
+
+    def _run(self, prog, feed, fetch):
+        exe = static.Executor()
+        return exe.run(prog, feed=feed, fetch_list=fetch)
+
+    def test_identity_scale_clean(self):
+        x = static.data("x", [-1, 3], "float32")
+        y = paddle.scale(x, scale=1.0, bias=0.0)   # no-op
+        z = paddle.scale(y, scale=2.0)             # real
+        prog = static.default_main_program()
+        n_before = len(prog.ops)
+        static.apply_pass(prog, "identity_scale_clean_pass")
+        assert len(prog.ops) == n_before - 1
+        a = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        (out,) = self._run(prog, {"x": a}, [z])
+        np.testing.assert_allclose(out, 2 * a, rtol=1e-6)
+
+    def test_transpose_cancel(self):
+        x = static.data("x", [-1, 2, 3], "float32")
+        t1 = paddle.transpose(x, [0, 2, 1])
+        t2 = paddle.transpose(t1, [0, 2, 1])       # cancels t1
+        z = paddle.scale(t2, scale=3.0)
+        prog = static.default_main_program()
+        static.apply_pass(prog, "transpose_cancel_pass")
+        assert not any(o.op_type == "transpose2" for o in prog.ops)
+        a = np.random.RandomState(1).randn(2, 2, 3).astype(np.float32)
+        (out,) = self._run(prog, {"x": a}, [z])
+        np.testing.assert_allclose(out, 3 * a, rtol=1e-6)
+
+    def test_transpose_pair_kept_when_not_inverse(self):
+        x = static.data("x", [-1, 2, 3], "float32")
+        t1 = paddle.transpose(x, [1, 0, 2])
+        t2 = paddle.transpose(t1, [0, 2, 1])       # NOT the inverse
+        prog = static.default_main_program()
+        n = sum(o.op_type == "transpose2" for o in prog.ops)
+        static.apply_pass(prog, "transpose_cancel_pass")
+        assert sum(o.op_type == "transpose2" for o in prog.ops) == n
+
+    def test_scale_merge(self):
+        x = static.data("x", [-1, 3], "float32")
+        y = paddle.scale(x, scale=2.0, bias=1.0)
+        z = paddle.scale(y, scale=3.0, bias=-0.5)
+        w = paddle.scale(z, scale=0.5)
+        prog = static.default_main_program()
+        assert sum(o.op_type in ("scale", "scale_op")
+                   for o in prog.ops) == 3
+        static.apply_pass(prog, "scale_merge_pass")
+        assert sum(o.op_type in ("scale", "scale_op")
+                   for o in prog.ops) == 1
+        a = np.random.RandomState(2).randn(2, 3).astype(np.float32)
+        (out,) = self._run(prog, {"x": a}, [w])
+        np.testing.assert_allclose(out, ((a * 2 + 1) * 3 - 0.5) * 0.5,
+                                   rtol=1e-5)
+
+    def test_transpose_cancel_chained_pairs(self):
+        """Two cancellable pairs back to back: chain resolution must not
+        leave dangling refs."""
+        x = static.data("x", [-1, 2, 3], "float32")
+        t = x
+        for _ in range(4):
+            t = paddle.transpose(t, [0, 2, 1])
+        z = paddle.scale(t, scale=2.0)
+        prog = static.default_main_program()
+        static.apply_pass(prog, "transpose_cancel_pass")
+        assert not any(o.op_type == "transpose2" for o in prog.ops)
+        a = np.random.RandomState(4).randn(2, 2, 3).astype(np.float32)
+        (out,) = self._run(prog, {"x": a}, [z])
+        np.testing.assert_allclose(out, 2 * a, rtol=1e-6)
+
+    def test_fetch_of_removed_var_resolves_via_alias(self):
+        """Fetching a var a removal pass deleted must still work (the
+        alias table replaces the reference's fetch-set protection)."""
+        x = static.data("x", [-1, 3], "float32")
+        y = paddle.scale(x, scale=1.0)             # no-op, gets removed
+        z = paddle.scale(y, scale=2.0)
+        prog = static.default_main_program()
+        static.apply_pass(prog, "identity_scale_clean_pass")
+        a = np.random.RandomState(5).randn(2, 3).astype(np.float32)
+        out_y, out_z = self._run(prog, {"x": a}, [y, z])
+        np.testing.assert_allclose(out_y, a, rtol=1e-6)
+        np.testing.assert_allclose(out_z, 2 * a, rtol=1e-6)
+
+    def test_delete_quant_pass_recovers_fp32(self):
+        from paddle_tpu.quantization import fake_quantize_dequantize_abs_max
+        x = static.data("x", [-1, 4], "float32")
+        q = fake_quantize_dequantize_abs_max(x)
+        z = paddle.scale(q, scale=1.5)
+        prog = static.default_main_program()
+        assert any(o.op_type.startswith("fake_quantize")
+                   for o in prog.ops)
+        static.apply_pass(prog, "delete_quant_pass")
+        assert not any(o.op_type.startswith("fake_quantize")
+                       for o in prog.ops)
+        a = np.random.RandomState(3).randn(2, 4).astype(np.float32)
+        (out,) = self._run(prog, {"x": a}, [z])
+        np.testing.assert_allclose(out, 1.5 * a, rtol=1e-6)
