@@ -27,6 +27,20 @@ PT_EXPORT void pt_arena_free(pt_arena_t, void* p);
 //        [4]=n_chunks [5]=peak_in_use
 PT_EXPORT void pt_arena_stats(pt_arena_t, uint64_t out[6]);
 
+// ---- strategy facade (AllocatorFacade analogue): base strategy
+// ("auto_growth" | "naive_best_fit") + hard byte limit + retry tier that
+// waits for frees up to retry_ms before failing -------------------------
+typedef void* pt_alloc_t;
+PT_EXPORT pt_alloc_t pt_allocator_create(const char* strategy,
+                                         size_t chunk_bytes,
+                                         size_t alignment,
+                                         uint64_t limit_bytes,
+                                         int retry_ms);
+PT_EXPORT void pt_allocator_destroy(pt_alloc_t);
+PT_EXPORT void* pt_allocator_alloc(pt_alloc_t, size_t bytes);
+PT_EXPORT void pt_allocator_free(pt_alloc_t, void* p);
+PT_EXPORT void pt_allocator_stats(pt_alloc_t, uint64_t out[6]);
+
 // ---- blocking bounded queue (DataLoader double-buffering) -----------------
 typedef void* pt_queue_t;
 PT_EXPORT pt_queue_t pt_queue_create(size_t capacity);

@@ -7,13 +7,23 @@
 // input pipeline (batch assembly before device_put). Same strategy as the
 // reference: carve from large chunks, best-fit on a size-ordered free map,
 // coalesce neighbours on free, grow by max(chunk, request).
+// The FACADE below (pt_allocator_*) mirrors the reference's
+// AllocatorFacade + FLAGS_allocator_strategy (memory/allocation/
+// allocator_facade.h:41): strategy-selected base allocator
+// ("auto_growth" = this arena; "naive_best_fit" = one fixed pool carved
+// up-front, no growth) with an optional RETRY tier (memory/allocation/
+// retry_allocator.cc) that blocks on a condition variable for frees
+// before failing, plus a hard byte limit making retry meaningful.
 #include "api.h"
 
+#include <chrono>
+#include <condition_variable>
 #include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
 #include <new>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -29,9 +39,25 @@ struct Block {
 
 class Arena {
  public:
-  Arena(size_t chunk_bytes, size_t alignment)
+  Arena(size_t chunk_bytes, size_t alignment, bool can_grow = true)
       : chunk_(chunk_bytes ? chunk_bytes : (8u << 20)),
-        align_(alignment ? alignment : 64) {}
+        align_(alignment ? alignment : 64), can_grow_(can_grow) {}
+
+  // naive_best_fit support: reserve the first chunk, then freeze
+  void Preallocate() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!chunks_.empty()) return;
+    Block* b = Grow(1);
+    if (b) free_by_size_.insert({{b->size, b}, b});
+    can_grow_ = false;
+  }
+
+  // hard cap on in-use bytes, enforced under the SAME mutex as the
+  // accounting (a facade-side check would be a TOCTOU under concurrency)
+  void SetLimit(uint64_t limit_bytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    limit_ = limit_bytes;
+  }
 
   ~Arena() {
     // every Block lives in exactly one of the two maps
@@ -43,9 +69,11 @@ class Arena {
   void* Alloc(size_t bytes) {
     std::lock_guard<std::mutex> g(mu_);
     bytes = Align(bytes ? bytes : 1);
+    if (limit_ && in_use_ + bytes > limit_) return nullptr;
     auto it = free_by_size_.lower_bound({bytes, nullptr});
     Block* b;
     if (it == free_by_size_.end()) {
+      if (!can_grow_) return nullptr;  // fixed pool exhausted
       b = Grow(bytes);
       if (!b) return nullptr;
     } else {
@@ -127,6 +155,8 @@ class Arena {
 
   std::mutex mu_;
   size_t chunk_, align_;
+  bool can_grow_ = true;
+  uint64_t limit_ = 0;
   std::vector<void*> chunks_;
   // (size, block) ordered set = best-fit lookup via lower_bound
   std::map<std::pair<size_t, Block*>, Block*> free_by_size_;
@@ -135,12 +165,103 @@ class Arena {
   uint64_t n_allocs_ = 0, n_frees_ = 0;
 };
 
+// ---- strategy facade with limit + retry tier ------------------------------
+
+class Allocator {
+ public:
+  // strategy: "auto_growth" grows by chunks on demand; "naive_best_fit"
+  // carves ONE pool of limit_bytes up-front and never grows (the
+  // reference's pre-allocated-pool strategy).
+  Allocator(const std::string& strategy, size_t chunk_bytes,
+            size_t alignment, uint64_t limit_bytes, int retry_ms)
+      : arena_(strategy == "naive_best_fit" && limit_bytes
+                   ? limit_bytes : chunk_bytes,
+               alignment),
+        limit_(limit_bytes), retry_ms_(retry_ms) {
+    if (strategy == "naive_best_fit" && limit_bytes) {
+      arena_.Preallocate();  // one fixed pool, growth frozen
+    }
+  }
+
+  void* Alloc(size_t bytes) {
+    void* p = TryAlloc(bytes);
+    if (p || retry_ms_ <= 0) return p;
+    // retry tier: wait for frees up to the deadline (reference:
+    // RetryAllocator::AllocateImpl wait_event logic)
+    std::unique_lock<std::mutex> lk(retry_mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(retry_ms_);
+    while (std::chrono::steady_clock::now() < deadline) {
+      retry_cv_.wait_until(lk, deadline);
+      p = TryAlloc(bytes);
+      if (p) return p;
+    }
+    return nullptr;
+  }
+
+  void Free(void* p) {
+    {
+      std::lock_guard<std::mutex> g(size_mu_);
+      auto it = sizes_.find(p);
+      if (it != sizes_.end()) {
+        outstanding_ -= it->second;
+        sizes_.erase(it);
+      }
+    }
+    arena_.Free(p);
+    retry_cv_.notify_all();
+  }
+
+  void Stats(uint64_t out[6]) { arena_.Stats(out); }
+
+ private:
+  void* TryAlloc(size_t bytes) {
+    {
+      std::lock_guard<std::mutex> g(size_mu_);
+      if (limit_ && outstanding_ + bytes > limit_) return nullptr;
+    }
+    void* p = arena_.Alloc(bytes);
+    if (p) {
+      std::lock_guard<std::mutex> g(size_mu_);
+      sizes_[p] = bytes;
+      outstanding_ += bytes;
+    }
+    return p;
+  }
+
+  Arena arena_;
+  uint64_t limit_;
+  int retry_ms_;
+  std::mutex size_mu_, retry_mu_;
+  std::condition_variable retry_cv_;
+  std::unordered_map<void*, size_t> sizes_;
+  uint64_t outstanding_ = 0;
+};
+
 }  // namespace
 
 extern "C" {
 
 pt_arena_t pt_arena_create(size_t chunk_bytes, size_t alignment) {
   return new (std::nothrow) Arena(chunk_bytes, alignment);
+}
+
+pt_alloc_t pt_allocator_create(const char* strategy, size_t chunk_bytes,
+                               size_t alignment, uint64_t limit_bytes,
+                               int retry_ms) {
+  return new (std::nothrow) Allocator(strategy ? strategy : "auto_growth",
+                                      chunk_bytes, alignment, limit_bytes,
+                                      retry_ms);
+}
+void pt_allocator_destroy(pt_alloc_t a) { delete static_cast<Allocator*>(a); }
+void* pt_allocator_alloc(pt_alloc_t a, size_t bytes) {
+  return static_cast<Allocator*>(a)->Alloc(bytes);
+}
+void pt_allocator_free(pt_alloc_t a, void* p) {
+  static_cast<Allocator*>(a)->Free(p);
+}
+void pt_allocator_stats(pt_alloc_t a, uint64_t out[6]) {
+  static_cast<Allocator*>(a)->Stats(out);
 }
 void pt_arena_destroy(pt_arena_t a) { delete static_cast<Arena*>(a); }
 void* pt_arena_alloc(pt_arena_t a, size_t bytes) {

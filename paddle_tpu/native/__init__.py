@@ -66,6 +66,16 @@ def _load():
         lib.pt_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
         lib.pt_arena_stats.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_uint64)]
+        lib.pt_allocator_create.restype = ctypes.c_void_p
+        lib.pt_allocator_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_uint64, ctypes.c_int]
+        lib.pt_allocator_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_allocator_alloc.restype = ctypes.c_void_p
+        lib.pt_allocator_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        lib.pt_allocator_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.pt_allocator_stats.argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(ctypes.c_uint64)]
         lib.pt_queue_create.restype = ctypes.c_void_p
         lib.pt_queue_create.argtypes = [ctypes.c_size_t]
         lib.pt_queue_destroy.argtypes = [ctypes.c_void_p]
@@ -140,6 +150,49 @@ class HostArena:
     def __del__(self):
         if getattr(self, "_h", None):
             self._lib.pt_arena_destroy(self._h)
+            self._h = None
+
+
+class HostAllocator:
+    """Strategy-selected host allocator with limit + retry tier
+    (reference: memory/allocation/allocator_facade.h:41 AllocatorFacade
+    over FLAGS_allocator_strategy, retry_allocator.cc).
+
+    strategy: "auto_growth" (grow by chunks on demand) or
+    "naive_best_fit" (one fixed pool of `limit_bytes`, no growth).
+    `retry_ms` > 0 makes a failed allocation WAIT for concurrent frees
+    up to the deadline before raising (the reference's RetryAllocator)."""
+
+    def __init__(self, strategy="auto_growth", chunk_bytes=8 << 20,
+                 alignment=64, limit_bytes=0, retry_ms=0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        if strategy not in ("auto_growth", "naive_best_fit"):
+            raise ValueError(f"unknown allocator strategy {strategy!r}")
+        self._lib = lib
+        self._h = lib.pt_allocator_create(strategy.encode(), chunk_bytes,
+                                          alignment, limit_bytes, retry_ms)
+
+    def alloc(self, nbytes: int) -> int:
+        p = self._lib.pt_allocator_alloc(self._h, nbytes)
+        if not p:
+            raise MemoryError(f"allocator alloc of {nbytes} failed "
+                              "(limit/pool exhausted after retry window)")
+        return p
+
+    def free(self, ptr: int):
+        self._lib.pt_allocator_free(self._h, ptr)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 6)()
+        self._lib.pt_allocator_stats(self._h, out)
+        return {"reserved": out[0], "in_use": out[1], "allocs": out[2],
+                "frees": out[3], "chunks": out[4], "peak": out[5]}
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_allocator_destroy(self._h)
             self._h = None
 
 

@@ -205,3 +205,75 @@ def test_queue_dataset_matches_python_fallback(tmp_path):
         return [([o.tolist(), v.tolist()]) for b in it for o, v in b]
 
     np.testing.assert_equal(run(True), run(False))
+
+
+class TestHostAllocatorFacade:
+    """Strategy facade + retry tier (r4; reference:
+    allocator_facade.h:41, retry_allocator.cc)."""
+
+    def _need(self):
+        from paddle_tpu import native
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        return native
+
+    def test_auto_growth_with_limit(self):
+        native = self._need()
+        a = native.HostAllocator("auto_growth", chunk_bytes=1 << 16,
+                                 limit_bytes=1 << 20)
+        p1 = a.alloc(512 << 10)
+        with pytest.raises(MemoryError):
+            a.alloc(600 << 10)          # would exceed the 1 MB limit
+        a.free(p1)
+        p2 = a.alloc(600 << 10)         # fits again after the free
+        a.free(p2)
+        s = a.stats()
+        assert s["allocs"] >= 2 and s["in_use"] == 0
+
+    def test_naive_pool_never_grows(self):
+        native = self._need()
+        a = native.HostAllocator("naive_best_fit", limit_bytes=256 << 10)
+        assert a.stats()["chunks"] == 1    # pool carved up-front
+        p = a.alloc(200 << 10)
+        with pytest.raises(MemoryError):
+            a.alloc(200 << 10)             # pool exhausted, no growth
+        a.free(p)
+        assert a.stats()["chunks"] == 1
+
+    def test_retry_tier_waits_for_concurrent_free(self):
+        import threading
+        import time
+        native = self._need()
+        a = native.HostAllocator("auto_growth", limit_bytes=1 << 20,
+                                 retry_ms=2000)
+        p = a.alloc(900 << 10)
+
+        def free_later():
+            time.sleep(0.3)
+            a.free(p)
+
+        t = threading.Thread(target=free_later)
+        t.start()
+        t0 = time.time()
+        p2 = a.alloc(900 << 10)   # blocks until the free, then succeeds
+        waited = time.time() - t0
+        t.join()
+        a.free(p2)
+        assert 0.2 < waited < 2.0
+
+    def test_retry_tier_gives_up_after_deadline(self):
+        import time
+        native = self._need()
+        a = native.HostAllocator("auto_growth", limit_bytes=64 << 10,
+                                 retry_ms=300)
+        p = a.alloc(60 << 10)
+        t0 = time.time()
+        with pytest.raises(MemoryError):
+            a.alloc(60 << 10)
+        assert time.time() - t0 >= 0.25
+        a.free(p)
+
+    def test_bad_strategy_rejected(self):
+        native = self._need()
+        with pytest.raises(ValueError, match="strategy"):
+            native.HostAllocator("buddy")
