@@ -127,32 +127,19 @@ def _probe_tpu(timeout_s=150.0):
 
 
 def _run_bench_child(force_cpu, timeout_s=900.0):
-    """Run the bench body in a timed child. Returns (json_line|None, err)."""
-    import subprocess
-    import sys
+    """Run the bench body in a timed child (shared salvage logic lives in
+    tpu_capture.run_timed_child). Returns (json_line|None, err)."""
+    from tpu_capture import run_timed_child
 
     extra = {"_PT_BENCH_FORCE_CPU": "1"} if force_cpu else {}
-    env = dict(os.environ, _PT_BENCH_CHILD="1", **extra)
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env,
-            capture_output=True, text=True, timeout=timeout_s)
-        line = _last_json_line(out.stdout)
-        if line is None:
-            return None, (f"child rc={out.returncode}, no JSON; stderr "
-                          "tail: " + out.stderr[-300:].replace("\n", " "))
-        return line, None
-    except subprocess.TimeoutExpired as e:
-        # the bench may have printed its result before hanging in backend
-        # teardown — salvage captured stdout (bytes even in text mode on
-        # some CPython versions)
-        captured = e.stdout or ""
-        if isinstance(captured, bytes):
-            captured = captured.decode("utf-8", "replace")
-        line = _last_json_line(captured)
-        if line is None:
-            return None, "child timed out (backend hang?)"
-        return line, None
+    stdout, stderr_tail, err = run_timed_child(
+        [sys.executable, os.path.abspath(__file__)], timeout_s,
+        env=dict(_PT_BENCH_CHILD="1", **extra))
+    line = _last_json_line(stdout)
+    if line is None:
+        return None, "%s; stderr tail: %s" % (
+            err or "no JSON result line", stderr_tail.replace("\n", " "))
+    return line, None
 
 
 def _latest_tpu_capture():

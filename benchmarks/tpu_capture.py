@@ -42,26 +42,43 @@ def probe_tpu(timeout_s: float = 150.0) -> bool:
         return False
 
 
+def run_timed_child(cmd, timeout_s: float, env=None):
+    """Run `cmd` in a timed child; returns
+    (stdout_text, stderr_tail, err_note|None).
+
+    Shared by bench.py and the capture path: the stdout SALVAGE on timeout
+    matters — a bench may print its result line and then hang in backend
+    teardown (e.stdout arrives as bytes on some CPython versions). The
+    stderr tail is returned even on rc==0 so a silent no-result child
+    stays diagnosable."""
+    def _text(v):
+        return v.decode("utf-8", "replace") if isinstance(v, bytes) \
+            else (v or "")
+
+    try:
+        out = subprocess.run(
+            cmd, env=dict(os.environ, **(env or {})), capture_output=True,
+            text=True, timeout=timeout_s, cwd=_ROOT)
+    except subprocess.TimeoutExpired as e:
+        return (_text(e.stdout), _text(e.stderr)[-300:],
+                "child timed out (salvaged stdout)")
+    err = None
+    if out.returncode != 0:
+        err = "child rc=%d" % out.returncode
+    return out.stdout, out.stderr[-300:], err
+
+
 def _run_suite_child(which: str, timeout_s: float):
     """Run `python benchmarks/train_bench.py <which>` in a timed child,
     returning (list-of-parsed-json-lines, err)."""
-    try:
-        out = subprocess.run(
-            [sys.executable,
-             os.path.join(_ROOT, "benchmarks", "train_bench.py"), which],
-            env=dict(os.environ), capture_output=True, text=True,
-            timeout=timeout_s, cwd=_ROOT)
-    except subprocess.TimeoutExpired as e:
-        captured = e.stdout or ""
-        if isinstance(captured, bytes):
-            captured = captured.decode("utf-8", "replace")
-        lines = _parse_lines(captured)
-        return lines, "suite child timed out (salvaged %d lines)" % len(lines)
-    lines = _parse_lines(out.stdout)
-    err = None
+    stdout, stderr_tail, err = run_timed_child(
+        [sys.executable,
+         os.path.join(_ROOT, "benchmarks", "train_bench.py"), which],
+        timeout_s)
+    lines = _parse_lines(stdout)
     if not lines:
-        err = ("suite rc=%d, no JSON; stderr tail: " % out.returncode
-               + out.stderr[-300:].replace("\n", " "))
+        err = "%s; stderr tail: %s" % (err or "no JSON in child stdout",
+                                       stderr_tail.replace("\n", " "))
     return lines, err
 
 

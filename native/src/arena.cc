@@ -16,6 +16,7 @@
 // before failing, plus a hard byte limit making retry meaningful.
 #include "api.h"
 
+#include <algorithm>
 #include <chrono>
 #include <condition_variable>
 #include <cstdlib>
@@ -69,8 +70,20 @@ class Arena {
   void* Alloc(size_t bytes) {
     std::lock_guard<std::mutex> g(mu_);
     bytes = Align(bytes ? bytes : 1);
+    // fast-path refusal with the aligned request (a lower bound on what
+    // the block will actually charge) — avoids growing a chunk that the
+    // precise check below would reject anyway
     if (limit_ && in_use_ + bytes > limit_) return nullptr;
+    // the chosen block charges its ACTUAL size: `bytes` if it splits, the
+    // whole (possibly larger, unsplittable) block otherwise — the limit
+    // gates on that, not the request. An unsplittable block that would
+    // bust the limit is SKIPPED, not fatal: a larger splittable block
+    // further up charges exactly `bytes` and may still fit.
     auto it = free_by_size_.lower_bound({bytes, nullptr});
+    while (it != free_by_size_.end() && limit_ &&
+           in_use_ + TakeOf(it->second, bytes) > limit_ &&
+           it->second->size < bytes + align_)
+      ++it;
     Block* b;
     if (it == free_by_size_.end()) {
       if (!can_grow_) return nullptr;  // fixed pool exhausted
@@ -79,6 +92,10 @@ class Arena {
     } else {
       b = it->second;
       free_by_size_.erase(it);
+    }
+    if (limit_ && in_use_ + TakeOf(b, bytes) > limit_) {
+      free_by_size_.insert({{b->size, b}, b});  // put the block back
+      return nullptr;
     }
     if (b->size >= bytes + align_) {  // split the tail back to free list
       Block* tail = new Block{b->ptr + bytes, b->size - bytes, true,
@@ -140,6 +157,11 @@ class Arena {
  private:
   size_t Align(size_t n) const { return (n + align_ - 1) & ~(align_ - 1); }
 
+  // bytes actually charged if `b` serves an (aligned) request of `bytes`
+  size_t TakeOf(const Block* b, size_t bytes) const {
+    return b->size >= bytes + align_ ? bytes : b->size;
+  }
+
   void EraseFree(Block* b) { free_by_size_.erase({b->size, b}); }
 
   Block* Grow(size_t need) {
@@ -170,15 +192,21 @@ class Arena {
 class Allocator {
  public:
   // strategy: "auto_growth" grows by chunks on demand; "naive_best_fit"
-  // carves ONE pool of limit_bytes up-front and never grows (the
-  // reference's pre-allocated-pool strategy).
+  // carves ONE pool up-front (limit_bytes, or chunk_bytes when no limit
+  // is given) and NEVER grows — the pool is fixed even without a limit,
+  // matching the documented semantics.
   Allocator(const std::string& strategy, size_t chunk_bytes,
             size_t alignment, uint64_t limit_bytes, int retry_ms)
       : arena_(strategy == "naive_best_fit" && limit_bytes
                    ? limit_bytes : chunk_bytes,
                alignment),
-        limit_(limit_bytes), retry_ms_(retry_ms) {
-    if (strategy == "naive_best_fit" && limit_bytes) {
+        retry_ms_(retry_ms) {
+    // the limit is enforced INSIDE the arena, under the same mutex as the
+    // in-use accounting and against ACTUAL block sizes (incl. unsplit
+    // best-fit slack) — a facade-side byte counter would be both a TOCTOU
+    // under concurrency and an undercount
+    if (limit_bytes) arena_.SetLimit(limit_bytes);
+    if (strategy == "naive_best_fit") {
       arena_.Preallocate();  // one fixed pool, growth frozen
     }
   }
@@ -187,55 +215,38 @@ class Allocator {
     void* p = TryAlloc(bytes);
     if (p || retry_ms_ <= 0) return p;
     // retry tier: wait for frees up to the deadline (reference:
-    // RetryAllocator::AllocateImpl wait_event logic)
+    // RetryAllocator::AllocateImpl wait_event logic). TryAlloc runs again
+    // under retry_mu_ BEFORE the first wait: Free takes retry_mu_ before
+    // notifying, so a free landing after the lock-free TryAlloc above
+    // cannot slip between our re-check and the wait (lost wakeup).
     std::unique_lock<std::mutex> lk(retry_mu_);
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(retry_ms_);
-    while (std::chrono::steady_clock::now() < deadline) {
-      retry_cv_.wait_until(lk, deadline);
+    for (;;) {
       p = TryAlloc(bytes);
       if (p) return p;
+      if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+      retry_cv_.wait_until(lk, deadline);
     }
-    return nullptr;
   }
 
   void Free(void* p) {
-    {
-      std::lock_guard<std::mutex> g(size_mu_);
-      auto it = sizes_.find(p);
-      if (it != sizes_.end()) {
-        outstanding_ -= it->second;
-        sizes_.erase(it);
-      }
-    }
     arena_.Free(p);
+    // pairing with the waiter's locked re-check (holds no other lock here,
+    // so the retry_mu_ -> arena-mutex order in Alloc can't deadlock)
+    { std::lock_guard<std::mutex> g(retry_mu_); }
     retry_cv_.notify_all();
   }
 
   void Stats(uint64_t out[6]) { arena_.Stats(out); }
 
  private:
-  void* TryAlloc(size_t bytes) {
-    {
-      std::lock_guard<std::mutex> g(size_mu_);
-      if (limit_ && outstanding_ + bytes > limit_) return nullptr;
-    }
-    void* p = arena_.Alloc(bytes);
-    if (p) {
-      std::lock_guard<std::mutex> g(size_mu_);
-      sizes_[p] = bytes;
-      outstanding_ += bytes;
-    }
-    return p;
-  }
+  void* TryAlloc(size_t bytes) { return arena_.Alloc(bytes); }
 
   Arena arena_;
-  uint64_t limit_;
   int retry_ms_;
-  std::mutex size_mu_, retry_mu_;
+  std::mutex retry_mu_;
   std::condition_variable retry_cv_;
-  std::unordered_map<void*, size_t> sizes_;
-  uint64_t outstanding_ = 0;
 };
 
 }  // namespace

@@ -159,9 +159,10 @@ class HostAllocator:
     over FLAGS_allocator_strategy, retry_allocator.cc).
 
     strategy: "auto_growth" (grow by chunks on demand) or
-    "naive_best_fit" (one fixed pool of `limit_bytes`, no growth).
-    `retry_ms` > 0 makes a failed allocation WAIT for concurrent frees
-    up to the deadline before raising (the reference's RetryAllocator)."""
+    "naive_best_fit" (one fixed pool carved up-front — `limit_bytes` if
+    given, else `chunk_bytes` — and NEVER grown). `retry_ms` > 0 makes a
+    failed allocation WAIT for concurrent frees up to the deadline before
+    raising (the reference's RetryAllocator)."""
 
     def __init__(self, strategy="auto_growth", chunk_bytes=8 << 20,
                  alignment=64, limit_bytes=0, retry_ms=0):

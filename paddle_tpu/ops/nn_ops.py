@@ -226,7 +226,11 @@ def _conv_im2col(x, w, stride, pad, dilation, channel_last):
     else:              # patches [N, cin*k, *sp]
         out = jnp.einsum("nf...,of->no...", patches, w2,
                          preferred_element_type=jnp.float32)
-    return out.astype(x.dtype) if x.dtype != jnp.bfloat16 else out
+    # dtype contract matches the direct path below: bf16 convs return f32
+    # (the explicit BN-stats upcast), every other dtype rounds back to
+    # x.dtype after the f32 accumulation — flipping FLAGS_conv_algo must
+    # never change a model's activation dtypes
+    return out if x.dtype == jnp.bfloat16 else out.astype(x.dtype)
 
 
 @primitive("conv2d_op")

@@ -227,9 +227,13 @@ class TransposeCancelPass(PassBase):
                     [p1[i] for i in p2] == list(range(len(p1))):
                 # pair output == pair input; chained pairs resolve
                 # transitively because the mapping target may itself be
-                # an earlier pair's (mapped) output
+                # an earlier pair's (mapped) output. Only the SECOND
+                # transpose is dropped: the first stays as a dead producer
+                # so its output (a genuinely transposed value, NOT
+                # aliasable to the pair input) remains fetchable; the
+                # executor's backward slice prunes it when unfetched.
                 mapping[op.out_names[0]] = prev.in_refs[0]
-                drop.update((id(prev), id(op)))
+                drop.add(id(op))
         return _remove_and_rewire(program, mapping, drop_ids=drop)
 
 
@@ -267,24 +271,24 @@ class ScaleMergePass(PassBase):
                 b = s * b
             return s, b
 
-        drop = set()
-        mapping: Dict[str, tuple] = {}
         for op in program.ops:
-            if op.op_type not in self._SCALE or id(op) in drop:
+            if op.op_type not in self._SCALE:
                 continue
             kind, ref = op.in_refs[0]
             prev = producer.get(ref) if kind != "const" else None
             if prev is None or prev.op_type not in self._SCALE \
-                    or uses.get(ref, 0) != 1 or id(prev) in drop:
+                    or uses.get(ref, 0) != 1:
                 continue
             s1, b1 = canon(prev)
             s2, b2 = canon(op)
             op.attrs = dict(op.attrs, scale=s1 * s2, bias=b1 * s2 + b2,
                             bias_after_scale=True)
             op.in_refs = [prev.in_refs[0]]
-            drop.add(id(prev))
-            producer.pop(prev.out_names[0], None)
-        program.ops = [o for o in program.ops if id(o) not in drop]
+            # prev is NOT removed: it becomes a dead op the executor's
+            # backward slice prunes, but its output stays fetchable (its
+            # value is not expressible as an alias of any surviving var).
+            # Chained merges stay correct: an in-place-merged scale
+            # computes the same value its output always held.
         return program
 
 

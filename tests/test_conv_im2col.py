@@ -81,3 +81,21 @@ def test_im2col_grouped_falls_back():
         set_flags({"FLAGS_conv_algo": "direct"})
     np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=2e-4,
                                rtol=2e-4)
+
+
+def test_im2col_dtype_parity_with_direct():
+    """Flipping FLAGS_conv_algo must not change activation dtypes (r4
+    advisor finding): bf16 in -> f32 out on BOTH paths (the BN-stats
+    upcast), f16/f32 round back to the input dtype on both."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.nn_ops import conv
+    for dt in (jnp.float32, jnp.bfloat16, jnp.float16):
+        x = jnp.ones((1, 3, 8, 8), dt)
+        w = jnp.ones((4, 3, 3, 3), dt)
+        outs = {algo: conv.fn(x, w, stride=(1, 1), padding=(1, 1),
+                              dilation=(1, 1), groups=1, channel_last=False,
+                              algo=algo)
+                for algo in ("direct", "im2col")}
+        assert outs["direct"].dtype == outs["im2col"].dtype, dt
+        expect = jnp.float32 if dt == jnp.bfloat16 else dt
+        assert outs["direct"].dtype == expect, dt

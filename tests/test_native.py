@@ -240,6 +240,38 @@ class TestHostAllocatorFacade:
         a.free(p)
         assert a.stats()["chunks"] == 1
 
+    def test_naive_pool_without_limit_is_still_fixed(self):
+        """naive_best_fit with no limit must carve ONE chunk_bytes pool
+        and freeze growth — not silently degrade to a growing arena (r4
+        advisor finding)."""
+        native = self._need()
+        a = native.HostAllocator("naive_best_fit", chunk_bytes=256 << 10)
+        assert a.stats()["chunks"] == 1     # pool carved up-front
+        p = a.alloc(200 << 10)
+        with pytest.raises(MemoryError):
+            a.alloc(200 << 10)              # pool exhausted, no growth
+        a.free(p)
+        assert a.stats()["chunks"] == 1
+
+    def test_limit_accounts_aligned_sizes(self):
+        """The limit gate tracks ALIGNED sizes: many odd-sized blocks must
+        not let real arena usage exceed limit_bytes by alignment slack (r4
+        advisor finding)."""
+        native = self._need()
+        limit = 64 << 10
+        a = native.HostAllocator("auto_growth", chunk_bytes=1 << 16,
+                                 alignment=256, limit_bytes=limit)
+        ptrs = []
+        try:
+            while True:
+                ptrs.append(a.alloc(1))     # 1 byte requested, 256 used
+        except MemoryError:
+            pass
+        assert len(ptrs) <= limit // 256    # raw-byte accounting -> 64k
+        assert a.stats()["in_use"] <= limit
+        for p in ptrs:
+            a.free(p)
+
     def test_retry_tier_waits_for_concurrent_free(self):
         import threading
         import time

@@ -142,6 +142,16 @@ class TestNewRewritePasses:
         (out,) = self._run(prog, {"x": a}, [z])
         np.testing.assert_allclose(out, 2 * a, rtol=1e-6)
 
+    @staticmethod
+    def _compiled_ops(prog, fetch):
+        """Ops surviving the executor's backward slice for `fetch` — what
+        actually compiles (dead first-of-pair producers are kept in
+        prog.ops only so their outputs stay fetchable)."""
+        from paddle_tpu.static.program import prune_ops
+        targets = {v.name for v in fetch}
+        ops, _ = prune_ops(prog.ops, targets)
+        return ops
+
     def test_transpose_cancel(self):
         x = static.data("x", [-1, 2, 3], "float32")
         t1 = paddle.transpose(x, [0, 2, 1])
@@ -149,10 +159,27 @@ class TestNewRewritePasses:
         z = paddle.scale(t2, scale=3.0)
         prog = static.default_main_program()
         static.apply_pass(prog, "transpose_cancel_pass")
-        assert not any(o.op_type == "transpose2" for o in prog.ops)
+        assert not any(o.op_type == "transpose2"
+                       for o in self._compiled_ops(prog, [z]))
         a = np.random.RandomState(1).randn(2, 2, 3).astype(np.float32)
         (out,) = self._run(prog, {"x": a}, [z])
         np.testing.assert_allclose(out, 3 * a, rtol=1e-6)
+
+    def test_transpose_cancel_intermediate_stays_fetchable(self):
+        """The pair's intermediate holds a genuinely TRANSPOSED value — it
+        cannot be aliased to the pair input, so the first transpose stays
+        as a dead producer and fetching it still computes it (r4 advisor
+        finding)."""
+        x = static.data("x", [-1, 2, 3], "float32")
+        t1 = paddle.transpose(x, [0, 2, 1])
+        t2 = paddle.transpose(t1, [0, 2, 1])
+        z = paddle.scale(t2, scale=3.0)
+        prog = static.default_main_program()
+        static.apply_pass(prog, "transpose_cancel_pass")
+        a = np.random.RandomState(7).randn(2, 2, 3).astype(np.float32)
+        out_t1, out_z = self._run(prog, {"x": a}, [t1, z])
+        np.testing.assert_allclose(out_t1, a.transpose(0, 2, 1), rtol=1e-6)
+        np.testing.assert_allclose(out_z, 3 * a, rtol=1e-6)
 
     def test_transpose_pair_kept_when_not_inverse(self):
         x = static.data("x", [-1, 2, 3], "float32")
@@ -172,11 +199,30 @@ class TestNewRewritePasses:
         assert sum(o.op_type in ("scale", "scale_op")
                    for o in prog.ops) == 3
         static.apply_pass(prog, "scale_merge_pass")
+        # the merged-into op carries the whole chain; predecessors stay as
+        # dead producers (fetchable) but fall out of the compiled slice
         assert sum(o.op_type in ("scale", "scale_op")
-                   for o in prog.ops) == 1
+                   for o in self._compiled_ops(prog, [w])) == 1
         a = np.random.RandomState(2).randn(2, 3).astype(np.float32)
         (out,) = self._run(prog, {"x": a}, [w])
         np.testing.assert_allclose(out, ((a * 2 + 1) * 3 - 0.5) * 0.5,
+                                   rtol=1e-5)
+
+    def test_scale_merge_intermediates_stay_fetchable(self):
+        """A merged-away scale's output (x·s1+b1) is not an alias of any
+        surviving var; it must still be computable on fetch (r4 advisor
+        finding)."""
+        x = static.data("x", [-1, 3], "float32")
+        y = paddle.scale(x, scale=2.0, bias=1.0)
+        z = paddle.scale(y, scale=3.0, bias=-0.5)
+        w = paddle.scale(z, scale=0.5)
+        prog = static.default_main_program()
+        static.apply_pass(prog, "scale_merge_pass")
+        a = np.random.RandomState(8).randn(2, 3).astype(np.float32)
+        out_y, out_z, out_w = self._run(prog, {"x": a}, [y, z, w])
+        np.testing.assert_allclose(out_y, a * 2 + 1, rtol=1e-5)
+        np.testing.assert_allclose(out_z, (a * 2 + 1) * 3 - 0.5, rtol=1e-5)
+        np.testing.assert_allclose(out_w, ((a * 2 + 1) * 3 - 0.5) * 0.5,
                                    rtol=1e-5)
 
     def test_transpose_cancel_chained_pairs(self):
@@ -189,7 +235,8 @@ class TestNewRewritePasses:
         z = paddle.scale(t, scale=2.0)
         prog = static.default_main_program()
         static.apply_pass(prog, "transpose_cancel_pass")
-        assert not any(o.op_type == "transpose2" for o in prog.ops)
+        assert not any(o.op_type == "transpose2"
+                       for o in self._compiled_ops(prog, [z]))
         a = np.random.RandomState(4).randn(2, 2, 3).astype(np.float32)
         (out,) = self._run(prog, {"x": a}, [z])
         np.testing.assert_allclose(out, 2 * a, rtol=1e-6)
