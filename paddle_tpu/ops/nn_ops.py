@@ -761,6 +761,30 @@ def zero_pad(x, *, padding, channel_last=False):
 
 
 # ---------------------------------------------------------------------------
+# fused inference primitives emitted by the export-time fusion passes
+# (static/passes.py fc_fuse_pass / fuse_elewise_add_act_pass — reference:
+# ir/fc_fuse_pass.cc:1, ir/fuse_elewise_add_act_pass.cc:1). At run time XLA
+# fuses these anyway; the win is a smaller exported artifact and a single
+# quantizable matmul site for the int8 path.
+
+
+@primitive("fc_op")
+def fc(x, w, b, *, transpose_x=False, transpose_y=False):
+    if transpose_x and x.ndim > 1:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y and w.ndim > 1:
+        w = jnp.swapaxes(w, -1, -2)
+    return jnp.matmul(x, w) + b
+
+
+@primitive("fused_elemwise_add_act")
+def fused_add_act(x, y, *, act="relu", act_attrs=None):
+    from ..framework.dispatch import OPS
+
+    return OPS[act].fn(jnp.add(x, y), **(act_attrs or {}))
+
+
+# ---------------------------------------------------------------------------
 # scaled dot-product attention (plain XLA path; the Pallas flash kernel in
 # ops/pallas_kernels.py takes over on TPU for long sequences — reference
 # analogue: operators/fused/fused_attention_op.cu / multihead_matmul_op.cu)

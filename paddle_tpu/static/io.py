@@ -16,8 +16,17 @@ from ..framework.tensor import Tensor
 
 def _program_payload(program, feed_vars, fetch_vars):
     from .program import prune_ops
-    kept, needed = prune_ops(program.ops,
-                             {v.name for v in fetch_vars})
+    # a fetch var removed by a cleanup pass resolves through the alias
+    # table; the alias TARGETS must survive the prune and the aliases must
+    # ship in the artifact (else the loaded program has no producer for
+    # the fetch name — r5 review finding)
+    aliases = dict(getattr(program, "aliases", {}))
+    targets = {v.name for v in fetch_vars}
+    for name in list(targets):
+        kind_ref = aliases.get(name)
+        if kind_ref is not None and kind_ref[0] != "const":
+            targets.add(kind_ref[1])
+    kept, needed = prune_ops(program.ops, targets)
     ops = [{"op_type": op.op_type, "fn_name": op.op_type,
             "attrs": op.attrs, "in_refs": op.in_refs,
             "out_names": op.out_names} for op in kept]
@@ -29,24 +38,33 @@ def _program_payload(program, feed_vars, fetch_vars):
         "captures": caps,
         "feed_names": [v.name for v in feed_vars],
         "fetch_names": [v.name for v in fetch_vars],
+        "aliases": aliases,
     }
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         program=None, **kwargs):
+                         program=None, optimize=True, **kwargs):
+    """optimize=True runs the export-time fusion pipeline (conv+BN fold,
+    fc fuse, add+act fuse — static/passes.py INFERENCE_FUSION_PASSES) on a
+    CLONE of the program, the analogue of the reference's analysis passes
+    (ir/conv_bn_fuse_pass.cc etc.) baked into the saved artifact."""
     from .program import default_main_program
     program = program or default_main_program()
     if not isinstance(feed_vars, (list, tuple)):
         feed_vars = [feed_vars]
     if not isinstance(fetch_vars, (list, tuple)):
         fetch_vars = [fetch_vars]
+    if optimize:
+        from .passes import apply_inference_fusion
+        program = apply_inference_fusion(
+            program, protected={v.name for v in fetch_vars})
     d = os.path.dirname(path_prefix)
     if d:
         os.makedirs(d, exist_ok=True)
     payload = _program_payload(program, feed_vars, fetch_vars)
     with open(path_prefix + ".pdmodel", "wb") as f:
         pickle.dump({k: payload[k] for k in ("ops", "feed_names",
-                                             "fetch_names")}, f)
+                                             "fetch_names", "aliases")}, f)
     with open(path_prefix + ".pdiparams", "wb") as f:
         pickle.dump(payload["captures"], f)
     return program
@@ -94,6 +112,7 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
         for n in op.out_names:
             program.vars.setdefault(
                 n, Variable(program, n, jax.ShapeDtypeStruct((), np.float32)))
+    program.aliases = dict(meta.get("aliases", {}))
     return program, meta["feed_names"], meta["fetch_names"]
 
 

@@ -8,7 +8,9 @@ pass rewrites `Program.ops` (the staged OpRecord list) BEFORE the whole
 program is compiled to one XLA module — the right altitude for surgery XLA
 cannot do itself: deleting training-only ops for inference, forcing bf16
 compute on matmul-class ops (static AMP), inserting fake-quant ops for
-quantized export. Fusion passes are deliberately absent: XLA owns fusion.
+quantized export. RUNTIME fusion stays XLA's job; the fusion passes here
+(conv+BN fold, fc fuse, add+act fuse) are EXPORT-TIME artifact rewrites —
+smaller saved models, one quantizable matmul per fused site.
 """
 from __future__ import annotations
 
@@ -243,6 +245,266 @@ class TransposeCancelPass(PassBase):
 # whose inputs are all constants — folding happens at trace time.
 
 
+# ---------------------------------------------------------------------------
+# export-time fusion passes (r4 VERDICT item 2). These fold/fuse the
+# INFERENCE ARTIFACT — runtime fusion is XLA's job, but a folded artifact is
+# smaller (BN's four arrays collapse into the conv weight + one bias) and
+# gives the int8 path a single quantizable matmul per conv+bn. They change
+# the VALUES of fused-away intermediate vars, so they run on the cloned
+# program inside save_inference_model(optimize=True), never on a live
+# training program. Reference: ir/conv_bn_fuse_pass.cc:1, ir/fc_fuse_pass.cc:1,
+# ir/fuse_elewise_add_act_pass.cc:1.
+
+
+def _producer_uses(program):
+    producer, uses = {}, {}
+    for op in program.ops:
+        for n in op.out_names:
+            producer[n] = op
+        for kind, ref in op.in_refs:
+            if kind != "const":
+                uses[ref] = uses.get(ref, 0) + 1
+    return producer, uses
+
+
+_UNRESOLVED = object()
+
+
+def _cap_array(caps_by_name, ref):
+    """Concrete value of a ("cap"|"const", x) ref, or _UNRESOLVED for a
+    graph var (unfoldable)."""
+    kind, v = ref
+    if kind == "const":
+        return v
+    if kind == "cap" and v in caps_by_name:
+        import numpy as np
+
+        return np.asarray(caps_by_name[v]._data)
+    return _UNRESOLVED
+
+
+def _const_eval(caps_by_name, producer, ref, depth=4):
+    """Resolve `ref` to a concrete array if its subgraph is parameter-only
+    (caps/consts through e.g. reshape2) — the mini constant-folder the
+    fold passes use for bias chains. Returns _UNRESOLVED when any input is
+    a true graph var or depth runs out."""
+    import numpy as np
+
+    v = _cap_array(caps_by_name, ref)
+    if v is not _UNRESOLVED:
+        return v
+    op = producer.get(ref[1])
+    if op is None or depth <= 0:
+        return _UNRESOLVED
+    ins = [_const_eval(caps_by_name, producer, r, depth - 1)
+           for r in op.in_refs]
+    if any(i is _UNRESOLVED for i in ins):
+        return _UNRESOLVED
+    try:
+        outs = op.fn(*ins, **op.attrs)
+    except Exception:
+        return _UNRESOLVED
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    return np.asarray(outs[op.out_names.index(ref[1])])
+
+
+def _add_capture(program, arr):
+    from ..framework.tensor import Tensor
+    import numpy as np
+
+    t = Tensor(np.asarray(arr))
+    t.stop_gradient = True
+    t.persistable = True
+    return program._capture(t)
+
+
+def _caps_by_name(program):
+    return {program.capture_names[i]: t
+            for i, t in program.captured.items()}
+
+
+@register_pass("conv_bn_fuse_pass")
+class ConvBnFusePass(PassBase):
+    """Fold inference batch-norm into the preceding conv's weight + one
+    bias add: w' = w·(γ/√(σ²+ε)) along the cout axis,
+    b' = β − μ·(γ/√(σ²+ε)) (reference: ir/conv_bn_fuse_pass.cc:1
+    ConvBNFusePass — there a GraphPatternDetector rewrite over OpDesc;
+    here an OpRecord rewrite with the folded arrays registered as new
+    captures, so the BN statistics drop out of the exported artifact).
+
+    Unlike the other fusion passes (whose surviving dead producers stay
+    numerically correct), the fold RESCALES the conv weight — the conv's
+    own output changes value. `protected` names (the export fetch set)
+    therefore veto the fold when they include the conv or bias-add
+    intermediates, the analogue of the reference passes' fetch-set
+    protection."""
+
+    def __init__(self, protected=()):
+        self.protected = frozenset(protected)
+
+    def apply(self, program):
+        import numpy as np
+
+        producer, uses = _producer_uses(program)
+        caps = _caps_by_name(program)
+        conv_replacements = {}  # id(old conv record) -> new record
+        for i, op in enumerate(program.ops):
+            if op.op_type != "batch_norm_infer":
+                continue
+            kind, ref = op.in_refs[0]
+            if kind != "var":
+                continue
+            # pattern: conv[→ bias-add] → bn. The staged Conv2D layer adds
+            # its bias as reshape2(cap) + elementwise_add, so a parameter-
+            # only bias chain is const-folded through.
+            p = producer.get(ref)
+            conv, conv_bias, conv_out = None, None, ref
+            if p is not None and p.op_type == "conv2d_op":
+                conv = p
+            elif p is not None and p.op_type == "elementwise_add" \
+                    and len(p.in_refs) == 2:
+                for xi, bi in ((0, 1), (1, 0)):
+                    k2, r2 = p.in_refs[xi]
+                    cand = producer.get(r2) if k2 == "var" else None
+                    if cand is not None and cand.op_type == "conv2d_op" \
+                            and uses.get(r2, 0) == 1:
+                        b = _const_eval(caps, producer, p.in_refs[bi])
+                        if b is not _UNRESOLVED and b is not None:
+                            conv, conv_bias, conv_out = cand, b, r2
+                        break
+            if conv is None or uses.get(ref, 0) != 1 \
+                    or len(conv.in_refs) != 2 \
+                    or int(conv.attrs.get("groups", 1)) != 1 \
+                    or id(conv) in conv_replacements:
+                continue
+            # fetching the conv/bias-add intermediate would observe the
+            # rescaled weight: refuse the fold for protected names
+            if self.protected & ({conv_out, ref} | set(conv.out_names)):
+                continue
+            w = _cap_array(caps, conv.in_refs[1])
+            if w is _UNRESOLVED or conv.in_refs[1][0] != "cap":
+                continue
+            vals = [_cap_array(caps, r) for r in op.in_refs[1:5]]
+            if any(v is _UNRESOLVED for v in vals):
+                continue
+            gamma, beta, mean, var = vals
+            if mean is None or var is None:
+                continue
+            n_ch = int(mean.shape[0])
+            if conv_bias is not None:
+                if conv_bias.size != n_ch:
+                    continue  # not a per-channel bias: leave un-fused
+                conv_bias = np.asarray(conv_bias).reshape(-1)
+            eps = float(op.attrs.get("epsilon", 1e-5))
+            channel_last = bool(conv.attrs.get("channel_last", False))
+            inv = 1.0 / np.sqrt(np.asarray(var, np.float64) + eps)
+            scale = inv if gamma is None else gamma * inv
+            if channel_last:   # HWIO weights: cout is the LAST axis
+                w_new = w * scale.reshape((1,) * (w.ndim - 1) + (-1,))
+            else:              # OIHW: cout first
+                w_new = w * scale.reshape((-1,) + (1,) * (w.ndim - 1))
+            shift = mean if conv_bias is None else mean - conv_bias
+            bias = (0.0 if beta is None else beta) - shift * scale
+            nsp = w.ndim - 2
+            bias = bias.reshape((-1,)) if channel_last \
+                else bias.reshape((1, -1) + (1,) * nsp)
+            w_name = _add_capture(program, w_new.astype(w.dtype))
+            b_name = _add_capture(program, bias.astype(w.dtype))
+            # REPLACE the conv record rather than mutating it in place —
+            # Program.clone() shares OpRecord objects, so mutation would
+            # corrupt the source program (r5 review finding)
+            conv_replacements[id(conv)] = OpRecord(
+                conv.op_type, conv.fn, dict(conv.attrs),
+                [conv.in_refs[0], ("cap", w_name)], list(conv.out_names))
+            from ..ops.math import add as _add_prim
+
+            program.ops[i] = OpRecord(
+                "elementwise_add", _add_prim.fn, {},
+                [("var", conv_out), ("cap", b_name)], list(op.out_names))
+        if conv_replacements:
+            program.ops = [conv_replacements.get(id(o), o)
+                           for o in program.ops]
+        return program
+
+
+@register_pass("fc_fuse_pass")
+class FcFusePass(PassBase):
+    """matmul + bias-add → one fc op (reference: ir/fc_fuse_pass.cc:1) —
+    the single op is what quant_insert_pass wraps, making a quantized
+    linear one int8 matmul. The matmul survives as a dead producer so its
+    output stays fetchable."""
+
+    def apply(self, program):
+        from ..framework.dispatch import OPS
+
+        producer, uses = _producer_uses(program)
+        for i, op in enumerate(program.ops):
+            if op.op_type != "elementwise_add" or len(op.in_refs) != 2:
+                continue
+            for xi, bi in ((0, 1), (1, 0)):
+                kind, ref = op.in_refs[xi]
+                mm = producer.get(ref) if kind == "var" else None
+                if mm is not None and mm.op_type == "matmul_v2" \
+                        and uses.get(ref, 0) == 1 \
+                        and op.in_refs[bi][0] != "var":
+                    program.ops[i] = OpRecord(
+                        "fc_op", OPS["fc_op"].fn,
+                        {"transpose_x": mm.attrs.get("transpose_x", False),
+                         "transpose_y": mm.attrs.get("transpose_y", False)},
+                        [mm.in_refs[0], mm.in_refs[1], op.in_refs[bi]],
+                        list(op.out_names))
+                    break
+        return program
+
+
+@register_pass("fuse_elewise_add_act_pass")
+class ElewiseAddActFusePass(PassBase):
+    """elementwise_add + activation → one fused op (reference:
+    ir/fuse_elewise_add_act_pass.cc:1). The add survives as a dead
+    producer so its output stays fetchable."""
+
+    ACTS = ("relu", "relu6", "gelu", "sigmoid", "tanh")
+
+    def apply(self, program):
+        from ..framework.dispatch import OPS
+
+        producer, uses = _producer_uses(program)
+        for i, op in enumerate(program.ops):
+            if op.op_type not in self.ACTS or not op.in_refs:
+                continue
+            kind, ref = op.in_refs[0]
+            addop = producer.get(ref) if kind == "var" else None
+            if addop is None or addop.op_type != "elementwise_add" \
+                    or uses.get(ref, 0) != 1:
+                continue
+            program.ops[i] = OpRecord(
+                "fused_elemwise_add_act", OPS["fused_elemwise_add_act"].fn,
+                {"act": op.op_type, "act_attrs": dict(op.attrs)},
+                list(addop.in_refs), list(op.out_names))
+        return program
+
+
+INFERENCE_FUSION_PASSES = ("identity_scale_clean_pass", "conv_bn_fuse_pass",
+                           "fc_fuse_pass", "fuse_elewise_add_act_pass")
+
+
+def apply_inference_fusion(program, protected=()):
+    """Deep-clone the program's op records and run the export-time fusion
+    pipeline on the clone (the passes rewrite records and re-point
+    captured weights — the live training program must stay untouched).
+    `protected`: fetch-set var names whose values must survive unchanged
+    (vetoes the conv+BN weight rescale when they name its intermediates)."""
+    p = program.clone()
+    p.ops = [OpRecord(o.op_type, o.fn, dict(o.attrs), list(o.in_refs),
+                      list(o.out_names)) for o in program.ops]
+    for name in INFERENCE_FUSION_PASSES:
+        if name == "conv_bn_fuse_pass":
+            p = apply_pass(p, name, protected=protected)
+        else:
+            p = apply_pass(p, name)
+    return p
+
+
 @register_pass("scale_merge_pass")
 class ScaleMergePass(PassBase):
     """Collapse consecutive scale ops into one:
@@ -316,7 +578,7 @@ class QuantInsertPass(PassBase):
     (reference: contrib/slim/quantization/quantization_pass.py
     QuantizationTransformPass)."""
 
-    DEFAULT_LIST = ("matmul_v2", "mul", "bmm", "conv2d_op")
+    DEFAULT_LIST = ("matmul_v2", "mul", "bmm", "conv2d_op", "fc_op")
 
     def __init__(self, op_types=None, weight_bits=8, activation_bits=8):
         self.op_types = tuple(op_types or self.DEFAULT_LIST)
