@@ -143,12 +143,8 @@ class Predictor:
                     env[n] = o
             # fetch names removed by export-time cleanup passes resolve
             # through the artifact's alias table (static/io.py payload)
-            for name, (kind, ref) in getattr(prog, "aliases", {}).items():
-                if name not in env:
-                    if kind == "const":
-                        env[name] = ref
-                    elif ref in env:
-                        env[name] = env[ref]
+            from ..static.program import resolve_aliases_into_env
+            resolve_aliases_into_env(env, getattr(prog, "aliases", {}))
             outs = [env[n] for n in self._fetch_names]
             if bf16:
                 outs = [o.astype(np.float32)

@@ -245,6 +245,54 @@ class GPTForPretraining(Layer):
         hidden = self.gpt(input_ids, position_ids)
         return _lm_logits(hidden, self.gpt.embeddings.word_embeddings.weight)
 
+    def to_pipeline(self, num_stages, seg_method="layer:GPTDecoderLayer",
+                    **pipe_kwargs) -> "GPTForPipeline":
+        """Partitioner hand-off (r4 VERDICT item 3): rebuild this model as
+        a GPTForPipeline with `num_stages` stages and COPY the weights
+        across, so an auto-parallel plan that chose pp>1 can be applied to
+        the already-built eager model (the reference's partitioner slices
+        the serialized program instead —
+        distributed/auto_parallel/partitioner.py:846)."""
+        from functools import partial as _partial
+
+        g = self.gpt
+        emb = g.embeddings
+        blk = g.layers[0]
+        pipe = GPTForPipeline(
+            vocab_size=g.vocab_size, hidden_size=g.hidden_size,
+            num_layers=len(g.layers), num_heads=blk.attn.num_heads,
+            intermediate_size=blk.mlp.fc1.weight.shape[1],
+            max_position_embeddings=emb.position_embeddings.weight.shape[0],
+            attn_dropout_prob=blk.attn.attn_dropout_prob,
+            hidden_dropout_prob=blk.dropout.p,
+            layer_norm_epsilon=getattr(g.ln_f, "_epsilon", 1e-5),
+            num_stages=num_stages, seg_method=seg_method, **pipe_kwargs)
+        # structural weight copy: run_function = [embed, blocks..., ln, head]
+        # where the head shares the embed object (tied weights both here
+        # and in GPTForPipeline, so one copy covers both ends)
+        srcs = [emb] + list(g.layers) + [g.ln_f]
+        copied = set()
+        for src, dst in zip(srcs, pipe.run_function):
+            dst_layer = dst.args[0] if isinstance(dst, _partial) else dst
+            sd = src.state_dict()
+            for name, p in dst_layer.named_parameters():
+                if name not in sd:
+                    raise RuntimeError(
+                        f"to_pipeline weight copy: {type(dst_layer).__name__}"
+                        f".{name} has no counterpart in "
+                        f"{type(src).__name__} — the pipeline layout "
+                        "drifted from the eager model; a silent skip here "
+                        "would leave the parameter at random init")
+                p.set_value(np.asarray(sd[name].numpy()))
+                copied.add(id(p))
+        uncovered = [n for n, p in pipe.named_parameters()
+                     if id(p) not in copied]
+        if uncovered:
+            raise RuntimeError(
+                f"to_pipeline weight copy left parameters at random init: "
+                f"{uncovered}")
+        return pipe
+
     def generate(self, input_ids, max_new_tokens=16):
         """Greedy decode with per-layer KV caches (inference path)."""
         from ..ops import creation as cr, manipulation as mp, math as m

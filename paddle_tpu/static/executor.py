@@ -59,11 +59,8 @@ class _CompiledProgram:
         targets |= {name for _, name in program.buffer_updates}
         # fetching a pass-removed var goes through its alias: keep the
         # alias TARGET alive through the prune
-        aliases = getattr(program, "aliases", {})
-        for name in list(targets):
-            kind_ref = aliases.get(name)
-            if kind_ref is not None and kind_ref[0] != "const":
-                targets.add(kind_ref[1])
+        from .program import extend_targets_with_aliases
+        extend_targets_with_aliases(targets, getattr(program, "aliases", {}))
         self.ops, needed = prune_ops(program.ops, targets)
         self.rng_names = [n for n in program.rng_inputs if n in needed]
         self.buffer_updates = [(b, n) for b, n in program.buffer_updates
@@ -112,13 +109,8 @@ class _CompiledProgram:
                 outs = (outs,)
             env.update(zip(op.out_names, outs))
         # vars removed by rewrite passes stay fetchable via their alias
-        for name, (kind, ref) in self.aliases.items():
-            if name not in env:
-                if kind == "const":
-                    env[name] = ref
-                elif ref in env:
-                    env[name] = env[ref]
-        return env
+        from .program import resolve_aliases_into_env
+        return resolve_aliases_into_env(env, self.aliases)
 
     def _fetch(self, env):
         missing = [n for n in self.fetch_names if n not in env]

@@ -15,17 +15,14 @@ from ..framework.tensor import Tensor
 
 
 def _program_payload(program, feed_vars, fetch_vars):
-    from .program import prune_ops
+    from .program import extend_targets_with_aliases, prune_ops
     # a fetch var removed by a cleanup pass resolves through the alias
     # table; the alias TARGETS must survive the prune and the aliases must
     # ship in the artifact (else the loaded program has no producer for
     # the fetch name — r5 review finding)
     aliases = dict(getattr(program, "aliases", {}))
-    targets = {v.name for v in fetch_vars}
-    for name in list(targets):
-        kind_ref = aliases.get(name)
-        if kind_ref is not None and kind_ref[0] != "const":
-            targets.add(kind_ref[1])
+    targets = extend_targets_with_aliases({v.name for v in fetch_vars},
+                                          aliases)
     kept, needed = prune_ops(program.ops, targets)
     ops = [{"op_type": op.op_type, "fn_name": op.op_type,
             "attrs": op.attrs, "in_refs": op.in_refs,

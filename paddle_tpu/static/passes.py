@@ -323,6 +323,17 @@ def _caps_by_name(program):
             for i, t in program.captured.items()}
 
 
+def _pristine(op) -> bool:
+    """True iff op.fn is the registry primitive's own fn — a fusion pass
+    must NOT rebuild an op whose fn carries an installed wrapper
+    (quant_insert's fake-quant, amp_bf16's cast): replacing it with the
+    registry fn would silently drop the wrapper (r5 review finding)."""
+    from ..framework.dispatch import OPS
+
+    prim = OPS.get(op.op_type)
+    return prim is not None and op.fn is prim.fn
+
+
 @register_pass("conv_bn_fuse_pass")
 class ConvBnFusePass(PassBase):
     """Fold inference batch-norm into the preceding conv's weight + one
@@ -349,7 +360,7 @@ class ConvBnFusePass(PassBase):
         caps = _caps_by_name(program)
         conv_replacements = {}  # id(old conv record) -> new record
         for i, op in enumerate(program.ops):
-            if op.op_type != "batch_norm_infer":
+            if op.op_type != "batch_norm_infer" or not _pristine(op):
                 continue
             kind, ref = op.in_refs[0]
             if kind != "var":
@@ -362,7 +373,7 @@ class ConvBnFusePass(PassBase):
             if p is not None and p.op_type == "conv2d_op":
                 conv = p
             elif p is not None and p.op_type == "elementwise_add" \
-                    and len(p.in_refs) == 2:
+                    and len(p.in_refs) == 2 and _pristine(p):
                 for xi, bi in ((0, 1), (1, 0)):
                     k2, r2 = p.in_refs[xi]
                     cand = producer.get(r2) if k2 == "var" else None
@@ -439,13 +450,14 @@ class FcFusePass(PassBase):
 
         producer, uses = _producer_uses(program)
         for i, op in enumerate(program.ops):
-            if op.op_type != "elementwise_add" or len(op.in_refs) != 2:
+            if op.op_type != "elementwise_add" or len(op.in_refs) != 2 \
+                    or not _pristine(op):
                 continue
             for xi, bi in ((0, 1), (1, 0)):
                 kind, ref = op.in_refs[xi]
                 mm = producer.get(ref) if kind == "var" else None
                 if mm is not None and mm.op_type == "matmul_v2" \
-                        and uses.get(ref, 0) == 1 \
+                        and _pristine(mm) and uses.get(ref, 0) == 1 \
                         and op.in_refs[bi][0] != "var":
                     program.ops[i] = OpRecord(
                         "fc_op", OPS["fc_op"].fn,
@@ -470,12 +482,13 @@ class ElewiseAddActFusePass(PassBase):
 
         producer, uses = _producer_uses(program)
         for i, op in enumerate(program.ops):
-            if op.op_type not in self.ACTS or not op.in_refs:
+            if op.op_type not in self.ACTS or not op.in_refs \
+                    or not _pristine(op):
                 continue
             kind, ref = op.in_refs[0]
             addop = producer.get(ref) if kind == "var" else None
             if addop is None or addop.op_type != "elementwise_add" \
-                    or uses.get(ref, 0) != 1:
+                    or uses.get(ref, 0) != 1 or not _pristine(addop):
                 continue
             program.ops[i] = OpRecord(
                 "fused_elemwise_add_act", OPS["fused_elemwise_add_act"].fn,

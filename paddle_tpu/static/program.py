@@ -75,6 +75,29 @@ class OpRecord:
         self.out_names = out_names
 
 
+def extend_targets_with_aliases(targets, aliases):
+    """Add each aliased target's surviving ref to `targets` (in place) so
+    a prune keeps it producible. One shared definition of alias-prune
+    semantics for the executor, export payload, and predictor."""
+    for name in list(targets):
+        kind_ref = aliases.get(name)
+        if kind_ref is not None and kind_ref[0] != "const":
+            targets.add(kind_ref[1])
+    return targets
+
+
+def resolve_aliases_into_env(env, aliases):
+    """Materialize pass-removed vars into a finished run env (in place):
+    consts directly, var/cap refs from their surviving value."""
+    for name, (kind, ref) in aliases.items():
+        if name not in env:
+            if kind == "const":
+                env[name] = ref
+            elif ref in env:
+                env[name] = env[ref]
+    return env
+
+
 def prune_ops(ops, targets):
     """Backward slice: keep only ops needed for `targets` (reference:
     Executor prune, framework/executor.cc:372 / prune.cc)."""

@@ -280,3 +280,22 @@ class TestReviewRegressions:
         clone = infer.clone()
         static.apply_pass(clone, "conv_bn_fuse_pass")
         assert [list(o.in_refs) for o in infer.ops] == src_refs
+
+    def test_quant_wrapped_ops_not_defused(self):
+        """Fusion after quant_insert must NOT rebuild wrapped ops from the
+        pristine registry fn — that would silently drop the fake-quant
+        wrapper (r5 review finding). Wrapped matmul/add stay un-fused."""
+        from paddle_tpu.framework.dispatch import OPS
+        paddle.seed(0)
+        x = static.data("x", [-1, 6], "float32")
+        lin = nn.Linear(6, 4)
+        y = nn.functional.softmax(lin(x))
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        prog = static.default_main_program()
+        static.apply_pass(prog, "quant_insert_pass")
+        fused = apply_inference_fusion(prog)
+        types = _compiled_types(fused, [y.name])
+        assert "fc_op" not in types          # wrapped matmul kept as-is
+        mms = [o for o in fused.ops if o.op_type == "matmul_v2"]
+        assert mms and all(o.fn is not OPS["matmul_v2"].fn for o in mms)
