@@ -270,11 +270,11 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
     return call
 
 
-def forward_jaxpr(network, inputs):
-    """jax.make_jaxpr of network(*inputs) under the engine's
-    functionalization protocol (params/buffers/RNG as traced inputs,
-    state restored afterwards). Shared by the auto-parallel planner's
-    cost measurement — ONE copy of the swap-and-restore trace harness."""
+def _functional_fwd(network, reduce=None):
+    """The swap-and-restore trace harness (params/buffers/RNG as traced
+    inputs, state restored afterwards) — ONE copy shared by forward_jaxpr
+    and train_jaxpr; `reduce` maps the output array list to the traced
+    return value."""
     params = [p for _, p in network.named_parameters()]
     buffers = [b for _, b in network.named_buffers()]
     mutable = params + buffers
@@ -292,17 +292,43 @@ def forward_jaxpr(network, inputs):
             with state.trace_guard(), state.no_grad_guard():
                 out = network(*ts)
             outs = out if isinstance(out, (list, tuple)) else [out]
-            return [o._data for o in outs]
+            arrs = [o._data for o in outs]
+            return reduce(arrs) if reduce is not None else arrs
         finally:
             for m, a in zip(mutable, saved):
                 m._data = a
             RNG.key = saved_key
 
+    return fwd, params, buffers
+
+
+def _trace_args(network, inputs, params, buffers):
     in_arrs = [x._data if isinstance(x, Tensor) else np.asarray(x)
                for x in inputs]
-    return jax.make_jaxpr(fwd)(
-        [p._data for p in params], [b._data for b in buffers],
-        RNG.key, in_arrs)
+    return ([p._data for p in params], [b._data for b in buffers],
+            RNG.key, in_arrs)
+
+
+def forward_jaxpr(network, inputs):
+    """jax.make_jaxpr of network(*inputs) under the engine's
+    functionalization protocol. Shared by the auto-parallel planner's
+    cost measurement."""
+    fwd, params, buffers = _functional_fwd(network)
+    return jax.make_jaxpr(fwd)(*_trace_args(network, inputs, params,
+                                            buffers))
+
+
+def train_jaxpr(network, inputs):
+    """Forward+backward jaxpr: grad of the summed outputs wrt params,
+    under the same functionalization protocol as forward_jaxpr. The
+    auto-parallel planner prices ACTUAL backward FLOPs from this instead
+    of the 3x-forward heuristic (r4 VERDICT item 4)."""
+    fwd, params, buffers = _functional_fwd(
+        network,
+        reduce=lambda arrs: sum(jnp.sum(a.astype(jnp.float32))
+                                for a in arrs))
+    return jax.make_jaxpr(jax.grad(fwd))(*_trace_args(network, inputs,
+                                                      params, buffers))
 
 
 def make_eval_step(network, loss_fn=None, mesh=None):

@@ -386,6 +386,7 @@ class ConvBnFusePass(PassBase):
             if conv is None or uses.get(ref, 0) != 1 \
                     or len(conv.in_refs) != 2 \
                     or int(conv.attrs.get("groups", 1)) != 1 \
+                    or not _pristine(conv) \
                     or id(conv) in conv_replacements:
                 continue
             # fetching the conv/bias-add intermediate would observe the
