@@ -211,10 +211,12 @@ def _sanitize_specs(specs, mesh_names):
 
 
 def _block(out):
-    """Force device completion of an eval-step result (list/tensor)."""
+    """Block until a step result is computed WITHOUT copying it to host
+    (a D2H gather inside the timed region would charge each candidate a
+    transfer cost that varies with its output sharding)."""
     outs = out if isinstance(out, (list, tuple)) else [out]
     for o in outs:
-        np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+        jax.block_until_ready(getattr(o, "_data", o))
 
 
 def _count_repeated_blocks(network) -> int:
@@ -399,11 +401,25 @@ class Planner:
             cands = [c for c in feasible[:calibrate_topk] if c.pp == 1]
             runner = measure_fn or (lambda c: self._measure_config_step(
                 network, inputs, c))
+            measure_errors = {}
             for c in cands:
                 try:
                     measured[(c.dp, c.mp, c.pp)] = float(runner(c))
-                except Exception:
-                    continue  # unmeasurable candidate keeps analytic rank
+                except Exception as e:
+                    # unmeasurable candidate keeps its analytic rank, but
+                    # the failure must be VISIBLE: a broken measure_fn
+                    # that fails every candidate would otherwise silently
+                    # degrade calibration to a no-op
+                    measure_errors[(c.dp, c.mp, c.pp)] = \
+                        f"{type(e).__name__}: {e}"
+            if measure_errors:
+                import warnings
+
+                warnings.warn(
+                    "planner calibration: measurement failed for "
+                    f"{measure_errors}"
+                    + ("; ranking stays analytic" if not measured else ""))
+                m["measure_failures"] = float(len(measure_errors))
             if measured:
                 # STABLE re-rank: measurement only says something about
                 # the configs it ran, so measured configs permute among
@@ -433,12 +449,16 @@ class Planner:
 
     def _measure_config_step(self, network, inputs, cfg, steps: int = 3):
         """Wall-clock one candidate (dp, mp) config: attach its completed
-        specs, build its mesh over the available devices, compile the
-        forward step, and time `steps` blocked runs (median). Restores the
-        network's spec markers afterwards."""
+        specs, build its mesh over the available devices, compile a TRAIN
+        step (forward + backward + lr=0 SGD, so the backward collectives
+        the config choice hinges on are in the measurement and parameters
+        stay unchanged), and time `steps` blocked runs (median). Falls
+        back to the forward-only eval step for non-differentiable models.
+        Restores the network's spec markers afterwards."""
         import time as _time
 
-        from ...jit.engine import make_eval_step
+        from ...jit.engine import make_eval_step, make_train_step
+        from ...optimizer import SGD
 
         saved = [(p, getattr(p, "sharding_spec", None))
                  for _, p in network.named_parameters()]
@@ -454,13 +474,33 @@ class Planner:
             need = int(np.prod([n for _, n in axes]))
             mesh = Mesh(np.asarray(devs[:need]).reshape(
                 [n for _, n in axes]), tuple(a for a, _ in axes))
-            step = make_eval_step(network, mesh=mesh)
-            outs = step(list(inputs))
-            _block(outs)                    # compile + warm
+            try:
+                opt = SGD(parameters=network.parameters(),
+                          learning_rate=0.0)
+
+                def loss_fn(*outs):
+                    acc = None
+                    for o in outs:
+                        v = (o.astype("float32") ** 2).mean()
+                        acc = v if acc is None else acc + v
+                    return acc
+
+                tstep = make_train_step(network, loss_fn, opt, mesh=mesh)
+
+                def run():
+                    loss, _ = tstep(list(inputs), [])
+                    return loss
+            except Exception:
+                estep = make_eval_step(network, mesh=mesh)
+
+                def run():
+                    return estep(list(inputs))
+
+            _block(run())                   # compile + warm
             times = []
             for _ in range(steps):
                 t0 = _time.perf_counter()
-                _block(step(list(inputs)))
+                _block(run())
                 times.append(_time.perf_counter() - t0)
             return float(np.median(times))
         finally:

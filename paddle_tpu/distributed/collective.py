@@ -358,6 +358,26 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
             return jax.lax.psum_scatter(x[0], g.axis_name,
                                         scatter_dimension=0, tiled=True)[None]
         return _ret(tensor, _eager_shard_map(g, blk, arr))
+    if _cross_process(g):
+        # each process holds a DIFFERENT full send buffer: exchange,
+        # reduce over ranks per `op`, keep this rank's chunk
+        # (c_reducescatter semantics)
+        stacked = _process_exchange(arr, g)      # [nranks, nranks*c, *S]
+        if op == ReduceOp.SUM:
+            red = stacked.sum(0)
+        elif op == ReduceOp.MAX:
+            red = stacked.max(0)
+        elif op == ReduceOp.MIN:
+            red = stacked.min(0)
+        elif op == ReduceOp.PROD:
+            red = stacked.prod(0)
+        else:  # AVG
+            red = stacked.mean(0)
+        n = g.nranks
+        # _process_exchange guarantees group rank i IS process i
+        chunk = red.reshape((n, red.shape[0] // n)
+                            + red.shape[1:])[jax.process_index()]
+        return _ret(tensor, jnp.asarray(chunk, arr.dtype))
     # replicated input: rank i's result = (sum over ranks of chunk i)
     # = chunk_i * nranks; returned in the rank-dim representation
     n = g.nranks
@@ -397,6 +417,12 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
                                    concat_axis=0, tiled=False)
             return jnp.moveaxis(r, 0, 1)
         out = _eager_shard_map(g, blk, arr)
+    elif g.nranks > 1 and _cross_process(g):
+        # exchange every rank's (nranks, *S) send buffer; my row i of the
+        # result is what rank i addressed to me
+        stacked = _process_exchange(arr, g)      # [nranks, nranks, *S]
+        # _process_exchange guarantees group rank i IS process i
+        out = jnp.asarray(stacked[:, jax.process_index()], arr.dtype)
     else:
         out = arr  # single rank: identity
     if out_tensor_list is not None:
@@ -407,7 +433,9 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
 def send(tensor, dst=0, group=None, sync_op=True):
     """reference: send_v2 — p2p send. Traced context: expressed as a
-    ppermute with a single edge; pair with recv on the peer."""
+    ppermute with a single edge; pair with recv on the peer. Eager
+    cross-process: stages the buffer; the matching recv performs the
+    exchange (see recv's collective-relay contract)."""
     g = _get_group(group)
     arr = _wrap(tensor)
     if _is_traced(arr):
@@ -420,12 +448,25 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 def recv(tensor, src=0, group=None, sync_op=True):
     """reference: recv_v2. Eager single-controller: reads the staged send
-    buffer (host relay); compiled pipelines use ppermute directly."""
+    buffer (host relay); compiled pipelines use ppermute directly.
+
+    Eager CROSS-PROCESS p2p rides the cluster's all-gather as a relay:
+    every rank stages its outgoing buffer with send() (or anything — the
+    stage defaults to the recv arg) and then ALL ranks must call recv()
+    the same number of times in the same order (the same SPMD-style
+    contract compiled ppermute has); each picks its `src` row from the
+    exchange. The reference's NCCL send/recv pairs are likewise
+    communicator-collective over the ring."""
     g = _get_group(group)
     arr = _wrap(tensor)
     if _is_traced(arr):
         return _ret(tensor, arr)
     buf = getattr(g, "_p2p_buf", None)
+    if _cross_process(g):
+        staged = buf if buf is not None else arr
+        stacked = _process_exchange(staged, g)   # [nranks, *S]
+        g._p2p_buf = None
+        return _ret(tensor, jnp.asarray(stacked[src], staged.dtype))
     if buf is not None:
         return _ret(tensor, jax.device_put(buf, g.devices[g.rank]))
     return tensor

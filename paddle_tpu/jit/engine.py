@@ -302,7 +302,7 @@ def _functional_fwd(network, reduce=None):
     return fwd, params, buffers
 
 
-def _trace_args(network, inputs, params, buffers):
+def _trace_args(inputs, params, buffers):
     in_arrs = [x._data if isinstance(x, Tensor) else np.asarray(x)
                for x in inputs]
     return ([p._data for p in params], [b._data for b in buffers],
@@ -314,8 +314,7 @@ def forward_jaxpr(network, inputs):
     functionalization protocol. Shared by the auto-parallel planner's
     cost measurement."""
     fwd, params, buffers = _functional_fwd(network)
-    return jax.make_jaxpr(fwd)(*_trace_args(network, inputs, params,
-                                            buffers))
+    return jax.make_jaxpr(fwd)(*_trace_args(inputs, params, buffers))
 
 
 def train_jaxpr(network, inputs):
@@ -327,8 +326,8 @@ def train_jaxpr(network, inputs):
         network,
         reduce=lambda arrs: sum(jnp.sum(a.astype(jnp.float32))
                                 for a in arrs))
-    return jax.make_jaxpr(jax.grad(fwd))(*_trace_args(network, inputs,
-                                                      params, buffers))
+    return jax.make_jaxpr(jax.grad(fwd))(*_trace_args(inputs, params,
+                                                      buffers))
 
 
 def make_eval_step(network, loss_fn=None, mesh=None):

@@ -77,6 +77,26 @@ def run_rank():
     gathered = dist.all_gather(None, paddle.to_tensor(
         np.full((2,), float(rank + 10), np.float32)))
     res["all_gather"] = gathered.numpy().tolist()
+    if world > 1:
+        # reduce_scatter: rank i contributes a [world] buffer of (i+1);
+        # every rank's 1-element chunk = sum_i (i+1) = world(world+1)/2
+        rs_in = paddle.to_tensor(
+            np.full((world,), float(rank + 1), np.float32))
+        out = dist.reduce_scatter(rs_in)
+        res["reduce_scatter"] = np.asarray(out.numpy()).reshape(-1).tolist()
+        # alltoall: rank r sends row j = r*10+j; receives row i = i*10+r
+        a2a_in = paddle.to_tensor(np.asarray(
+            [[float(rank * 10 + j)] for j in range(world)], np.float32))
+        a2a = dist.alltoall(a2a_in)
+        res["alltoall"] = np.asarray(a2a.numpy()).reshape(-1).tolist()
+        # ring p2p: every rank sends (rank+1)*100 to rank+1, receives
+        # from rank-1 (all ranks call send then recv -> relay contract)
+        dist.send(paddle.to_tensor(
+            np.full((2,), float((rank + 1) * 100), np.float32)),
+            dst=(rank + 1) % world)
+        got = dist.recv(paddle.to_tensor(np.zeros((2,), np.float32)),
+                        src=(rank - 1) % world)
+        res["p2p"] = got.numpy().tolist()
     dist.barrier()
 
     res["losses"] = train_dp(rank, world)
@@ -87,6 +107,130 @@ def run_rank():
     print("WORKER_OK", rank)
 
 
+def run_hybrid():
+    """The multi-host pod shape: process-level DP (one process per
+    'host') x an IN-PROCESS mp mesh (several devices per process). The
+    global mesh spans both processes; GSPMD inserts the cross-process
+    collectives (the reference's multi-node NCCL hierarchy)."""
+    from paddle_tpu.framework.platform import pin_host_platform
+    pin_host_platform(4, verify=False)   # 4 local devices per process
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu.distributed as dist
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    dist.init_parallel_env()
+    # the dp axis is the PROCESS grid (get_world_size counts devices in
+    # this single-controller stack)
+    rank, nproc = jax.process_index(), jax.process_count()
+    res = {"rank": rank, "world": nproc,
+           "process_count": nproc,
+           "global_devices": len(jax.devices()),
+           "local_devices": len(jax.local_devices())}
+
+    # global mesh: dp axis across processes, mp axis across each
+    # process's local devices
+    devs = np.asarray(jax.devices()).reshape(nproc, 4)
+    mesh = Mesh(devs, ("dp", "mp"))
+
+    # per-process batch shard -> global dp-sharded array
+    rs = np.random.RandomState(7)
+    X = rs.randn(nproc * 2, 8).astype(np.float32)   # full batch (oracle)
+    W = rs.randn(8, 16).astype(np.float32)
+    x_local = X[rank * 2:(rank + 1) * 2]
+    x_g = multihost_utils.host_local_array_to_global_array(
+        x_local, mesh, P("dp", None))
+    w_g = jax.device_put(W, NamedSharding(mesh, P(None, "mp")))
+
+    @jax.jit
+    def step(x, w):
+        y = jnp.tanh(x @ w)              # mp-sharded matmul
+        return jnp.mean(y * y)           # global reduction crosses dp+mp
+
+    loss = step(x_g, w_g)
+    # the scalar is fully replicated: every process reads the same value
+    res["hybrid_loss"] = float(
+        multihost_utils.process_allgather(
+            np.asarray(loss.addressable_data(0))).reshape(-1)[0])
+    res["hybrid_oracle"] = float(
+        np.mean(np.tanh(X @ W) ** 2))
+    out = os.environ.get("PT_DIST_OUT")
+    if out:
+        with open(f"{out}.{rank}", "w") as f:
+            json.dump(res, f)
+    print("HYBRID_OK", rank)
+
+
+def run_elastic():
+    """Elastic-restart drill: train with per-step checkpointing; on the
+    FIRST incarnation rank 1 dies abruptly mid-run; the relaunch resumes
+    from the checkpoint and must land on the uninterrupted trajectory
+    (reference: fleet elastic + checkpoint/resume)."""
+    from paddle_tpu.framework.platform import pin_host_platform
+    pin_host_platform(1, verify=False)
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    ckpt = os.environ["PT_ELASTIC_CKPT"]
+    die_at = int(os.environ.get("PT_ELASTIC_DIE_AT", "-1"))
+    total_steps = 4
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.Tanh(),
+                               paddle.nn.Linear(16, 1))
+    start = 0
+    if os.path.exists(ckpt + ".meta"):
+        with open(ckpt + ".meta") as f:
+            start = json.load(f)["step"]
+        state = np.load(ckpt + ".npz")
+        for i, p in enumerate(net.parameters()):
+            p.set_value(state[f"p{i}"])
+
+    rs = np.random.RandomState(42)
+    X = rs.randn(8, 8).astype(np.float32)
+    Y = rs.randn(8, 1).astype(np.float32)
+    per = 8 // world
+    xs, ys = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
+    losses = []
+    for step in range(start, total_steps):
+        if step == die_at:
+            # rank 1 dies abruptly; the other ranks exit as the elastic
+            # watch would kill them once a peer is gone (blocking in the
+            # next collective would only stall until the cluster timeout)
+            os._exit(17 if rank == 1 else 3)
+        x, y = paddle.to_tensor(xs), paddle.to_tensor(ys)
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        lt = paddle.to_tensor(loss.numpy())
+        dist.all_reduce(lt, op=dist.ReduceOp.AVG)
+        losses.append(float(lt.numpy()))
+        for p in net.parameters():
+            g = p.grad
+            dist.all_reduce(g, op=dist.ReduceOp.AVG)
+            p.set_value(p.numpy() - 0.1 * g.numpy())
+            p.clear_gradient()
+        if rank == 0:                    # checkpoint AFTER the update
+            np.savez(ckpt + ".npz", **{
+                f"p{i}": p.numpy()
+                for i, p in enumerate(net.parameters())})
+            with open(ckpt + ".meta", "w") as f:
+                json.dump({"step": step + 1}, f)
+        dist.barrier()
+
+    out = os.environ.get("PT_DIST_OUT")
+    if out:
+        with open(f"{out}.{rank}", "w") as f:
+            json.dump({"rank": rank, "start": start, "losses": losses}, f)
+    print("ELASTIC_OK", rank)
+
+
 def spawn_entry():
     """Entry for the paddle.distributed.spawn path (module-level so the
     mp 'spawn' start method can pickle it by reference)."""
@@ -94,11 +238,16 @@ def spawn_entry():
 
 
 def main():
-    if len(sys.argv) > 1 and sys.argv[1] == "spawn":
+    mode = sys.argv[1] if len(sys.argv) > 1 else ""
+    if mode == "spawn":
         # parent: exercise the spawn API itself (env plumbing + join)
         import paddle_tpu.distributed as dist
         dist.spawn(spawn_entry, nprocs=2)
         print("SPAWN_PARENT_OK")
+    elif mode == "hybrid":
+        run_hybrid()
+    elif mode == "elastic":
+        run_elastic()
     else:
         run_rank()
 
