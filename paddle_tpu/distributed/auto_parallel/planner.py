@@ -474,6 +474,16 @@ class Planner:
             need = int(np.prod([n for _, n in axes]))
             mesh = Mesh(np.asarray(devs[:need]).reshape(
                 [n for _, n in axes]), tuple(a for a, _ in axes))
+            def train_run():
+                loss, _ = tstep(list(inputs), [])
+                return loss
+
+            def eval_run():
+                return estep(list(inputs))
+
+            # differentiation happens lazily inside the jitted step, so a
+            # non-differentiable model fails at the WARM-UP call, not at
+            # construction — the fallback must wrap both
             try:
                 opt = SGD(parameters=network.parameters(),
                           learning_rate=0.0)
@@ -486,17 +496,12 @@ class Planner:
                     return acc
 
                 tstep = make_train_step(network, loss_fn, opt, mesh=mesh)
-
-                def run():
-                    loss, _ = tstep(list(inputs), [])
-                    return loss
+                run = train_run
+                _block(run())               # compile + warm
             except Exception:
                 estep = make_eval_step(network, mesh=mesh)
-
-                def run():
-                    return estep(list(inputs))
-
-            _block(run())                   # compile + warm
+                run = eval_run
+                _block(run())               # forward-only fallback
             times = []
             for _ in range(steps):
                 t0 = _time.perf_counter()

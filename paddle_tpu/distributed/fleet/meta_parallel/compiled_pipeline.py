@@ -1,5 +1,5 @@
 """Compiled pipeline schedule: the WHOLE 1F1B lives inside one XLA
-program (r4, VERDICT item 10).
+program (r4, VERDICT item 10; generalized r5, VERDICT item 6).
 
 The host-scheduled engine (pipeline_parallel.py) dispatches one
 executable per stage per micro-batch — faithful to the reference's
@@ -14,11 +14,23 @@ THROUGH the scanned pipeline yields the reverse-schedule backward in the
 same compiled program (ppermute's vjp is the reverse permute), i.e.
 forward+backward pipelining with zero host involvement.
 
-Constraint (inherent to the stacked formulation): all stages run the
-SAME block function over identically-shaped weights — the uniform
-partition case (N identical transformer blocks), which is what
-compiled-schedule pipelining is for. Heterogeneous stages (embedding /
-head) stay on the host-scheduled engine, which remains the default.
+Generality (r5):
+
+* **n_micro and pp are independent** — the scan runs n_micro + pp - 1
+  ticks for any n_micro >= 1; out-of-range ticks compute on stale data
+  but only ever feed other out-of-range ticks, and the loss mask keeps
+  them out of the value AND the gradient.
+* **dp x pp meshes** — pass a mesh with ("dp", "pp") axes: micro-batches
+  shard their batch dim over "dp", stage weights replicate over it, the
+  schedule permutes within each dp slice, and the loss/grads average
+  across dp (shard_map's transpose inserts the gradient psum).
+* **heterogeneous first/last stages** (embedding / head) via PADDED
+  STACKING: first/last parameters are padded to a [pp, ...] stack that
+  is zeros off their stage, so every device runs one uniform program and
+  the stage index selects what contributes. The pad trades a redundant
+  first/last compute per stage for the single fused program — profitable
+  when embed/head cost ≪ block cost; for cases where it is not, the
+  host-scheduled engine remains the default for heterogeneous models.
 """
 from __future__ import annotations
 
@@ -34,24 +46,36 @@ __all__ = ["CompiledPipeline1F1B"]
 
 
 class CompiledPipeline1F1B:
-    """One-XLA-program GPipe/1F1B over a uniform block pipeline.
+    """One-XLA-program GPipe/1F1B over a (possibly dp-replicated) block
+    pipeline.
 
     block_fn(stage_params, x) -> y        pure jax, shape-preserving
     loss_fn(y, label) -> scalar           pure jax
+    first_fn(first_params, micro_in) -> x  optional input stage
+                                           (e.g. embedding: ids -> hidden)
+    last_fn(last_params, y) -> out        optional output stage applied
+                                          before loss_fn (e.g. LM head)
     stacked_params: pytree whose leaves have leading dim n_stages
                     (stage i's weights at index i), sharded P("pp", ...).
+                    With first/last stages: a dict
+                    {"blocks": ..., "first": ..., "last": ...} whose
+                    first/last entries are UNSTACKED (place() pads them).
 
-    step(micro_x [n_micro, mb, ...], micro_y [n_micro, ...]) returns
-    (mean micro loss, grads pytree stacked like the params).
+    step(params, micro_x [n_micro, mb, ...], micro_y [n_micro, ...])
+    returns (mean micro loss, grads pytree shaped like the params).
     """
 
     def __init__(self, block_fn: Callable, loss_fn: Callable,
                  n_stages: int, n_micro: int,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 first_fn: Optional[Callable] = None,
+                 last_fn: Optional[Callable] = None):
         if n_micro < 1 or n_stages < 2:
             raise ValueError("need n_micro >= 1 and n_stages >= 2")
         self.block_fn = block_fn
         self.loss_fn = loss_fn
+        self.first_fn = first_fn
+        self.last_fn = last_fn
         self.pp = n_stages
         self.n_micro = n_micro
         self.mesh = mesh or Mesh(
@@ -62,15 +86,31 @@ class CompiledPipeline1F1B:
         if self.mesh.shape["pp"] != n_stages:
             raise ValueError(
                 f"mesh pp axis {self.mesh.shape['pp']} != {n_stages}")
+        extra = [a for a in self.mesh.axis_names if a != "pp"]
+        if extra and extra != ["dp"]:
+            raise ValueError(
+                f"supported mesh axes are ('pp',) or ('dp', 'pp'); got "
+                f"{self.mesh.axis_names}")
+        self.dp = int(self.mesh.shape.get("dp", 1))
         self._jitted = None
         self._built_treedef = None
+
+    @property
+    def _het(self) -> bool:
+        return self.first_fn is not None or self.last_fn is not None
 
     # -- schedule (runs per-device inside shard_map) -----------------------
     def _pipeline(self, w_local, micro_x, micro_y):
         pp, n_micro = self.pp, self.n_micro
         stage = jax.lax.axis_index("pp")
-        # un-stack this device's stage weights (leading dim 1 locally)
-        w = jax.tree_util.tree_map(lambda a: a[0], w_local)
+        if self._het:
+            w = jax.tree_util.tree_map(lambda a: a[0], w_local["blocks"])
+            w_first = jax.tree_util.tree_map(lambda a: a[0],
+                                             w_local["first"])
+            w_last = jax.tree_util.tree_map(lambda a: a[0],
+                                            w_local["last"])
+        else:
+            w = jax.tree_util.tree_map(lambda a: a[0], w_local)
         fwd_perm = [(i, i + 1) for i in range(pp - 1)]
 
         def tick(carry, t):
@@ -81,57 +121,141 @@ class CompiledPipeline1F1B:
             # out-of-range ticks — the loss mask keeps them out of the
             # value AND the gradient.
             x0 = micro_x[jnp.clip(t, 0, n_micro - 1)]
+            if self.first_fn is not None:
+                # padded stacking: every device computes the input stage,
+                # but only stage 0's (real) parameters reach the value —
+                # elsewhere the where() discards it (and its gradient)
+                x0 = self.first_fn(w_first, x0)
             x = jnp.where(stage == 0, x0, act_in)
             y = self.block_fn(w, x)
             m = t - (pp - 1)
             valid = ((stage == pp - 1) & (m >= 0) & (m < n_micro))
             lbl = micro_y[jnp.clip(m, 0, n_micro - 1)]
+            out = y if self.last_fn is None else self.last_fn(w_last, y)
+            # double-where: invalid ticks evaluate loss_fn on a SAFE
+            # constant instead of the real (possibly all-zero padded)
+            # output — a singular partial (log/sqrt/div at 0) times the
+            # zero cotangent of the outer where would otherwise inject
+            # NaN into every stage's grads (the standard where-grad trap)
+            safe = jnp.where(valid, out, jnp.ones_like(out))
             loss_acc = loss_acc + jnp.where(
-                valid, self.loss_fn(y, lbl), 0.0)
+                valid, self.loss_fn(safe, lbl), 0.0)
             act_out = jax.lax.ppermute(y, "pp", fwd_perm)
             return (act_out, loss_acc), None
 
-        init = (jnp.zeros_like(micro_x[0]), jnp.float32(0.0))
+        if self.first_fn is not None:
+            # the permuted activation is hidden-shaped (first_fn output),
+            # not input-shaped: derive the carry shape without computing
+            a0 = jax.eval_shape(lambda mx: self.first_fn(w_first, mx),
+                                micro_x[0])
+            init_act = jnp.zeros(a0.shape, a0.dtype)
+        else:
+            init_act = jnp.zeros_like(micro_x[0])
+        init = (init_act, jnp.float32(0.0))
         (_, loss_acc), _ = jax.lax.scan(
             tick, init, jnp.arange(n_micro + pp - 1))
         # only the last stage accumulated loss; share it with everyone
-        return jax.lax.psum(loss_acc, "pp") / n_micro
+        loss = jax.lax.psum(loss_acc, "pp") / n_micro
+        if self.dp > 1:
+            loss = jax.lax.pmean(loss, "dp")
+        return loss
 
-    @staticmethod
-    def _stack_spec(a) -> P:
+    def _stack_spec(self, a) -> P:
         """One formula for the stacked-weight layout: stage dim over
         'pp', the rest replicated (shared by place() and the shard_map
-        in_specs — they must never drift apart)."""
+        in_specs — they must never drift apart). On a dp x pp mesh the
+        weights are replicated over dp implicitly (axis unnamed)."""
         return P("pp", *([None] * (a.ndim - 1)))
 
-    def _build(self, stacked_params):
-        stack_specs = jax.tree_util.tree_map(self._stack_spec,
-                                             stacked_params)
-        mapped = jax.shard_map(
-            self._pipeline, mesh=self.mesh,
-            in_specs=(stack_specs, P(), P()),
-            out_specs=P(), check_vma=False)
+    def _batch_spec(self, a) -> P:
+        """Micro-batch stream layout: [n_micro, mb, ...] with the batch
+        dim sharded over dp when present."""
+        if self.dp > 1 and a.ndim >= 2:
+            return P(None, "dp", *([None] * (a.ndim - 2)))
+        return P()
 
-        def value_and_grad(w, micro_x, micro_y):
-            return jax.value_and_grad(
-                lambda w_: mapped(w_, micro_x, micro_y))(w)
+    def _pad_stack(self, a, index: int):
+        """Pad an unstacked first/last param into a [pp, ...] stack that
+        is zeros off `index` (padded stacking; the zero rows live on the
+        other stages' devices and receive zero gradients). Built
+        HOST-side: a jnp pad would transiently materialize the full
+        pp x size array on one device before place() reshards it —
+        device_put from a numpy array transfers per-shard slices only."""
+        a = np.asarray(a)
+        out = np.zeros((self.pp,) + a.shape, a.dtype)
+        out[index] = a
+        return out
 
-        self._jitted = jax.jit(value_and_grad)
-        self._built_treedef = jax.tree_util.tree_structure(stacked_params)
+    def _prepare(self, params):
+        """Normalize user params into the stacked/padded layout."""
+        if not self._het:
+            return params
+        if not (isinstance(params, dict) and "blocks" in params
+                and set(params) <= {"blocks", "first", "last"}):
+            raise ValueError(
+                "heterogeneous pipeline expects params "
+                "{'blocks': stacked, 'first': ..., 'last': ...}")
+        out = {"blocks": params["blocks"]}
+        out["first"] = jax.tree_util.tree_map(
+            lambda a: self._pad_stack(a, 0), params.get("first", ()))
+        out["last"] = jax.tree_util.tree_map(
+            lambda a: self._pad_stack(a, self.pp - 1),
+            params.get("last", ()))
+        return out
 
-    def place(self, stacked_params):
-        """Commit the stacked weights onto the pp mesh (stage i's block
-        physically resident on device i)."""
+    def unpad(self, grads):
+        """Recover first/last grads from a heterogeneous step's stacked
+        grad pytree: {'blocks': stacked, 'first': unstacked, 'last':
+        unstacked}."""
+        if not self._het:
+            return grads
+        return {
+            "blocks": grads["blocks"],
+            "first": jax.tree_util.tree_map(lambda a: a[0],
+                                            grads["first"]),
+            "last": jax.tree_util.tree_map(lambda a: a[self.pp - 1],
+                                           grads["last"]),
+        }
+
+    def place(self, params):
+        """Commit the (normalized) stacked weights onto the mesh (stage
+        i's block physically resident on pp-slice i; padded first/last
+        rows land as zeros on the other stages)."""
+        params = self._prepare(params)
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(
                 a, NamedSharding(self.mesh, self._stack_spec(a))),
-            stacked_params)
+            params)
 
-    def step(self, stacked_params, micro_x, micro_y):
-        """(mean micro loss, stacked grads). Compile once per params tree
-        structure; the schedule, collectives, and the reverse-pipeline
-        backward are all inside the one executable."""
-        treedef = jax.tree_util.tree_structure(stacked_params)
+    def place_batch(self, micro_x):
+        """Shard a micro-batch stream [n_micro, mb, ...] over dp (no-op
+        on a pure pp mesh)."""
+        return jax.device_put(
+            micro_x, NamedSharding(self.mesh,
+                                   self._batch_spec(micro_x)))
+
+    def _build(self, placed_params, micro_x, micro_y):
+        stack_specs = jax.tree_util.tree_map(self._stack_spec,
+                                             placed_params)
+        mapped = jax.shard_map(
+            self._pipeline, mesh=self.mesh,
+            in_specs=(stack_specs, self._batch_spec(micro_x),
+                      self._batch_spec(micro_y)),
+            out_specs=P(), check_vma=False)
+
+        def value_and_grad(w, mx, my):
+            return jax.value_and_grad(
+                lambda w_: mapped(w_, mx, my))(w)
+
+        self._jitted = jax.jit(value_and_grad)
+        self._built_treedef = jax.tree_util.tree_structure(placed_params)
+
+    def step(self, placed_params, micro_x, micro_y):
+        """(mean micro loss, grads shaped like the placed params — use
+        unpad() to read heterogeneous first/last grads). Compile once per
+        params tree structure; the schedule, collectives, and the
+        reverse-pipeline backward are all inside the one executable."""
+        treedef = jax.tree_util.tree_structure(placed_params)
         if self._jitted is None or treedef != self._built_treedef:
-            self._build(stacked_params)
-        return self._jitted(stacked_params, micro_x, micro_y)
+            self._build(placed_params, micro_x, micro_y)
+        return self._jitted(placed_params, micro_x, micro_y)

@@ -22,6 +22,17 @@ N_MICRO = 4
 MB = 2           # micro-batch size
 
 
+@pytest.fixture(autouse=True)
+def _reset_fleet():
+    """The host-engine parity path initializes fleet with pp=4; leaving
+    that behind makes later suites' plan.apply() refuse (the
+    initialized-with-different-degrees guard)."""
+    yield
+    dist.fleet._state.initialized = False
+    from paddle_tpu.distributed import collective
+    collective.destroy_process_group()
+
+
 class Block(paddle.nn.Layer):
     """Shape-preserving block: tanh(x @ W + b)."""
 
@@ -159,3 +170,168 @@ class TestCompiledPipelineParity:
             losses.append(float(loss))
             w = jax.tree_util.tree_map(lambda p, g: p - 0.2 * g, w, grads)
         assert losses[-1] < losses[0] * 0.7, losses
+
+
+def _oracle(Ws, bs, x, y, pp, n_micro):
+    """Dense chain oracle for arbitrary (pp, n_micro)."""
+    def f(stack):
+        Ws_, bs_ = stack
+        total = 0.0
+        for m in range(n_micro):
+            h = x[m]
+            for s in range(pp):
+                h = jnp.tanh(h @ Ws_[s] + bs_[s])
+            total = total + _mse(h, y[m])
+        return total / n_micro
+
+    loss, grads = jax.value_and_grad(f)((jnp.asarray(Ws), jnp.asarray(bs)))
+    return float(loss), np.asarray(grads[0]), np.asarray(grads[1])
+
+
+class TestGeneralizedConfigs:
+    """r4 VERDICT item 6: the schedule must hold beyond the single
+    (pp=4, n_micro=4) point — n_micro != pp both ways, odd widths/batch,
+    pp=2 and pp=8, and a dp x pp mesh."""
+
+    @pytest.mark.parametrize("pp,n_micro,mb,h", [
+        (4, 2, 2, 16),     # n_micro < pp (bubble-heavy)
+        (4, 7, 2, 16),     # n_micro > pp, not a multiple
+        (2, 4, 3, 8),      # smallest pipeline, odd micro-batch
+        (8, 3, 2, 8),      # deep pipeline, few micros
+        (4, 4, 1, 5),      # odd hidden width, single-sample micros
+        (4, 1, 2, 16),     # degenerate single micro-batch
+    ])
+    def test_matches_oracle(self, pp, n_micro, mb, h):
+        rs = np.random.RandomState(pp * 100 + n_micro)
+        Ws = rs.randn(pp, h, h).astype(np.float32) * 0.3
+        bs = rs.randn(pp, h).astype(np.float32) * 0.1
+        x = rs.randn(n_micro, mb, h).astype(np.float32)
+        y = rs.randn(n_micro, mb, h).astype(np.float32)
+        eng = CompiledPipeline1F1B(_block_fn, _mse, pp, n_micro)
+        w = eng.place((jnp.asarray(Ws), jnp.asarray(bs)))
+        loss, grads = eng.step(w, jnp.asarray(x), jnp.asarray(y))
+        oloss, ogW, ogb = _oracle(Ws, bs, x, y, pp, n_micro)
+        np.testing.assert_allclose(float(loss), oloss, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads[0]), ogW, rtol=1e-4,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads[1]), ogb, rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_dp_times_pp_mesh(self):
+        """dp=2 x pp=4: batch shards over dp, stages over pp, loss and
+        grads equal the dense full-batch oracle."""
+        from jax.sharding import Mesh
+        pp, n_micro, mb, h = 4, 3, 4, 8      # mb 4 -> 2 per dp slice
+        rs = np.random.RandomState(11)
+        Ws = rs.randn(pp, h, h).astype(np.float32) * 0.3
+        bs = rs.randn(pp, h).astype(np.float32) * 0.1
+        x = rs.randn(n_micro, mb, h).astype(np.float32)
+        y = rs.randn(n_micro, mb, h).astype(np.float32)
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                    ("dp", "pp"))
+        eng = CompiledPipeline1F1B(_block_fn, _mse, pp, n_micro, mesh=mesh)
+        w = eng.place((jnp.asarray(Ws), jnp.asarray(bs)))
+        mx = eng.place_batch(jnp.asarray(x))
+        my = eng.place_batch(jnp.asarray(y))
+        # the batch really shards over dp
+        assert {s.data.shape for s in mx.addressable_shards} \
+            == {(n_micro, mb // 2, h)}
+        loss, grads = eng.step(w, mx, my)
+        oloss, ogW, ogb = _oracle(Ws, bs, x, y, pp, n_micro)
+        np.testing.assert_allclose(float(loss), oloss, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads[0]), ogW, rtol=1e-4,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads[1]), ogb, rtol=1e-4,
+                                   atol=1e-6)
+
+
+class TestHeterogeneousStages:
+    """r4 VERDICT item 6: embedding-in/head-out pipelines via padded
+    stacking — stage 0 additionally embeds token ids, the last stage
+    additionally projects to logits, all inside the one XLA program."""
+
+    V, H, T = 12, 8, 6     # vocab, hidden, seq
+
+    @staticmethod
+    def _embed(w_emb, ids):
+        (E,) = w_emb
+        return E[ids]                      # [mb, T] -> [mb, T, H]
+
+    @staticmethod
+    def _head(w_head, h):
+        (Wh,) = w_head
+        return h @ Wh                      # [mb, T, H] -> [mb, T, V]
+
+    @staticmethod
+    def _ce(logits, labels):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, labels[..., None], axis=-1))
+
+    def _setup(self, pp, n_micro, mb, seed=5):
+        rs = np.random.RandomState(seed)
+        E = rs.randn(self.V, self.H).astype(np.float32) * 0.3
+        Wh = rs.randn(self.H, self.V).astype(np.float32) * 0.3
+        Ws = rs.randn(pp, self.H, self.H).astype(np.float32) * 0.3
+        bs = rs.randn(pp, self.H).astype(np.float32) * 0.1
+        ids = rs.randint(0, self.V, (n_micro, mb, self.T)).astype(np.int32)
+        lbl = rs.randint(0, self.V, (n_micro, mb, self.T)).astype(np.int32)
+        return E, Wh, Ws, bs, ids, lbl
+
+    def _oracle(self, E, Wh, Ws, bs, ids, lbl, pp, n_micro):
+        def f(packed):
+            E_, Wh_, Ws_, bs_ = packed
+            total = 0.0
+            for m in range(n_micro):
+                h = E_[ids[m]]
+                for s in range(pp):
+                    h = jnp.tanh(h @ Ws_[s] + bs_[s])
+                total = total + self._ce(h @ Wh_, lbl[m])
+            return total / n_micro
+
+        loss, g = jax.value_and_grad(f)(
+            (jnp.asarray(E), jnp.asarray(Wh), jnp.asarray(Ws),
+             jnp.asarray(bs)))
+        return float(loss), [np.asarray(x) for x in g]
+
+    @pytest.mark.parametrize("pp,n_micro,mb", [(4, 4, 2), (4, 6, 2),
+                                               (2, 3, 3)])
+    def test_embedding_head_pipeline_matches_oracle(self, pp, n_micro, mb):
+        E, Wh, Ws, bs, ids, lbl = self._setup(pp, n_micro, mb)
+        eng = CompiledPipeline1F1B(
+            _block_fn, self._ce, pp, n_micro,
+            first_fn=self._embed, last_fn=self._head)
+        w = eng.place({"blocks": (jnp.asarray(Ws), jnp.asarray(bs)),
+                       "first": (jnp.asarray(E),),
+                       "last": (jnp.asarray(Wh),)})
+        loss, grads = eng.step(w, jnp.asarray(ids), jnp.asarray(lbl))
+        g = eng.unpad(grads)
+        oloss, (ogE, ogWh, ogW, ogb) = self._oracle(
+            E, Wh, Ws, bs, ids, lbl, pp, n_micro)
+        np.testing.assert_allclose(float(loss), oloss, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g["first"][0]), ogE,
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g["last"][0]), ogWh,
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g["blocks"][0]), ogW,
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g["blocks"][1]), ogb,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_padded_rows_get_zero_grads(self):
+        """Off-stage padded first/last rows must receive exactly zero
+        gradient (their compute is masked out of value and grad)."""
+        pp, n_micro, mb = 4, 4, 2
+        E, Wh, Ws, bs, ids, lbl = self._setup(pp, n_micro, mb)
+        eng = CompiledPipeline1F1B(
+            _block_fn, self._ce, pp, n_micro,
+            first_fn=self._embed, last_fn=self._head)
+        w = eng.place({"blocks": (jnp.asarray(Ws), jnp.asarray(bs)),
+                       "first": (jnp.asarray(E),),
+                       "last": (jnp.asarray(Wh),)})
+        _, grads = eng.step(w, jnp.asarray(ids), jnp.asarray(lbl))
+        gE = np.asarray(grads["first"][0])     # [pp, V, H]
+        gWh = np.asarray(grads["last"][0])     # [pp, H, V]
+        assert np.all(gE[1:] == 0)
+        assert np.all(gWh[:-1] == 0)
+        assert np.any(gE[0] != 0) and np.any(gWh[-1] != 0)
