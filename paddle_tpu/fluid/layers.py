@@ -403,3 +403,58 @@ def sequence_softmax(x, lengths=None, name=None):
 def data(name, shape, dtype="float32", lod_level=0):
     from ..static.program import data as _data
     return _data(name, shape, dtype)
+
+
+# -- r5 CTR / metric-learning long tail (ops/misc_ops.py) -------------------
+# reference: fluid/layers/nn.py continuous_value_model / center_loss /
+# teacher_student_sigmoid_loss / squared_l2_distance, and
+# contrib fused_embedding_seq_pool.
+
+
+def continuous_value_model(input, cvm, use_cvm=True):  # noqa: A002
+    from ..ops.misc_ops import cvm as _op
+    return _op(input, cvm, use_cvm=bool(use_cvm))
+
+
+def center_loss(input, label, num_classes, alpha, centers,  # noqa: A002
+                update_center=True):
+    """Returns (loss [N,1], sample_center_diff, centers_out); when
+    update_center, the caller assigns centers_out back (reference mutates
+    the Centers var in-kernel; here state is functional)."""
+    from ..ops.misc_ops import center_loss as _op
+    return _op(input, label, centers, alpha, cluster_num=int(num_classes),
+               need_update=bool(update_center))
+
+
+def squared_l2_distance(x, y):
+    from ..ops.misc_ops import squared_l2_distance as _op
+    sub, out = _op(x, y)
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label,  # noqa: A002
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    from ..ops.misc_ops import teacher_student_sigmoid_loss as _op
+    return _op(input, label, soft_max_up_bound=float(soft_max_up_bound),
+               soft_max_lower_bound=float(soft_max_lower_bound))
+
+
+def fused_embedding_seq_pool(input, size, ids, lengths=None,  # noqa: A002
+                             combiner="sum", padding_idx=-1):
+    """Padded form of the reference contrib op: `input` is the embedding
+    table tensor, ids [B, L] + lengths [B]. `size`, when given, is
+    validated against the table's [vocab, dim] (the reference builds the
+    table from it; here the tensor already exists)."""
+    import numpy as np2
+    from ..framework.tensor import Tensor as _T
+    from ..ops.misc_ops import fused_embedding_seq_pool as _op
+    if size is not None and tuple(size) != tuple(input.shape):
+        raise ValueError(
+            f"fused_embedding_seq_pool: size {tuple(size)} does not match "
+            f"the embedding table shape {tuple(input.shape)}")
+    if lengths is None:
+        lengths = _T(np2.full((ids.shape[0],), ids.shape[1], np2.int32),
+                     _internal=True)
+    return _op(input, ids, lengths, combiner=combiner,
+               padding_idx=int(padding_idx))

@@ -9,6 +9,8 @@ reference:
 """
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -159,3 +161,164 @@ def edit_distance_arrays(hyp, ref, hyp_len, ref_len, normalized=True,
             d /= n
         out[b, 0] = d
     return out, np.asarray([B], np.float32)
+
+
+# ---------------------------------------------------------------------------
+# CTR / metric-learning long tail (r5 VERDICT item 7)
+# reference:
+#   paddle/fluid/operators/cvm_op.cc / .h          — CTR show/click feature
+#   paddle/fluid/operators/center_loss_op.cc / .h  — center loss + update
+#   paddle/fluid/operators/squared_l2_distance_op.h
+#   paddle/fluid/operators/teacher_student_sigmoid_loss_op.h
+#   paddle/fluid/operators/fused/fused_embedding_seq_pool_op.h
+
+
+@jax.custom_vjp
+def _cvm_keep(x, cvm):
+    """use_cvm=True: y0 = log(x0+1), y1 = log(x1+1) - y0, rest copied."""
+    y0 = jnp.log(x[:, :1] + 1.0)
+    y1 = jnp.log(x[:, 1:2] + 1.0) - y0
+    return jnp.concatenate([y0, y1, x[:, 2:]], axis=1)
+
+
+def _cvm_keep_fwd(x, cvm):
+    return _cvm_keep(x, cvm), (cvm, x.shape[0])
+
+
+def _cvm_keep_bwd(res, dy):
+    # reference grad rule (cvm_op.h CvmGradComputeKernel): the show/click
+    # columns of dX are OVERWRITTEN with the CVM feature values — a CTR
+    # trick, not the mathematical gradient — the rest passes dY through
+    cvm, n = res
+    dx = jnp.concatenate([jnp.broadcast_to(cvm[:, :2], (n, 2)), dy[:, 2:]],
+                         axis=1)
+    return dx, jnp.zeros_like(cvm)
+
+
+_cvm_keep.defvjp(_cvm_keep_fwd, _cvm_keep_bwd)
+
+
+@jax.custom_vjp
+def _cvm_drop(x, cvm):
+    """use_cvm=False: strip the two cvm columns."""
+    return x[:, 2:]
+
+
+def _cvm_drop_fwd(x, cvm):
+    return _cvm_drop(x, cvm), (cvm, x.shape[0])
+
+
+def _cvm_drop_bwd(res, dy):
+    cvm, n = res
+    dx = jnp.concatenate([jnp.broadcast_to(cvm[:, :2], (n, 2)), dy], axis=1)
+    return dx, jnp.zeros_like(cvm)
+
+
+_cvm_drop.defvjp(_cvm_drop_fwd, _cvm_drop_bwd)
+
+
+@primitive("cvm_op")
+def cvm(x, cvm_feature, *, use_cvm=True):
+    """reference: cvm_op.h CvmComputeKernel — X [N, D] whose first two
+    columns are the (show, click) feature; CVM [N, 2]."""
+    return _cvm_keep(x, cvm_feature) if use_cvm \
+        else _cvm_drop(x, cvm_feature)
+
+
+@primitive("center_loss_op")
+def center_loss(x, label, centers, update_rate, *, cluster_num,
+                need_update=True):
+    """reference: center_loss_op.h CenterLossKernel — per-sample loss
+    0.5*||x - center[label]||^2, the sample-center diffs, and the updated
+    centers (count-normalized accumulated diffs scaled by the update
+    rate; counts start at 1 exactly like the reference). Gradients flow
+    to x only (centers update is a side output, as in the reference)."""
+    label = label.reshape(-1)
+    c = jax.lax.stop_gradient(centers)
+    diff = x - c[label]                          # [N, D]
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if need_update:
+        d = jax.lax.stop_gradient(diff)
+        acc = jnp.zeros_like(c).at[label].add(d)
+        counts = jnp.ones((cluster_num,), x.dtype).at[label].add(1.0)
+        alpha = jnp.asarray(update_rate).reshape(())  # float or tensor
+        centers_out = c + alpha * acc / counts[:, None]
+    else:
+        centers_out = c
+    return loss, diff, centers_out
+
+
+@primitive("squared_l2_distance_op")
+def squared_l2_distance(x, y):
+    """reference: squared_l2_distance_op.h — row-wise squared L2 with
+    first-dim broadcast of y; returns (sub_result [N, C], out [N])."""
+    xf = x.reshape(x.shape[0], -1)
+    yf = y.reshape(y.shape[0], -1)
+    sub = xf - yf                                # broadcasts y rows == 1
+    # Out is [N, 1] (reference InferShape: {x_dims[0], 1})
+    return sub, jnp.sum(sub * sub, axis=1, keepdims=True)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _ts_loss(x, label, up, lo):
+    """Forward on UNCLIPPED x (reference computes the loss unclipped and
+    applies the soft_max bounds only in the gradient kernel)."""
+    base = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return jnp.where(
+        label < -1.0, base,
+        jnp.where(label < 0.0, base - x,
+                  jnp.where(label < 1.0, 2.0 * base - x * label,
+                            (base - x) + base - x * (label - 1.0))))
+
+
+def _ts_loss_fwd(x, label, up, lo):
+    return _ts_loss(x, label, up, lo), (x, label)
+
+
+def _ts_loss_bwd(up, lo, res, dy):
+    # reference grad kernel: pred = sigmoid(bounded x); branch by label;
+    # ZERO gradient at/outside the bounds
+    x, label = res
+    xb = jnp.clip(x, lo, up)
+    pred = jax.nn.sigmoid(xb)
+    branch = jnp.where(label < -1.0, pred,
+                       jnp.where(label < 0.0, pred - 1.0,
+                                 2.0 * pred - label))
+    branch = jnp.where((x >= up) | (x <= lo), 0.0, branch)
+    return dy * branch, jnp.zeros_like(label)
+
+
+_ts_loss.defvjp(_ts_loss_fwd, _ts_loss_bwd)
+
+
+@primitive("teacher_student_sigmoid_loss_op")
+def teacher_student_sigmoid_loss(x, label, *, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference: teacher_student_sigmoid_loss_op.h — sigmoid CE against
+    a click signal z plus an optional teacher value z', encoded in one
+    label: -2 (no teacher, no click), -1 (no teacher, click),
+    [0, 1) = z' with no click, [1, 2] = 1 + z' with click. Forward is
+    unclipped; the soft_max bounds act on the GRADIENT (saturating it to
+    zero), exactly as the reference splits them."""
+    return _ts_loss(x, label, float(soft_max_up_bound),
+                    float(soft_max_lower_bound))
+
+
+@primitive("fused_embedding_seq_pool_op")
+def fused_embedding_seq_pool(w, ids, lengths, *, combiner="sum",
+                             padding_idx=-1):
+    """reference: fused/fused_embedding_seq_pool_op.h — lookup + per-
+    sequence sum pool in one op (the LoD input becomes the repo's padded
+    ids [B, L] + lengths [B] convention). Differentiable wrt the table
+    (the reference's sparse W grad is XLA's scatter-add here)."""
+    if combiner != "sum":
+        raise NotImplementedError(
+            f"fused_embedding_seq_pool combiner {combiner!r}: the "
+            "reference kernel implements 'sum' only "
+            "(fused_embedding_seq_pool_op.h EmbeddingVSumFunctor)")
+    emb = w[jnp.clip(ids, 0, w.shape[0] - 1)]        # [B, L, D]
+    t = jnp.arange(ids.shape[1])[None, :]
+    mask = (t < lengths[:, None])
+    if padding_idx >= 0:
+        mask = mask & (ids != padding_idx)
+    return jnp.sum(emb * mask[..., None].astype(w.dtype), axis=1)

@@ -23,16 +23,24 @@ __all__ = ["yolo_box", "yolo_loss", "roi_align", "roi_pool", "RoIPool",
            "matrix_nms", "anchor_generator", "density_prior_box",
            "distribute_fpn_proposals", "collect_fpn_proposals",
            "polygon_box_transform", "box_decoder_and_assign",
-           "retinanet_detection_output"]
+           "retinanet_detection_output",
+           # r5 detection long-tail (detection_extra.py)
+           "rpn_target_assign", "generate_proposal_labels",
+           "generate_mask_labels", "locality_aware_nms",
+           "roi_perspective_transform"]
 
 from .detection_extra import (anchor_generator, bipartite_match,  # noqa: E402,F401
                               box_clip, box_decoder_and_assign,
                               collect_fpn_proposals, density_prior_box,
-                              distribute_fpn_proposals, iou_similarity,
-                              matrix_nms, mine_hard_examples,
-                              polygon_box_transform,
+                              distribute_fpn_proposals,
+                              generate_mask_labels,
+                              generate_proposal_labels, iou_similarity,
+                              locality_aware_nms, matrix_nms,
+                              mine_hard_examples, polygon_box_transform,
                               retinanet_detection_output,
-                              sigmoid_focal_loss, target_assign)
+                              roi_perspective_transform,
+                              rpn_target_assign, sigmoid_focal_loss,
+                              target_assign)
 
 
 @primitive("roi_align", dynamic=True)

@@ -1,0 +1,464 @@
+"""r5 op long-tail (VERDICT item 7): cvm, center_loss,
+squared_l2_distance, teacher_student_sigmoid_loss,
+fused_embedding_seq_pool, and the detection tier
+(rpn_target_assign, generate_proposal_labels, generate_mask_labels,
+locality_aware_nms, roi_perspective_transform). Oracles: the reference
+kernels' formulas (cvm_op.h, center_loss_op.h,
+teacher_student_sigmoid_loss_op.h) and the reference unit-test numpy
+oracles (test_rpn_target_assign_op.py, test_generate_proposal_labels_op.py)
+with use_random=False."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.vision import ops as V
+
+from op_test import OpTest, get_numeric_gradient
+
+
+def T(a, stop_gradient=True):
+    t = paddle.to_tensor(np.asarray(a))
+    t.stop_gradient = stop_gradient
+    return t
+
+
+class TestCvm:
+    def test_use_cvm_forward(self):
+        x = np.array([[3.0, 1.0, 0.5, -2.0],
+                      [0.0, 7.0, 1.5, 2.5]], np.float32)
+        cvm = x[:, :2].copy()
+        out = fluid.layers.continuous_value_model(T(x), T(cvm), True)
+        y0 = np.log(x[:, :1] + 1)
+        y1 = np.log(x[:, 1:2] + 1) - y0
+        np.testing.assert_allclose(
+            out.numpy(), np.concatenate([y0, y1, x[:, 2:]], 1), rtol=1e-6)
+
+    def test_no_cvm_drops_columns(self):
+        x = np.random.RandomState(0).rand(3, 6).astype(np.float32)
+        cvm = x[:, :2].copy()
+        out = fluid.layers.continuous_value_model(T(x), T(cvm), False)
+        np.testing.assert_allclose(out.numpy(), x[:, 2:], rtol=1e-6)
+
+    @pytest.mark.parametrize("use_cvm", [True, False])
+    def test_reference_grad_rule(self, use_cvm):
+        """cvm_op.h CvmGradComputeKernel: dX's first two columns are the
+        CVM feature values themselves; the rest passes dY through."""
+        x = np.random.RandomState(1).rand(2, 5).astype(np.float32) + 0.5
+        cvm = np.array([[2.0, 3.0], [4.0, 5.0]], np.float32)
+        xt, ct = T(x, stop_gradient=False), T(cvm)
+        out = fluid.layers.continuous_value_model(xt, ct, use_cvm)
+        paddle.sum(out).backward()
+        g = xt.grad.numpy()
+        np.testing.assert_allclose(g[:, :2], cvm, rtol=1e-6)
+        np.testing.assert_allclose(g[:, 2:], np.ones_like(g[:, 2:]),
+                                   rtol=1e-6)
+
+
+class TestCenterLoss:
+    def test_loss_diff_and_center_update(self):
+        """center_loss_op.h: loss_i = 0.5||x_i - c_{y_i}||^2; centers_out
+        = c + alpha * acc_diff / (1 + count) (counts init to 1)."""
+        rs = np.random.RandomState(2)
+        N, D, C = 5, 4, 3
+        x = rs.randn(N, D).astype(np.float32)
+        label = np.array([0, 1, 1, 2, 1], np.int64)
+        centers = rs.randn(C, D).astype(np.float32)
+        alpha = np.array([0.5], np.float32)
+        loss, diff, cout = fluid.layers.center_loss(
+            T(x), T(label), C, T(alpha), T(centers), update_center=True)
+        ediff = x - centers[label]
+        np.testing.assert_allclose(diff.numpy(), ediff, rtol=1e-5)
+        np.testing.assert_allclose(
+            loss.numpy(), 0.5 * (ediff ** 2).sum(1, keepdims=True),
+            rtol=1e-5)
+        expect = centers.copy()
+        counts = np.ones(C)
+        acc = np.zeros((C, D))
+        for i, l in enumerate(label):
+            counts[l] += 1
+            acc[l] += ediff[i]
+        expect += 0.5 * acc / counts[:, None]
+        np.testing.assert_allclose(cout.numpy(), expect, rtol=1e-5)
+
+    def test_grad_matches_reference_rule(self):
+        """CenterLossGradKernel: dX = dLoss (broadcast) * diff."""
+        rs = np.random.RandomState(3)
+        x = rs.randn(4, 3).astype(np.float32)
+        label = np.array([0, 1, 0, 1], np.int64)
+        centers = rs.randn(2, 3).astype(np.float32)
+        xt = T(x, stop_gradient=False)
+        loss, _, _ = fluid.layers.center_loss(
+            xt, T(label), 2, T(np.array([0.1], np.float32)), T(centers),
+            update_center=False)
+        w = rs.rand(4, 1).astype(np.float32)
+        paddle.sum(loss * T(w)).backward()
+        np.testing.assert_allclose(xt.grad.numpy(),
+                                   w * (x - centers[label]), rtol=1e-5)
+
+
+class TestSquaredL2Distance(OpTest):
+    op_type = "squared_l2_distance_op"
+    inputs = {"x": np.random.RandomState(4).randn(5, 3).astype(np.float32),
+              "y": np.random.RandomState(5).randn(5, 3).astype(np.float32)}
+    attrs = {}
+
+    def ref_fn(self, x, y):
+        sub = x - y
+        return sub, (sub * sub).sum(1, keepdims=True)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+    def test_broadcast_y(self):
+        x = np.random.RandomState(6).randn(4, 3).astype(np.float32)
+        y = np.random.RandomState(7).randn(1, 3).astype(np.float32)
+        from paddle_tpu.ops.misc_ops import squared_l2_distance
+        sub, out = squared_l2_distance(T(x), T(y))
+        np.testing.assert_allclose(out.numpy().reshape(-1),
+                                   ((x - y) ** 2).sum(1), rtol=1e-5)
+
+
+class TestTeacherStudentSigmoidLoss(OpTest):
+    op_type = "teacher_student_sigmoid_loss_op"
+    # cover all four label branches: -2, -1, [0,1), [1,2]
+    inputs = {"x": np.array([0.7, -1.2, 2.0, -0.4, 0.9, 1.7],
+                            np.float32),
+              "label": np.array([-2.0, -1.0, 0.3, 0.8, 1.0, 1.6],
+                                np.float32)}
+    attrs = {}
+
+    def ref_fn(self, x, label):
+        base = np.maximum(x, 0) + np.log(1 + np.exp(-np.abs(x)))
+        out = np.where(
+            label < -1.0, base,
+            np.where(label < 0.0, base - x,
+                     np.where(label < 1.0, 2 * base - x * label,
+                              (base - x) + base - x * (label - 1.0))))
+        return out.astype(np.float32)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
+
+
+class TestFusedEmbeddingSeqPool(OpTest):
+    op_type = "fused_embedding_seq_pool_op"
+    _rs = np.random.RandomState(8)
+    inputs = {"w": _rs.randn(10, 4).astype(np.float32),
+              "ids": np.array([[1, 3, 5, 0], [2, 2, 0, 0]], np.int64),
+              "lengths": np.array([3, 2], np.int64)}
+    attrs = {"combiner": "sum", "padding_idx": -1}
+
+    def ref_fn(self, w, ids, lengths):
+        out = np.zeros((len(ids), w.shape[1]), np.float32)
+        for b in range(len(ids)):
+            for t in range(lengths[b]):
+                out[b] += w[ids[b, t]]
+        return out
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad_w(self):
+        self.check_grad(["w"])
+
+    def test_padding_idx_skipped(self):
+        from paddle_tpu.ops.misc_ops import fused_embedding_seq_pool
+        w = self.inputs["w"]
+        out = fused_embedding_seq_pool(
+            T(w), T(self.inputs["ids"]), T(self.inputs["lengths"]),
+            combiner="sum", padding_idx=2)
+        expect = np.zeros((2, 4), np.float32)
+        expect[0] = w[1] + w[3] + w[5]
+        expect[1] = 0  # both in-length ids are the padding idx
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# detection tier
+
+
+def _rpn_oracle(iou, batch, pos, neg, fg_frac):
+    """Reference oracle (test_rpn_target_assign_op.py) with
+    use_random=False."""
+    a2g_arg = iou.argmax(1)
+    a2g_max = iou[np.arange(iou.shape[0]), a2g_arg]
+    g2a_max = iou.max(0)
+    labels = np.full((iou.shape[0],), -1, np.int32)
+    labels[np.where(iou == g2a_max)[0]] = 1
+    labels[a2g_max >= pos] = 1
+    num_fg = int(fg_frac * batch)
+    fg = np.where(labels == 1)[0]
+    labels[fg[num_fg:]] = -1
+    fg = np.where(labels == 1)[0]
+    num_bg = batch - len(fg)
+    bg = np.where(a2g_max < neg)[0]
+    enable = bg[:num_bg]
+    n_fake = int(np.isin(enable, fg).sum())
+    labels[enable] = 0
+    fg = np.where(labels == 1)[0]
+    bg = np.where(labels == 0)[0]
+    loc = np.hstack([[fg[0]] * n_fake, fg]).astype(np.int64)
+    score = np.hstack([fg, bg])
+    return loc, score, labels[score], n_fake
+
+
+class TestRpnTargetAssign:
+    def _case(self):
+        rs = np.random.RandomState(9)
+        anchors = np.stack([
+            rs.uniform(0, 40, 24), rs.uniform(0, 40, 24),
+            rs.uniform(42, 80, 24), rs.uniform(42, 80, 24)], axis=1) \
+            .astype(np.float32)
+        gts = np.array([[5, 5, 45, 45], [30, 30, 75, 75]], np.float32)
+        im_info = np.array([100.0, 100.0, 1.0], np.float32)
+        return anchors, gts, im_info
+
+    def test_matches_reference_oracle(self):
+        anchors, gts, im_info = self._case()
+        loc, score, lbl, tgt, inw = V.rpn_target_assign(
+            T(anchors), T(gts), None, T(im_info),
+            rpn_batch_size_per_im=16, rpn_straddle_thresh=-1,
+            rpn_fg_fraction=0.5, rpn_positive_overlap=0.6,
+            rpn_negative_overlap=0.3, use_random=False)
+        from paddle_tpu.vision.detection_extra import _np_iou_matrix
+        iou = _np_iou_matrix(anchors, gts)
+        eloc, escore, elbl, n_fake = _rpn_oracle(iou, 16, 0.6, 0.3, 0.5)
+        np.testing.assert_array_equal(loc.numpy(), eloc)
+        np.testing.assert_array_equal(score.numpy(), escore)
+        np.testing.assert_array_equal(lbl.numpy().reshape(-1), elbl)
+        assert tgt.numpy().shape == (len(eloc), 4)
+        inww = inw.numpy()
+        assert np.all(inww[:n_fake] == 0) and np.all(inww[n_fake:] == 1)
+
+    def test_straddle_filter(self):
+        anchors = np.array([[-10, -10, 5, 5], [10, 10, 40, 40]], np.float32)
+        gts = np.array([[12, 12, 38, 38]], np.float32)
+        im_info = np.array([50.0, 50.0, 1.0], np.float32)
+        loc, score, lbl, tgt, inw = V.rpn_target_assign(
+            T(anchors), T(gts), None, T(im_info),
+            rpn_batch_size_per_im=4, rpn_straddle_thresh=0.0,
+            use_random=False)
+        # the out-of-image anchor (index 0) never appears
+        assert 0 not in set(loc.numpy()) | set(score.numpy())
+
+
+class TestGenerateProposalLabels:
+    def test_sampling_and_targets(self):
+        rs = np.random.RandomState(10)
+        gts = np.array([[10, 10, 30, 30], [40, 40, 70, 70]], np.float32)
+        gcls = np.array([1, 2], np.int64)
+        crowd = np.zeros(2, np.int64)
+        # proposals: 2 near-gt (fg), 2 far (bg)
+        rois = np.array([[11, 11, 31, 31], [41, 39, 69, 71],
+                         [0, 0, 8, 8], [80, 80, 95, 95]], np.float32)
+        im_info = np.array([100, 100, 1.0], np.float32)
+        out = V.generate_proposal_labels(
+            T(rois), T(gcls), T(crowd), T(gts), T(im_info),
+            batch_size_per_im=6, fg_fraction=0.5, fg_thresh=0.5,
+            bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=4,
+            use_random=False)
+        srois, labels, tgt, inw, outw = [o.numpy() for o in out]
+        labels = labels.reshape(-1)
+        # gt boxes join the pool -> 4 fg candidates, capped at 3
+        n_fg = int((labels > 0).sum())
+        assert n_fg == 3
+        assert set(labels[labels > 0]) <= {1, 2}
+        assert np.all(labels[n_fg:] == 0)
+        assert tgt.shape == (len(labels), 16) and inw.shape == tgt.shape
+        # fg rows put their deltas at the label's 4-col slot
+        for i in range(n_fg):
+            c = labels[i]
+            assert inw[i, 4 * c:4 * c + 4].sum() == 4
+        np.testing.assert_array_equal(outw, (inw > 0).astype(np.float32))
+
+
+class TestGenerateMaskLabels:
+    def test_square_polygon_mask(self):
+        im_info = np.array([50, 50, 1.0], np.float32)
+        gcls = np.array([1], np.int64)
+        crowd = np.array([0], np.int64)
+        # gt instance: a 10..30 square polygon
+        segms = [[np.array([10, 10, 30, 10, 30, 30, 10, 30], np.float32)]]
+        labels = np.array([1, 0], np.int64)       # roi0 fg, roi1 bg
+        rois = np.array([[10, 10, 30, 30], [0, 0, 8, 8]], np.float32)
+        mrois, has_mask, mask = V.generate_mask_labels(
+            T(im_info), T(gcls), T(crowd), segms, T(labels), T(rois),
+            num_classes=3, resolution=8)
+        np.testing.assert_allclose(mrois.numpy(), rois[:1])
+        np.testing.assert_array_equal(has_mask.numpy(), [0])
+        m = mask.numpy().reshape(1, 3, 8, 8)
+        assert np.all(m[0, 0] == -1) and np.all(m[0, 2] == -1)
+        # the roi == polygon box: the mask is (nearly) all ones
+        assert m[0, 1].sum() >= 60
+        assert set(np.unique(m[0, 1])) <= {0, 1}
+
+    def test_no_fg_falls_back_to_bg_sentinel(self):
+        im_info = np.array([50, 50, 1.0], np.float32)
+        segms = [[np.array([0, 0, 10, 0, 10, 10, 0, 10], np.float32)]]
+        labels = np.array([0, 0], np.int64)
+        rois = np.array([[0, 0, 10, 10], [5, 5, 20, 20]], np.float32)
+        mrois, has_mask, mask = V.generate_mask_labels(
+            T(im_info), T(np.array([1], np.int64)),
+            T(np.array([0], np.int64)), segms, T(labels), T(rois),
+            num_classes=2, resolution=4)
+        assert mrois.numpy().shape == (1, 4)
+        assert np.all(mask.numpy() == -1)
+
+
+class TestLocalityAwareNms:
+    def test_merge_then_nms(self):
+        """Two heavily-overlapping detections merge score-weighted (scores
+        ADD); a disjoint one survives separately."""
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [50, 50, 60, 60]], np.float32)
+        scores = np.array([[0.8, 0.4, 0.9]], np.float32)
+        out = V.locality_aware_nms(
+            T(boxes), T(scores), score_threshold=0.1, nms_top_k=10,
+            keep_top_k=10, nms_threshold=0.3).numpy()
+        assert out.shape == (2, 6)
+        # PolyWeightedMerge: each box weighted by ITS OWN score
+        merged = (boxes[1] * 0.4 + boxes[0] * 0.8) / 1.2
+        row = out[np.argmax(out[:, 1])]
+        np.testing.assert_allclose(row[1], 1.2, rtol=1e-5)
+        np.testing.assert_allclose(row[2:], merged, rtol=1e-5)
+
+    def test_quad_boxes_poly_iou(self):
+        """8-point quads: same-square quads merge via PolyIoU."""
+        q = np.array([[0, 0, 10, 0, 10, 10, 0, 10],
+                      [0, 0, 10, 0, 10, 10, 0, 10],
+                      [30, 30, 40, 30, 40, 40, 30, 40]], np.float32)
+        scores = np.array([[0.5, 0.5, 0.7]], np.float32)
+        out = V.locality_aware_nms(
+            T(q), T(scores), score_threshold=0.1, nms_top_k=10,
+            keep_top_k=10, nms_threshold=0.3).numpy()
+        assert out.shape == (2, 10)
+        assert abs(out[:, 1].max() - 1.0) < 1e-5  # 0.5 + 0.5 merged
+
+
+class TestRoiPerspectiveTransform:
+    def test_axis_aligned_roi_identity_patch(self):
+        """An axis-aligned square ROI warps to (a resampling of) the
+        underlying patch; constant features stay constant."""
+        x = np.ones((1, 2, 12, 12), np.float32)
+        x[0, 1] = 3.0
+        rois = np.array([[2, 2, 9, 2, 9, 9, 2, 9]], np.float32)
+        out, mask = V.roi_perspective_transform(T(x), T(rois), 4, 4, 1.0)
+        o = out.numpy()
+        assert o.shape == (1, 2, 4, 4)
+        np.testing.assert_allclose(o[0, 0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(o[0, 1], 3.0, rtol=1e-5)
+        assert np.all(mask.numpy() == 1)
+
+    def test_gradient_flows_to_features(self):
+        rs = np.random.RandomState(11)
+        xv = rs.rand(1, 1, 10, 10).astype(np.float32)
+        rois = np.array([[1, 1, 8, 1, 8, 8, 1, 8]], np.float32)
+        xt = T(xv, stop_gradient=False)
+        out, _ = V.roi_perspective_transform(xt, T(rois), 3, 3, 1.0)
+        paddle.sum(out).backward()
+        g = xt.grad.numpy()
+        assert g.shape == xv.shape and g.sum() > 0
+
+        # numeric check on a few feature entries
+        from paddle_tpu.ops.pallas_kernels import attention_path_counts  # noqa
+        from paddle_tpu.framework.dispatch import OPS
+        prim = OPS["roi_perspective_transform_op"]
+
+        def fn(xx):
+            o, _ = prim.fn(xx, rois, transformed_height=3,
+                           transformed_width=3, spatial_scale=1.0)
+            return np.asarray(o)
+
+        num = get_numeric_gradient(
+            lambda xx, rr: prim.fn(xx, rr, transformed_height=3,
+                                   transformed_width=3,
+                                   spatial_scale=1.0)[0],
+            [xv, rois], 0, delta=1e-3)
+        np.testing.assert_allclose(g, num, rtol=5e-2, atol=1e-4)
+
+    def test_out_of_bounds_masked_zero(self):
+        x = np.ones((1, 1, 6, 6), np.float32)
+        rois = np.array([[-4, -4, 3, -4, 3, 3, -4, 3]], np.float32)
+        out, mask = V.roi_perspective_transform(T(x), T(rois), 4, 4, 1.0)
+        m = mask.numpy()[0, 0]
+        assert m.min() == 0          # some samples fall outside
+        o = out.numpy()[0, 0]
+        assert np.all(o[m == 0] == 0)
+
+
+class TestReviewRegressions:
+    def test_rpn_all_crowd_gts_yields_no_positives(self):
+        """All-crowd (or empty) gt: every anchor must be background, not
+        all-positive via the 0==0 IoU match (r5 review finding)."""
+        anchors = np.array([[0, 0, 10, 10], [20, 20, 40, 40],
+                            [5, 5, 30, 30]], np.float32)
+        gts = np.array([[1, 1, 9, 9]], np.float32)
+        crowd = np.array([1], np.int64)
+        im_info = np.array([50, 50, 1.0], np.float32)
+        loc, score, lbl, tgt, inw = V.rpn_target_assign(
+            T(anchors), T(gts), T(crowd), T(im_info),
+            rpn_batch_size_per_im=4, rpn_straddle_thresh=-1,
+            use_random=False)
+        assert len(loc.numpy()) == 0
+        assert np.all(lbl.numpy() == 0)
+
+    def test_proposal_labels_empty_gt_all_background(self):
+        rois = np.array([[0, 0, 10, 10], [20, 20, 40, 40]], np.float32)
+        out = V.generate_proposal_labels(
+            T(rois), T(np.zeros(0, np.int64)), T(np.zeros(0, np.int64)),
+            T(np.zeros((0, 4), np.float32)),
+            T(np.array([50, 50, 1.0], np.float32)),
+            batch_size_per_im=4, class_nums=3, use_random=False)
+        labels = out[1].numpy().reshape(-1)
+        assert len(labels) == 2 and np.all(labels == 0)
+
+    def test_mask_labels_unscale_rois(self):
+        """With im_scale=2, rois are in scaled coords; the mask must still
+        align with the original-coordinate polygon (r5 review finding)."""
+        im_info = np.array([100, 100, 2.0], np.float32)
+        segms = [[np.array([10, 10, 30, 10, 30, 30, 10, 30], np.float32)]]
+        labels = np.array([1], np.int64)
+        rois_scaled = np.array([[20, 20, 60, 60]], np.float32)  # = box*2
+        mrois, _, mask = V.generate_mask_labels(
+            T(im_info), T(np.array([1], np.int64)),
+            T(np.array([0], np.int64)), segms, T(labels), T(rois_scaled),
+            num_classes=2, resolution=8)
+        m = mask.numpy().reshape(1, 2, 8, 8)
+        assert m[0, 1].sum() >= 60            # roi covers the polygon
+        np.testing.assert_allclose(mrois.numpy(), rois_scaled)
+
+    def test_teacher_student_forward_unclipped_grad_saturates(self):
+        """Forward uses unclipped x; gradient is ZERO beyond the bounds
+        (reference grad-kernel split, r5 review finding)."""
+        x = np.array([20.0, 0.5], np.float32)
+        lbl = np.array([-2.0, -2.0], np.float32)
+        from paddle_tpu.ops.misc_ops import teacher_student_sigmoid_loss
+        xt = T(x, stop_gradient=False)
+        out = teacher_student_sigmoid_loss(xt, T(lbl))
+        np.testing.assert_allclose(
+            out.numpy()[0], 20.0 + np.log1p(np.exp(-20.0)), rtol=1e-6)
+        paddle.sum(out).backward()
+        g = xt.grad.numpy()
+        assert g[0] == 0.0                     # saturated at the bound
+        assert abs(g[1] - 1 / (1 + np.exp(-0.5))) < 1e-5
+
+    def test_squared_l2_out_is_rank2(self):
+        from paddle_tpu.ops.misc_ops import squared_l2_distance
+        x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        _, out = squared_l2_distance(T(x), T(x * 0.5))
+        assert out.numpy().shape == (4, 1)
+
+    def test_center_loss_float_alpha(self):
+        from paddle_tpu.ops.misc_ops import center_loss
+        x = np.random.RandomState(1).randn(3, 2).astype(np.float32)
+        out = center_loss(T(x), T(np.array([0, 1, 0], np.int64)),
+                          T(np.zeros((2, 2), np.float32)), 0.5,
+                          cluster_num=2, need_update=True)
+        assert out[2].numpy().shape == (2, 2)
