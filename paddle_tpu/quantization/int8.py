@@ -169,6 +169,21 @@ def _require_scale(path, wrapped_scale, act_scales, key):
     return scale
 
 
+def _wrapper_scale(path, sub, act_scales):
+    """Activation scale for a Quantized* wrapper, in precedence order:
+    the wrapper's fixed PTQ act_scale, then an EXPLICITLY passed
+    act_scales entry (the caller's calibration must beat implicit
+    state), then the QAT-tracked moving-average abs-max."""
+    if sub.act_scale is not None:
+        return sub.act_scale
+    explicit = (act_scales or {}).get(path + ".inner",
+                                      (act_scales or {}).get(path))
+    if explicit is not None:
+        return explicit
+    return _require_scale(path, getattr(sub, "_ma_scale", None),
+                          act_scales, path + ".inner")
+
+
 def convert_to_int8(model: Layer, act_scales=None, _prefix="") -> Layer:
     """Swap calibrated Quantized*/raw Linear/Conv2D layers for TRUE int8
     layers (reference: ConvertToInt8Pass). `act_scales` maps layer path →
@@ -183,8 +198,7 @@ def convert_to_int8(model: Layer, act_scales=None, _prefix="") -> Layer:
         path = _prefix + name
         if isinstance(sub, QuantizedLinear):
             model._sub_layers[name] = Int8Linear(
-                sub.inner, _require_scale(path, sub.act_scale, act_scales,
-                                          path + ".inner"))
+                sub.inner, _wrapper_scale(path, sub, act_scales))
         elif isinstance(sub, QuantizedConv2D):
             if not _conv_int8_supported(sub.inner):
                 warnings.warn(f"convert_to_int8: conv {path!r} is grouped "
@@ -192,8 +206,7 @@ def convert_to_int8(model: Layer, act_scales=None, _prefix="") -> Layer:
                               "path", stacklevel=2)
                 continue
             model._sub_layers[name] = Int8Conv2D(
-                sub.inner, _require_scale(path, sub.act_scale, act_scales,
-                                          path + ".inner"))
+                sub.inner, _wrapper_scale(path, sub, act_scales))
         elif type(sub).__name__ == "Linear" and act_scales \
                 and path in act_scales:
             model._sub_layers[name] = Int8Linear(sub, act_scales[path])

@@ -838,6 +838,11 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms,
         np.zeros((0, 4), np.float32)
 
     fg = np.where(labels > 0)[0]
+    if len(fg) and len(poly_boxes) == 0:
+        # fg rois but no usable (non-crowd, labeled) polygon instance:
+        # fall through to the background sentinel rather than crash on an
+        # empty IoU argmax (r5 review finding)
+        fg = fg[:0]
     if len(fg):
         roi_has_mask = fg.copy()
         cls = labels[fg]
@@ -991,6 +996,11 @@ def _roi_perspective_transform(x, rois, *, transformed_height,
     H, W]; rois: [R, 8] all on image 0 (single-image form). Returns
     (out [R, C, th, tw], mask [R, 1, th, tw])."""
     N, C, H, W = x.shape
+    if N != 1:
+        raise NotImplementedError(
+            "roi_perspective_transform: single-image form (N=1); sampling "
+            f"got a batch of {N} — slice the image the rois belong to "
+            "(the reference distributes rois per image via LoD)")
     R = rois.shape[0]
     rx = rois[:, 0::2] * spatial_scale                     # [R, 4]
     ry = rois[:, 1::2] * spatial_scale

@@ -462,3 +462,52 @@ class TestReviewRegressions:
                           T(np.zeros((2, 2), np.float32)), 0.5,
                           cluster_num=2, need_update=True)
         assert out[2].numpy().shape == (2, 2)
+
+    def test_mask_labels_all_crowd_gts_sentinel(self):
+        """fg rois but every gt crowd: background sentinel, not an
+        argmax-over-empty crash (r5 review finding)."""
+        im_info = np.array([50, 50, 1.0], np.float32)
+        segms = [[np.array([0, 0, 10, 0, 10, 10, 0, 10], np.float32)]]
+        mrois, hm, mask = V.generate_mask_labels(
+            T(im_info), T(np.array([1], np.int64)),
+            T(np.array([1], np.int64)),   # crowd
+            segms, T(np.array([1, 0], np.int64)),
+            T(np.array([[0, 0, 10, 10], [5, 5, 20, 20]], np.float32)),
+            num_classes=2, resolution=4)
+        assert np.all(mask.numpy() == -1)
+
+    def test_roi_perspective_batch_guard(self):
+        x = np.ones((2, 1, 6, 6), np.float32)
+        rois = np.array([[1, 1, 4, 1, 4, 4, 1, 4]], np.float32)
+        with pytest.raises(NotImplementedError, match="single-image"):
+            V.roi_perspective_transform(T(x), T(rois), 3, 3, 1.0)
+
+
+class TestQatScaleHygiene:
+    def test_eval_forward_does_not_pollute_ma_scale(self):
+        from paddle_tpu.quantization import (ImperativeQuantAware,
+                                             collect_qat_act_scales)
+        paddle.seed(0)
+        net = ImperativeQuantAware().quantize(
+            paddle.nn.Sequential(paddle.nn.Linear(4, 2)))
+        small = paddle.to_tensor(np.full((2, 4), 0.1, np.float32))
+        huge = paddle.to_tensor(np.full((2, 4), 100.0, np.float32))
+        net.train()
+        net(small)
+        s1 = collect_qat_act_scales(net)
+        net.eval()
+        net(huge)                       # must NOT move the stat
+        assert collect_qat_act_scales(net) == s1
+
+    def test_explicit_act_scales_beat_tracked(self):
+        from paddle_tpu.quantization import ImperativeQuantAware
+        from paddle_tpu.quantization.int8 import convert_to_int8
+        paddle.seed(0)
+        net = ImperativeQuantAware().quantize(
+            paddle.nn.Sequential(paddle.nn.Linear(4, 2)))
+        net.train()
+        net(paddle.to_tensor(np.full((2, 4), 0.1, np.float32)))
+        int8 = convert_to_int8(net, act_scales={"0": 7.0})
+        lin = int8[0]
+        # Int8Linear stores the per-step size (_act_step = scale/127)
+        assert abs(float(lin.act_scale) * 127.0 - 7.0) < 1e-4

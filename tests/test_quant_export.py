@@ -243,3 +243,68 @@ class TestLeNetAccuracyDrop:
         qlogits = qnet(paddle.to_tensor(xs)).numpy()
         acc_int8 = float((qlogits.argmax(1) == ys).mean())
         assert acc_int8 >= acc_fp32 - 0.02, (acc_fp32, acc_int8)
+
+
+class TestQATEndToEnd:
+    def test_qat_train_then_int8_deploy_accuracy(self):
+        """r4 VERDICT item 8: TRAIN with fake-quant inserted (eager QAT —
+        the wrappers track moving-average activation scales), convert to
+        true int8, and hold deploy accuracy within 1 point of the
+        fp32-trained model (reference: slim QAT acceptance flow,
+        quantization_pass.py + ConvertToInt8Pass)."""
+        import os
+        os.environ.setdefault("PADDLE_TPU_SYNTH_SAMPLES", "512")
+        from paddle_tpu.quantization import (ImperativeQuantAware,
+                                             collect_qat_act_scales,
+                                             convert_to_int8)
+        from paddle_tpu.vision.datasets import MNIST
+        from paddle_tpu.vision.models import LeNet
+
+        train = MNIST(mode="train")
+        test = MNIST(mode="test")
+        n = min(256, len(test))
+        xs_test = np.stack([test[i][0] for i in range(n)]).astype(np.float32)
+        ys_test = np.asarray([int(test[i][1]) for i in range(n)])
+        xb = np.stack([train[i][0] for i in range(448)]).astype(np.float32)
+        yb = np.asarray([int(train[i][1]) for i in range(448)], np.int64)
+
+        def eager_train(net, steps=70, bs=64):
+            opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                        learning_rate=1e-3)
+            ce = paddle.nn.CrossEntropyLoss()
+            for s in range(steps):
+                i = (s * bs) % len(xb)
+                x = paddle.to_tensor(xb[i:i + bs])
+                y = paddle.to_tensor(yb[i:i + bs])
+                loss = ce(net(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return net
+
+        def acc(net):
+            net.eval()
+            logits = net(paddle.to_tensor(xs_test)).numpy()
+            net.train()
+            return float((logits.argmax(1) == ys_test).mean())
+
+        # fp32 baseline (identical init via the seed)
+        paddle.seed(0)
+        fp32 = eager_train(LeNet())
+        acc_fp32 = acc(fp32)
+
+        # QAT: same init, fake-quant in the training graph
+        paddle.seed(0)
+        qat = ImperativeQuantAware().quantize(LeNet())
+        qat = eager_train(qat)
+        scales = collect_qat_act_scales(qat)
+        assert scales and all(v > 0 for v in scales.values())
+
+        int8 = convert_to_int8(qat)
+        acc_int8 = acc(int8)
+        # the deployed model is REALLY int8
+        from paddle_tpu.quantization.int8 import Int8Conv2D, Int8Linear
+        kinds = [type(l).__name__ for l in int8.sublayers()]
+        assert "Int8Linear" in kinds and "Int8Conv2D" in kinds
+        assert acc_fp32 > 0.5, acc_fp32           # training actually worked
+        assert acc_int8 >= acc_fp32 - 0.01, (acc_fp32, acc_int8)
