@@ -95,12 +95,8 @@ _CONFIG_DEFAULTS = {
 # silently training without the feature (the reference's meta-optimizer
 # `_can_apply` would at least have logged a fallback).
 _UNIMPLEMENTED = {
-    "dgc": "DGC sparsified allreduce targets slow Ethernet; dense psum over "
-           "ICI is faster (README: Scope cuts)",
     "adaptive_localsgd": "use strategy.localsgd with explicit "
                          "localsgd_configs instead",
-    "fp16_allreduce": "XLA already reduces in bf16 where safe under AMP "
-                      "(README: Scope cuts)",
     "a_sync": "parameter-server family is out of scope; shard embeddings "
               "over the mesh instead (README: Scope cuts)",
     "heter_ccl_mode": "GPU+CPU heterogeneous rings have no TPU meaning "

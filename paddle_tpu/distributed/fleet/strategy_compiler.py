@@ -118,12 +118,47 @@ class LocalSGDMeta(MetaOptimizerBase):
                                  begin_step=cfg["begin_step"])
 
 
+class DGCMeta(MetaOptimizerBase):
+    """reference: fleet/meta_optimizers/dgc_optimizer.py — requires a
+    Momentum-family inner optimizer there; here any optimizer with a
+    parameter list works (the momentum correction lives in the wrapper)."""
+
+    switch = "dgc"
+    conflicts = ("localsgd", "fp16_allreduce")
+    stage = "post"
+
+    def _can_apply(self, strategy, optimizer):
+        return hasattr(optimizer, "_parameter_list")
+
+    def apply(self, optimizer, strategy, hcg):
+        from .dygraph_optimizer import DGCOptimizer
+        cfg = strategy.dgc_configs
+        return DGCOptimizer(optimizer, hcg=hcg,
+                            rampup_begin_step=cfg["rampup_begin_step"],
+                            rampup_step=cfg["rampup_step"],
+                            sparsity=cfg.get("sparsity", [0.999]))
+
+
+class Fp16AllreduceMeta(MetaOptimizerBase):
+    switch = "fp16_allreduce"
+    conflicts = ("dgc",)
+    stage = "post"
+
+    def _can_apply(self, strategy, optimizer):
+        return hasattr(optimizer, "_parameter_list")
+
+    def apply(self, optimizer, strategy, hcg):
+        from .dygraph_optimizer import Fp16AllreduceOptimizer
+        return Fp16AllreduceOptimizer(optimizer, hcg=hcg)
+
+
 class StrategyCompiler:
     """Resolves which metas fire, in what order, and that none conflict
     (reference: strategy_compiler.py StrategyCompiler.generate_optimizer)."""
 
     METAS: List[MetaOptimizerBase] = [LarsMeta(), LambMeta(),
-                                      LocalSGDMeta()]
+                                      LocalSGDMeta(), DGCMeta(),
+                                      Fp16AllreduceMeta()]
 
     def select(self, strategy, optimizer) -> List[MetaOptimizerBase]:
         chosen = [m for m in self.METAS if m.enabled(strategy)]

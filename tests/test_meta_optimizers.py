@@ -109,8 +109,7 @@ class TestFleetMetaOptimizers:
 
 
 class TestStrategyHonesty:
-    @pytest.mark.parametrize("switch", ["dgc", "adaptive_localsgd",
-                                        "fp16_allreduce", "a_sync",
+    @pytest.mark.parametrize("switch", ["adaptive_localsgd", "a_sync",
                                         "heter_ccl_mode"])
     def test_unimplemented_switches_raise(self, switch):
         strategy = dist.fleet.DistributedStrategy()
@@ -125,7 +124,7 @@ class TestStrategyHonesty:
     def test_implemented_switches_accepted(self):
         strategy = dist.fleet.DistributedStrategy()
         for s in ["localsgd", "lars", "lamb", "recompute", "sharding",
-                  "gradient_merge", "amp"]:
+                  "gradient_merge", "amp", "dgc", "fp16_allreduce"]:
             setattr(strategy, s, True)
             assert getattr(strategy, s) is True
 
@@ -186,3 +185,172 @@ class TestStrategyCompiler:
         wrapped.step()
         wrapped.clear_grad()
         _reset_fleet()
+
+
+class TestDGC:
+    """DGC semantics (reference: meta_optimizers/dgc_optimizer.py over
+    dgc_op.h): top-k sparsified gradient, momentum correction, residual
+    accumulation — dropped coordinates accumulate until they win."""
+
+    def _wrapped(self, lin, **dgc_kw):
+        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
+            DGCOptimizer)
+        inner = paddle.optimizer.SGD(parameters=lin.parameters(),
+                                     learning_rate=0.1)
+        return DGCOptimizer(inner, hcg=None, **dgc_kw)
+
+    def test_topk_sparsification_and_residual(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 1, bias_attr=False)
+        opt = self._wrapped(lin, rampup_begin_step=0, sparsity=[0.75])
+        g = np.arange(1, 9, dtype=np.float32).reshape(8, 1)
+        lin.weight.grad = paddle.to_tensor(g.copy())
+        opt.step()
+        v = opt._v[id(lin.weight)]
+        # residual holds the 6 dropped coordinates
+        assert int((np.asarray(v) != 0).sum()) == 6
+        # dropped coords accumulate: same grad again -> their residual
+        # doubles and eventually exceeds fresh top entries
+        lin.weight.grad = paddle.to_tensor(g.copy())
+        opt.step()
+        v2 = np.asarray(opt._v[id(lin.weight)])
+        assert np.abs(v2).max() <= np.abs(np.asarray(v)).max() * 3
+
+    def test_rampup_dense_before_begin(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 1, bias_attr=False)
+        opt = self._wrapped(lin, rampup_begin_step=3, sparsity=[0.75])
+        w0 = lin.weight.numpy().copy()
+        lin.weight.grad = paddle.to_tensor(np.ones((4, 1), np.float32))
+        opt.step()
+        # before rampup: DENSE update moved every coordinate
+        assert np.all(lin.weight.numpy() != w0)
+
+    def test_converges_on_regression(self):
+        paddle.seed(1)
+        lin = paddle.nn.Linear(6, 1)
+        opt = self._wrapped(lin, rampup_begin_step=0, sparsity=[0.5])
+        rs = np.random.RandomState(0)
+        X = rs.randn(32, 6).astype(np.float32)
+        Y = X @ rs.randn(6, 1).astype(np.float32)
+        losses = []
+        for _ in range(40):
+            loss = ((lin(paddle.to_tensor(X)) - paddle.to_tensor(Y))
+                    ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+    def test_strategy_switch_applies(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
+            DGCOptimizer)
+        dist.fleet._state.initialized = False
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.dgc = True
+        strategy.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                                "sparsity": [0.5]}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        lin = paddle.nn.Linear(4, 2)
+        opt = dist.fleet.distributed_optimizer(
+            paddle.optimizer.SGD(parameters=lin.parameters(),
+                                 learning_rate=0.1), strategy=strategy)
+        assert isinstance(opt, DGCOptimizer)
+        dist.fleet._state.initialized = False
+
+
+class TestFp16Allreduce:
+    def test_grads_quantized_through_fp16(self):
+        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
+            Fp16AllreduceOptimizer)
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 1, bias_attr=False)
+        opt = Fp16AllreduceOptimizer(
+            paddle.optimizer.SGD(parameters=lin.parameters(),
+                                 learning_rate=1.0), hcg=None)
+        g = np.array([[1.0 + 2 ** -14], [1.0], [0.5], [2.0]], np.float32)
+        w0 = lin.weight.numpy().copy()
+        lin.weight.grad = paddle.to_tensor(g)
+        opt.step()
+        applied = w0 - lin.weight.numpy()
+        np.testing.assert_allclose(applied,
+                                   g.astype(np.float16).astype(np.float32),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_strategy_switch_applies(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
+            Fp16AllreduceOptimizer)
+        dist.fleet._state.initialized = False
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.fp16_allreduce = True
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        lin = paddle.nn.Linear(4, 2)
+        opt = dist.fleet.distributed_optimizer(
+            paddle.optimizer.SGD(parameters=lin.parameters(),
+                                 learning_rate=0.1), strategy=strategy)
+        assert isinstance(opt, Fp16AllreduceOptimizer)
+        dist.fleet._state.initialized = False
+
+    def test_dgc_conflicts_with_fp16_allreduce(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.strategy_compiler import (
+            StrategyCompiler)
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.dgc = True
+        strategy.fp16_allreduce = True
+        lin = paddle.nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(parameters=lin.parameters(),
+                                   learning_rate=0.1)
+        with pytest.raises(ValueError, match="conflict"):
+            StrategyCompiler().select(strategy, opt)
+
+    def test_momentum_not_applied_twice(self):
+        """DGC's momentum correction subsumes the inner Momentum's (the
+        reference substitutes the op); the inner's momentum is zeroed."""
+        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
+            DGCOptimizer)
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 1, bias_attr=False)
+        inner = paddle.optimizer.Momentum(parameters=lin.parameters(),
+                                          learning_rate=0.1, momentum=0.8)
+        opt = DGCOptimizer(inner, hcg=None, rampup_begin_step=0,
+                           sparsity=[0.0])
+        assert opt._momentum == 0.8
+        assert inner._momentum == 0.0
+
+    def test_tied_magnitudes_stay_topk(self):
+        """An all-equal residual must still send exactly k coordinates,
+        not the whole tensor (threshold-tie review finding)."""
+        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
+            DGCOptimizer)
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 1, bias_attr=False)
+        opt = DGCOptimizer(
+            paddle.optimizer.SGD(parameters=lin.parameters(),
+                                 learning_rate=0.1),
+            hcg=None, rampup_begin_step=0, sparsity=[0.75])
+        lin.weight.grad = paddle.to_tensor(np.ones((8, 1), np.float32))
+        sent = opt._compress(lin.weight)
+        assert int((np.asarray(sent) != 0).sum()) == 2   # k = 25% of 8
+
+    def test_rampup_counts_exact(self):
+        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
+            DGCOptimizer)
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 1, bias_attr=False)
+        opt = DGCOptimizer(
+            paddle.optimizer.SGD(parameters=lin.parameters(),
+                                 learning_rate=0.1),
+            hcg=None, rampup_begin_step=2, rampup_step=2,
+            sparsity=[0.5, 0.75])
+        # steps 0,1 dense; step 2 -> sparsity[0]; step 3 -> sparsity[1]
+        seen = []
+        for _ in range(4):
+            seen.append(opt._current_sparsity())
+            lin.weight.grad = paddle.to_tensor(
+                np.ones((4, 1), np.float32))
+            opt.step()
+        assert seen == [0.0, 0.0, 0.5, 0.75]
