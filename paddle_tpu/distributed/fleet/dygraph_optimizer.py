@@ -169,6 +169,18 @@ class DygraphShardingOptimizer:
         return self._inner_opt.minimize(*a, **kw)
 
 
+def _require_dp_spans_world(hcg, feature):
+    """Cross-process gradient exchange averages over ALL processes, which
+    is only the dp group when dp spans the world (the same contract
+    LocalSGDOptimizer._sync_params enforces)."""
+    world = jax.process_count()
+    dp = (hcg.get_data_parallel_world_size() if hcg is not None else world)
+    if dp != world:
+        raise NotImplementedError(
+            f"{feature} requires the dp group to span all processes; "
+            "hybrid mp/pp multi-process topologies are not supported")
+
+
 class DGCOptimizer:
     """Deep Gradient Compression — top-k gradient sparsification with
     momentum correction and local gradient (residual) accumulation.
@@ -195,14 +207,20 @@ class DGCOptimizer:
         self._ramp = max(1, int(rampup_step))
         self._sparsity = list(sparsity) or [0.999]
         # momentum correction SUBSUMES the inner optimizer's momentum
-        # (the reference replaces the Momentum op with the DGC op): take
-        # the inner's value and zero it there so momentum is not applied
-        # twice to the compressed grad
-        inner_m = getattr(optimizer, "_momentum", None)
+        # (the reference replaces the Momentum op with the DGC op): find
+        # the object that actually OWNS _momentum (wrappers like
+        # HybridParallelOptimizer delegate reads via __getattr__ but a
+        # plain setattr would only shadow it), take its value, and zero
+        # it THERE so momentum is not applied twice
+        owner = optimizer
+        while "_momentum" not in getattr(owner, "__dict__", {}) \
+                and hasattr(owner, "_inner_opt"):
+            owner = owner._inner_opt
+        inner_m = owner.__dict__.get("_momentum")
         if momentum is None:
             momentum = inner_m if inner_m is not None else 0.9
         if inner_m:
-            optimizer._momentum = 0.0
+            owner._momentum = 0.0
         self._momentum = float(momentum)
         self._step_count = 0
         self._u = {}    # id(param) -> momentum buffer
@@ -248,26 +266,13 @@ class DGCOptimizer:
         self._u[id(p)] = jnp.where(mask, 0.0, u)
         return sent
 
-    def _dp_spans_world(self):
-        """Cross-process compression averages over ALL processes, which
-        is only the dp group when dp spans the world (same contract as
-        LocalSGDOptimizer._sync_params)."""
-        world = jax.process_count()
-        dp = (self._hcg.get_data_parallel_world_size()
-              if self._hcg is not None else world)
-        if dp != world:
-            raise NotImplementedError(
-                "dgc/fp16_allreduce require the dp group to span all "
-                "processes; hybrid mp/pp multi-process topologies are "
-                "not supported")
-
     def _exchange(self, sent, dense=False):
         """Cross-process regime: ship only nonzeros (values + indices);
         dense warm-up steps take the plain dense mean (a sparse encoding
         of a dense tensor would triple the bytes)."""
         if jax.process_count() <= 1:
             return sent
-        self._dp_spans_world()
+        _require_dp_spans_world(self._hcg, "dgc")
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
 
@@ -341,7 +346,7 @@ class Fp16AllreduceOptimizer:
 
         multi = jax.process_count() > 1
         if multi:
-            DGCOptimizer._dp_spans_world(self)
+            _require_dp_spans_world(self._hcg, "fp16_allreduce")
         for p in self._inner_opt._parameter_list:
             if p.grad is None:
                 continue

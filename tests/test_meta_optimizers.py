@@ -261,38 +261,6 @@ class TestDGC:
         dist.fleet._state.initialized = False
 
 
-class TestFp16Allreduce:
-    def test_grads_quantized_through_fp16(self):
-        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
-            Fp16AllreduceOptimizer)
-        paddle.seed(0)
-        lin = paddle.nn.Linear(4, 1, bias_attr=False)
-        opt = Fp16AllreduceOptimizer(
-            paddle.optimizer.SGD(parameters=lin.parameters(),
-                                 learning_rate=1.0), hcg=None)
-        g = np.array([[1.0 + 2 ** -14], [1.0], [0.5], [2.0]], np.float32)
-        w0 = lin.weight.numpy().copy()
-        lin.weight.grad = paddle.to_tensor(g)
-        opt.step()
-        applied = w0 - lin.weight.numpy()
-        np.testing.assert_allclose(applied,
-                                   g.astype(np.float16).astype(np.float32),
-                                   rtol=1e-6, atol=1e-7)
-
-    def test_strategy_switch_applies(self):
-        import paddle_tpu.distributed as dist
-        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
-            Fp16AllreduceOptimizer)
-        dist.fleet._state.initialized = False
-        strategy = dist.fleet.DistributedStrategy()
-        strategy.fp16_allreduce = True
-        dist.fleet.init(is_collective=True, strategy=strategy)
-        lin = paddle.nn.Linear(4, 2)
-        opt = dist.fleet.distributed_optimizer(
-            paddle.optimizer.SGD(parameters=lin.parameters(),
-                                 learning_rate=0.1), strategy=strategy)
-        assert isinstance(opt, Fp16AllreduceOptimizer)
-        dist.fleet._state.initialized = False
 
     def test_dgc_conflicts_with_fp16_allreduce(self):
         import paddle_tpu.distributed as dist
@@ -354,3 +322,58 @@ class TestFp16Allreduce:
                 np.ones((4, 1), np.float32))
             opt.step()
         assert seen == [0.0, 0.0, 0.5, 0.75]
+
+
+class TestFp16Allreduce:
+    def test_grads_quantized_through_fp16(self):
+        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
+            Fp16AllreduceOptimizer)
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 1, bias_attr=False)
+        opt = Fp16AllreduceOptimizer(
+            paddle.optimizer.SGD(parameters=lin.parameters(),
+                                 learning_rate=1.0), hcg=None)
+        g = np.array([[1.0 + 2 ** -14], [1.0], [0.5], [2.0]], np.float32)
+        w0 = lin.weight.numpy().copy()
+        lin.weight.grad = paddle.to_tensor(g)
+        opt.step()
+        applied = w0 - lin.weight.numpy()
+        np.testing.assert_allclose(applied,
+                                   g.astype(np.float16).astype(np.float32),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_strategy_switch_applies(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.dygraph_optimizer import (
+            Fp16AllreduceOptimizer)
+        dist.fleet._state.initialized = False
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.fp16_allreduce = True
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        lin = paddle.nn.Linear(4, 2)
+        opt = dist.fleet.distributed_optimizer(
+            paddle.optimizer.SGD(parameters=lin.parameters(),
+                                 learning_rate=0.1), strategy=strategy)
+        assert isinstance(opt, Fp16AllreduceOptimizer)
+        dist.fleet._state.initialized = False
+
+
+class TestDGCStrategyComposition:
+    def test_momentum_subsumed_through_wrapper_chain(self):
+        """distributed_optimizer wraps the inner in HybridParallelOptimizer
+        before DGCMeta applies; the zeroing must reach the REAL owner of
+        _momentum, not shadow it on the wrapper (r5 review finding)."""
+        import paddle_tpu.distributed as dist
+        dist.fleet._state.initialized = False
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.dgc = True
+        strategy.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                                "sparsity": [0.5]}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        lin = paddle.nn.Linear(4, 2)
+        inner = paddle.optimizer.Momentum(parameters=lin.parameters(),
+                                          learning_rate=0.1, momentum=0.8)
+        opt = dist.fleet.distributed_optimizer(inner, strategy=strategy)
+        assert opt._momentum == 0.8
+        assert inner._momentum == 0.0
+        dist.fleet._state.initialized = False

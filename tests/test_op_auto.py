@@ -55,6 +55,20 @@ def WELL(n=3):
     return make
 
 
+def _TS_LABELS(rs):
+    # all four teacher_student label branches away from the breakpoints
+    return np.array([-2.0, -1.0, 0.3, 0.8, 1.2, 1.7], np.float32)
+
+
+def _LEN25(rs):
+    return np.array([5, 3], np.int64)
+
+
+def _ROIQUAD(rs):
+    return np.array([[1.0, 1.0, 8.0, 1.5, 8.5, 8.0, 1.5, 8.5]],
+                    np.float32)
+
+
 def SYM(n=3):
     def make(rs):
         a = rs.rand(n, n).astype(np.float32)
@@ -103,6 +117,29 @@ SPECS = {
     "heaviside": dict(in_=[_SGN, _D], grad=False),
     "elementwise_mod": dict(grad=False),
     "elementwise_floordiv": dict(grad=False),
+    # r5 CTR / fusion long tail
+    "cvm_op": dict(in_=[U(0.5, 3.0, (4, 6)), U(0.5, 3.0, (4, 2))],
+                   grad=False),   # CTR grad RULE != math grad (cvm_op.h);
+                                  # hand-checked in test_op_longtail_r5
+    "center_loss_op": dict(
+        in_=[U(-1, 1, (4, 3)), I64(3, (4,)), U(-1, 1, (3, 3)),
+             U(0.1, 0.5, (1,))],
+        # need_update=False: centers_out is a stop-gradient SIDE output
+        # (reference: no Centers grad); FD through the update would
+        # disagree with the intentional analytic block
+        attrs={"cluster_num": 3, "need_update": False}, grad=[0],
+        bf16=False),
+    "teacher_student_sigmoid_loss_op": dict(
+        in_=[U(-2, 2, (6,)), _TS_LABELS], grad=[0]),  # labels: no grad
+                              # (reference grad kernel emits dX only)
+    "fused_embedding_seq_pool_op": dict(
+        in_=[U(-1, 1, (8, 4)), I64(8, (2, 5)), _LEN25], grad=[0]),
+    "fc_op": dict(in_=[U(-1, 1, (3, 4)), U(-1, 1, (4, 5)),
+                       U(-1, 1, (5,))]),
+    "roi_perspective_transform_op": dict(
+        in_=[U(0.0, 1.0, (1, 2, 10, 10)), _ROIQUAD],
+        attrs={"transformed_height": 3, "transformed_width": 3},
+        grad=[0], tol=5e-2, bf16=False),
     # matmul family
     "matmul_v2": dict(in_=[U(-1, 1, (3, 4)), U(-1, 1, (4, 5))]),
     "mul": dict(in_=[U(-1, 1, (3, 4)), U(-1, 1, (4, 5))]),
