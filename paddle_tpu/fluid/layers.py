@@ -458,3 +458,77 @@ def fused_embedding_seq_pool(input, size, ids, lengths=None,  # noqa: A002
                      _internal=True)
     return _op(input, ids, lengths, combiner=combiner,
                padding_idx=int(padding_idx))
+
+
+# -- TensorArray family + runtime Print (r5 op-sample misses) ---------------
+# reference: fluid/layers/control_flow.py create_array/array_read/
+# array_write/array_length (LoDTensorArray ops) and control_flow.py Print
+# (print_op.cc). The dygraph realization is a plain Python list (exactly
+# the reference's dygraph branch); XLA-staged dynamic arrays are expressed
+# with lax.scan/while_loop carries instead, per the static control-flow
+# design (static/control_flow.py).
+
+
+def create_array(dtype="float32", initialized_list=None):
+    return list(initialized_list or [])
+
+
+def array_write(x, i, array=None):
+    idx = int(i.numpy()) if hasattr(i, "numpy") else int(i)
+    if array is None:
+        array = []
+    if idx > len(array):
+        # reference dygraph branch asserts i <= len(array); silent None
+        # padding would surface as a confusing crash at a later read
+        raise IndexError(
+            f"array_write index {idx} beyond array length {len(array)}")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    idx = int(i.numpy()) if hasattr(i, "numpy") else int(i)
+    return array[idx]
+
+
+def array_length(array):
+    import numpy as np2
+    from ..framework.tensor import Tensor as _T
+    return _T(np2.asarray([len(array)], np2.int64), _internal=True)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=False,
+          print_tensor_lod=False, print_phase="both"):
+    """Runtime tensor print (reference: print_op.cc / layers.Print):
+    eager values print immediately; traced values print at execution via
+    jax.debug.print. Returns the input (identity), like the reference."""
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from ..framework.tensor import Tensor as _T
+
+    arr = input._data if isinstance(input, _T) else _jnp.asarray(input)
+    head = message or "Print"
+    if print_tensor_shape:
+        head += f" shape={tuple(arr.shape)}"
+    if print_tensor_type:
+        head += f" dtype={arr.dtype}"
+    n = arr.size if summarize is None or summarize < 0 \
+        else min(int(summarize), arr.size)   # reference: -1 = print ALL
+    if isinstance(arr, _jax.core.Tracer):
+        # jax.debug.callback with a closure: the user's message must
+        # never reach a format-string parser (braces would crash)
+        def _cb(v, _head=head):
+            import numpy as np2
+            print(f"{_head} value={np2.asarray(v)}")
+
+        _jax.debug.callback(_cb, arr.reshape(-1)[:n])
+    else:
+        import numpy as np2
+        print(f"{head} value={np2.asarray(arr).reshape(-1)[:n]}")
+    return input

@@ -253,3 +253,46 @@ class TestStaticNN:
             assert callable(paddle.static.nn.while_loop)
         finally:
             paddle.disable_static()
+
+
+class TestTensorArrayAndPrint:
+    """r5: create_array/array_read/array_write/array_length + Print
+    (reference: fluid/layers/control_flow.py dygraph branches,
+    print_op.cc)."""
+
+    def test_array_roundtrip(self):
+        import paddle_tpu.fluid as fluid
+        L = fluid.layers
+        arr = L.create_array()
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        L.array_write(x, 0, arr)
+        L.array_write(x * 3, paddle.to_tensor(np.int64(1)), arr)
+        assert int(L.array_length(arr).numpy()[0]) == 2
+        np.testing.assert_allclose(L.array_read(arr, 1).numpy(),
+                                   [3.0, 3.0])
+
+    def test_array_write_strict_index(self):
+        import paddle_tpu.fluid as fluid
+        L = fluid.layers
+        arr = L.create_array()
+        with pytest.raises(IndexError):
+            L.array_write(paddle.to_tensor(np.ones(2, np.float32)), 3, arr)
+
+    def test_print_identity_and_braces(self, capsys):
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.framework.tensor import Tensor
+        L = fluid.layers
+        x = paddle.to_tensor(np.arange(5).astype(np.float32))
+        y = L.Print(x, summarize=-1, message="eager {brace}")
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+        out = capsys.readouterr().out
+        assert "4." in out and "{brace}" in out    # ALL elements, raw braces
+
+        @jax.jit
+        def g(arr):
+            L.Print(Tensor(arr, _internal=True), message="traced {i}")
+            return arr * 2
+        res = np.asarray(g(jnp.arange(3.0)))
+        np.testing.assert_allclose(res, [0, 2, 4])
