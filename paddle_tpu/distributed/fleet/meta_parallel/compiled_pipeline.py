@@ -69,14 +69,24 @@ class CompiledPipeline1F1B:
                  n_stages: int, n_micro: int,
                  mesh: Optional[Mesh] = None,
                  first_fn: Optional[Callable] = None,
-                 last_fn: Optional[Callable] = None):
+                 last_fn: Optional[Callable] = None,
+                 n_chunks: int = 1):
         if n_micro < 1 or n_stages < 2:
             raise ValueError("need n_micro >= 1 and n_stages >= 2")
+        if n_chunks < 1:
+            raise ValueError("n_chunks >= 1")
+        if n_chunks > 1 and (first_fn is not None or last_fn is not None):
+            raise NotImplementedError(
+                "interleaved schedule (n_chunks > 1) currently covers the "
+                "uniform-block pipeline; heterogeneous first/last stages "
+                "use n_chunks=1")
         self.block_fn = block_fn
         self.loss_fn = loss_fn
         self.first_fn = first_fn
         self.last_fn = last_fn
         self.pp = n_stages
+        self.v = int(n_chunks)     # virtual stages per device (interleaved
+                                   # 1F1B: block j lives on device j % pp)
         self.n_micro = n_micro
         self.mesh = mesh or Mesh(
             np.asarray(jax.devices()[:n_stages]), ("pp",))
@@ -99,8 +109,64 @@ class CompiledPipeline1F1B:
     def _het(self) -> bool:
         return self.first_fn is not None or self.last_fn is not None
 
+    # -- interleaved schedule (v > 1, runs per-device inside shard_map) ----
+    def _pipeline_interleaved(self, w_local, micro_x, micro_y):
+        """Virtual pipeline stages (reference: the interleaved 1F1B of
+        pipeline_parallel.py's schedule family / Megatron-LM "virtual
+        pipeline"): L = v*pp uniform blocks, block j resident on device
+        j % pp as chunk j // pp.
+
+        TRUE staggered schedule — each device computes exactly ONE block
+        per tick (dynamic chunk selection), one ring collective per tick.
+        Micros stream in groups of pp: micro m = g*pp + r runs block
+        (c, d) at tick t = g*v*pp + c*pp + r + d, which gives every
+        (tick, device) a unique (group, chunk, rank) — the inverse map
+        below. Total ticks = G*v*pp + pp - 1 (G = ceil(n/pp) groups), so
+        utilization is n*v/(n*v + pp - 1): the bubble shrinks by the
+        factor v that interleaving exists for, instead of the (L-1)-deep
+        bubble a naive all-chunks-per-tick formulation would pay."""
+        pp, n_micro, v = self.pp, self.n_micro, self.v
+        G = -(-n_micro // pp)               # micro groups of pp
+        stage = jax.lax.axis_index("pp")
+        w = w_local                          # [v, ...] local chunk rows
+        ring = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            y_prev, loss_acc = carry         # [mb, ...]
+            ring_val = jax.lax.ppermute(y_prev, "pp", ring)
+            # inverse schedule map for (t, device): which (group, chunk,
+            # rank) is active here
+            u = t - stage
+            uc = jnp.maximum(u, 0)
+            r = uc % pp
+            q = uc // pp
+            c = q % v
+            g = q // v
+            m = g * pp + r
+            active = (u >= 0) & (m < n_micro) & (g < G)
+            mi = jnp.clip(m, 0, n_micro - 1)
+            inject = (stage == 0) & (c == 0)
+            x = jnp.where(inject, micro_x[mi], ring_val)
+            wc = jax.tree_util.tree_map(lambda a: a[c], w)  # chunk select
+            y = self.block_fn(wc, x)
+            is_last = ((stage == pp - 1) & (c == v - 1) & active)
+            safe = jnp.where(is_last, y, jnp.ones_like(y))
+            loss_acc = loss_acc + jnp.where(
+                is_last, self.loss_fn(safe, micro_y[mi]), 0.0)
+            return (y, loss_acc), None
+
+        ticks = G * v * pp + pp - 1
+        init = (jnp.zeros_like(micro_x[0]), jnp.float32(0.0))
+        (_, loss_acc), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+        loss = jax.lax.psum(loss_acc, "pp") / n_micro
+        if self.dp > 1:
+            loss = jax.lax.pmean(loss, "dp")
+        return loss
+
     # -- schedule (runs per-device inside shard_map) -----------------------
     def _pipeline(self, w_local, micro_x, micro_y):
+        if self.v > 1:
+            return self._pipeline_interleaved(w_local, micro_x, micro_y)
         pp, n_micro = self.pp, self.n_micro
         stage = jax.lax.axis_index("pp")
         if self._het:
@@ -217,11 +283,44 @@ class CompiledPipeline1F1B:
                                            grads["last"]),
         }
 
+    def _interleave(self, a):
+        """[L, ...] block order -> [pp*v, ...] device-major order (device
+        d's contiguous v rows = blocks d, pp+d, ..., i.e. its chunks)."""
+        a = jnp.asarray(a)
+        L = self.v * self.pp
+        if a.shape[0] != L:
+            raise ValueError(
+                f"interleaved pipeline expects leading dim {L} "
+                f"(= n_chunks {self.v} x n_stages {self.pp}); got "
+                f"{a.shape[0]}")
+        return a.reshape((self.v, self.pp) + a.shape[1:]) \
+                .swapaxes(0, 1).reshape(a.shape)
+
+    def deinterleave(self, tree):
+        """Inverse of the placement permutation: device-major stacked
+        arrays (as returned by step()'s grads) back to [L, ...] block
+        order."""
+        if self.v == 1:
+            return tree
+
+        def inv(a):
+            a = jnp.asarray(a)
+            return a.reshape((self.pp, self.v) + a.shape[1:]) \
+                    .swapaxes(0, 1).reshape(a.shape)
+
+        return jax.tree_util.tree_map(inv, tree)
+
     def place(self, params):
         """Commit the (normalized) stacked weights onto the mesh (stage
         i's block physically resident on pp-slice i; padded first/last
-        rows land as zeros on the other stages)."""
+        rows land as zeros on the other stages). Interleaved mode
+        (n_chunks > 1) permutes [L, ...] block order into device-major
+        order so shard_map's contiguous split gives device d its round-
+        robin chunks; step() then returns grads in that placed layout
+        (deinterleave() maps them back)."""
         params = self._prepare(params)
+        if self.v > 1:
+            params = jax.tree_util.tree_map(self._interleave, params)
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(
                 a, NamedSharding(self.mesh, self._stack_spec(a))),
