@@ -121,12 +121,19 @@ class CompiledPipeline1F1B:
         Micros stream in groups of pp: micro m = g*pp + r runs block
         (c, d) at tick t = g*v*pp + c*pp + r + d, which gives every
         (tick, device) a unique (group, chunk, rank) — the inverse map
-        below. Total ticks = G*v*pp + pp - 1 (G = ceil(n/pp) groups), so
-        utilization is n*v/(n*v + pp - 1): the bubble shrinks by the
-        factor v that interleaving exists for, instead of the (L-1)-deep
-        bubble a naive all-chunks-per-tick formulation would pay."""
+        below. n_micro must divide into whole groups (pp | n_micro — a
+        ragged last group would burn a full group slot of masked ticks),
+        giving total ticks = n*v + pp - 1 and utilization
+        n*v/(n*v + pp - 1): the bubble shrinks by the factor v that
+        interleaving exists for, instead of the (L-1)-deep bubble a
+        naive all-chunks-per-tick formulation would pay."""
         pp, n_micro, v = self.pp, self.n_micro, self.v
-        G = -(-n_micro // pp)               # micro groups of pp
+        if n_micro % pp:
+            raise ValueError(
+                f"interleaved schedule needs n_micro ({n_micro}) divisible "
+                f"by n_stages ({pp}): micros stream in groups of pp, and a "
+                "partial group would cost a full group of masked ticks")
+        G = n_micro // pp                    # micro groups of pp
         stage = jax.lax.axis_index("pp")
         w = w_local                          # [v, ...] local chunk rows
         ring = [(i, (i + 1) % pp) for i in range(pp)]

@@ -13,8 +13,12 @@ from .bert import (BertForPretraining, BertModel,  # noqa: F401
 from .gpt import (GPT_CONFIGS, GPTDecoderLayer, GPTEmbeddings,
                   GPTForPipeline, GPTForPretraining, GPTModel,
                   GPTPretrainingCriterion, gpt_tiny, gpt2_small, gpt3_1p3b)
+from .gpt_compiled import (gpt_compiled_pipeline, retie_embedding,
+                           tied_embedding_grad)
 
 __all__ = ["GPTModel", "GPTForPretraining", "GPTForPipeline",
+           "gpt_compiled_pipeline", "tied_embedding_grad",
+           "retie_embedding",
            "GPTDecoderLayer", "GPTEmbeddings", "GPTPretrainingCriterion",
            "GPT_CONFIGS", "gpt_tiny", "gpt2_small", "gpt3_1p3b",
            "BertModel", "BertForPretraining", "BertPretrainingCriterion",

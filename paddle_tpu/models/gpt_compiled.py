@@ -1,0 +1,153 @@
+"""GPT through the compiled 1F1B pipeline: the WHOLE model — embedding,
+decoder stack, tied head, loss, schedule, and backward — as one XLA
+program over a (dp,) pp mesh.
+
+Builder around meta_parallel/compiled_pipeline.py: extracts a built
+GPTForPretraining's weights into the stacked layout (decoder i = stage
+row i; embedding/head ride the heterogeneous padded stacking) and
+provides the pure-jax block/embed/head functions. The host-scheduled
+engine (pipeline_parallel.py) stays the default for training with
+dropout; this path is the zero-host-involvement option (dropout-free —
+the compiled schedule does not thread per-micro RNG) for throughput and
+dropout-0 training. Reference bar: the whole-pipeline section program of
+section_worker.cc run as ONE device program instead of per-stage
+dispatches.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["gpt_compiled_pipeline", "tied_embedding_grad",
+           "retie_embedding"]
+
+
+def _ln(x, g, b, eps):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _causal_sdpa(q, k, v):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (float(d) ** -0.5)
+    T = s.shape[-1]
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.where(mask, s, jnp.asarray(-1e9, s.dtype))
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def gpt_compiled_pipeline(net, n_stages: int, n_micro: int,
+                          mesh=None, n_chunks: int = 1):
+    """(engine, placed_params) for a built GPTForPretraining.
+
+    num_layers must equal n_stages (heterogeneous embed/head pipelines
+    require n_chunks=1 in the engine). The head is TIED to the embedding:
+    both padded rows carry the same table, and tied_embedding_grad()
+    combines their gradients for the update."""
+    g = net.gpt
+    L = len(g.layers)
+    if n_chunks != 1:
+        raise NotImplementedError(
+            "gpt_compiled_pipeline uses heterogeneous embed/head stages, "
+            "which the engine supports at n_chunks=1")
+    if L != n_stages:
+        raise ValueError(
+            f"num_layers {L} must equal n_stages {n_stages} (one decoder "
+            "block per stage)")
+    blk0 = g.layers[0]
+    drops = [float(g.embeddings.dropout.p)] + [
+        float(b.attn.attn_dropout_prob) for b in g.layers] + [
+        float(b.dropout.p) for b in g.layers]
+    if any(d > 0 for d in drops):
+        raise ValueError(
+            "gpt_compiled_pipeline is dropout-free (the compiled schedule "
+            "does not thread per-micro RNG); build the model with "
+            "attn_dropout_prob=0.0 and hidden_dropout_prob=0.0, or train "
+            "on the host-scheduled engine")
+    nh = blk0.attn.num_heads
+    eps = float(getattr(g.ln_f, "_epsilon", 1e-5))
+
+    def stack(get):
+        return np.stack([np.asarray(get(b).numpy()) for b in g.layers])
+
+    blocks = (
+        stack(lambda b: b.ln_1.weight), stack(lambda b: b.ln_1.bias),
+        stack(lambda b: b.attn.qkv_proj.weight),
+        stack(lambda b: b.attn.qkv_proj.bias),
+        stack(lambda b: b.attn.out_proj.weight),
+        stack(lambda b: b.attn.out_proj.bias),
+        stack(lambda b: b.ln_2.weight), stack(lambda b: b.ln_2.bias),
+        stack(lambda b: b.mlp.fc1.weight), stack(lambda b: b.mlp.fc1.bias),
+        stack(lambda b: b.mlp.fc2.weight), stack(lambda b: b.mlp.fc2.bias),
+    )
+    E = np.asarray(g.embeddings.word_embeddings.weight.numpy())
+    P = np.asarray(g.embeddings.position_embeddings.weight.numpy())
+    gf = np.asarray(g.ln_f.weight.numpy())
+    bf = np.asarray(g.ln_f.bias.numpy())
+
+    def block_fn(p, x):
+        g1, b1, wqkv, bqkv, wo, bo, g2, b2, w1, bm1, w2, bm2 = p
+        h = _ln(x, g1, b1, eps)
+        B, T, H = h.shape
+        qkv = (h @ wqkv + bqkv).reshape(B, T, 3, nh, H // nh)
+        qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))        # [3, B, nh, T, hd]
+        a = _causal_sdpa(qkv[0], qkv[1], qkv[2])
+        a = jnp.transpose(a, (0, 2, 1, 3)).reshape(B, T, H)
+        x = x + (a @ wo + bo)
+        h = _ln(x, g2, b2, eps)
+        m = jax.nn.gelu(h @ w1 + bm1, approximate=True) @ w2 + bm2
+        return x + m
+
+    def first_fn(p, ids):
+        emb, pos = p
+        T = ids.shape[-1]
+        return emb[ids] + pos[jnp.arange(T)]
+
+    def last_fn(p, h):
+        gw, bw, emb = p
+        return _ln(h, gw, bw, eps) @ emb.T               # tied head
+
+    def loss_fn(logits, labels):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(lp, labels[..., None],
+                                             axis=-1))
+
+    from ..distributed.fleet.meta_parallel.compiled_pipeline import (
+        CompiledPipeline1F1B)
+
+    eng = CompiledPipeline1F1B(block_fn, loss_fn, n_stages, n_micro,
+                               mesh=mesh, first_fn=first_fn,
+                               last_fn=last_fn)
+    placed = eng.place({"blocks": tuple(jnp.asarray(a) for a in blocks),
+                        "first": (jnp.asarray(E), jnp.asarray(P)),
+                        "last": (jnp.asarray(gf), jnp.asarray(bf),
+                                 jnp.asarray(E))})
+    return eng, placed
+
+
+def tied_embedding_grad(eng, grads):
+    """Combined gradient of the tied embedding table: the first stage's
+    lookup grad plus the head's projection grad (the reference's
+    shared-weight allreduce across the tying stages, pp_layers.py:49)."""
+    u = eng.unpad(grads)
+    return u["first"][0] + u["last"][2]
+
+
+def retie_embedding(eng, params, new_table):
+    """Write an updated embedding table into BOTH tying rows of the
+    placed params (stage 0's padded `first` row and the last stage's
+    padded `last` row) — a naive per-row update with the untied grads
+    would silently drift the two copies apart."""
+    new_table = jnp.asarray(new_table)
+    first = list(params["first"])
+    first[0] = first[0].at[0].set(new_table)
+    last = list(params["last"])
+    last[2] = last[2].at[eng.pp - 1].set(new_table)
+    return {"blocks": params["blocks"], "first": tuple(first),
+            "last": tuple(last)}

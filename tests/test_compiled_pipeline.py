@@ -345,8 +345,8 @@ class TestInterleavedSchedule:
 
     @pytest.mark.parametrize("pp,v,n_micro,mb,h", [
         (2, 2, 4, 2, 8),       # L=4 on 2 devices
-        (4, 2, 3, 2, 8),       # L=8 on 4 devices, n_micro < L
-        (2, 3, 5, 1, 6),       # L=6, odd chunk count
+        (4, 2, 4, 2, 8),       # L=8 on 4 devices, n_micro < L
+        (2, 3, 6, 1, 6),       # L=6, odd chunk count
     ])
     def test_matches_oracle(self, pp, v, n_micro, mb, h):
         L = pp * v
@@ -403,7 +403,79 @@ class TestInterleavedSchedule:
             w = jax.tree_util.tree_map(lambda p, g: p - 0.2 * g, w, grads)
         assert losses[-1] < losses[0] * 0.8, losses
 
+    def test_ragged_micros_rejected(self):
+        eng = CompiledPipeline1F1B(_block_fn, _mse, 2, 3, n_chunks=2)
+        w = eng.place((np.zeros((4, 4, 4), np.float32),
+                       np.zeros((4, 4), np.float32)))
+        with pytest.raises(ValueError, match="divisible"):
+            eng.step(w, jnp.zeros((3, 2, 4)), jnp.zeros((3, 2, 4)))
+
     def test_het_plus_interleave_rejected(self):
         with pytest.raises(NotImplementedError, match="interleaved"):
             CompiledPipeline1F1B(_block_fn, _mse, 2, 2, n_chunks=2,
                                  first_fn=lambda p, x: x)
+
+
+class TestGPTCompiledPipeline:
+    """The flagship through the one-XLA-program schedule: embedding,
+    decoder stack, tied head, loss, and backward all inside one compiled
+    program (models/gpt_compiled.py)."""
+
+    TINY = dict(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
+                intermediate_size=64, max_position_embeddings=32,
+                attn_dropout_prob=0.0, hidden_dropout_prob=0.0)
+
+    def _data(self, nm=3, mb=2, t=16):
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 64, (nm, mb, t + 1)).astype(np.int32)
+        return ids[:, :, :-1], ids[:, :, 1:]
+
+    def test_matches_eager_gpt(self):
+        from paddle_tpu.models import (GPTPretrainingCriterion,
+                                       gpt_compiled_pipeline, gpt_tiny)
+        paddle.seed(3)
+        net = gpt_tiny(**self.TINY)
+        net.eval()
+        x, y = self._data()
+        eng, w = gpt_compiled_pipeline(net, n_stages=4, n_micro=3)
+        loss, grads = eng.step(w, jnp.asarray(x), jnp.asarray(y))
+        crit = GPTPretrainingCriterion()
+        losses = []
+        for m in range(3):
+            lg = net(paddle.to_tensor(x[m].astype(np.int64)))
+            losses.append(float(crit(
+                lg, paddle.to_tensor(y[m].astype(np.int64))).numpy()))
+        np.testing.assert_allclose(float(loss), float(np.mean(losses)),
+                                   rtol=2e-5)
+
+    def test_trains_with_tied_embedding(self):
+        from paddle_tpu.models import (gpt_compiled_pipeline,
+                                       tied_embedding_grad, gpt_tiny)
+        from paddle_tpu.models.gpt_compiled import retie_embedding
+        paddle.seed(4)
+        net = gpt_tiny(**self.TINY)
+        net.eval()
+        x, y = self._data()
+        eng, w = gpt_compiled_pipeline(net, n_stages=4, n_micro=3)
+        losses = []
+        lr = 0.1
+        for _ in range(8):
+            loss, grads = eng.step(w, jnp.asarray(x), jnp.asarray(y))
+            losses.append(float(loss))
+            # blocks + LN rows update per-row; the TIED table updates once
+            # with the combined grad and is written back into both rows
+            gE = tied_embedding_grad(eng, grads)
+            table = eng.unpad(w)["first"][0] - lr * gE
+            w = jax.tree_util.tree_map(lambda p, g: p - lr * g, w, grads)
+            w = retie_embedding(eng, w, table)
+        assert losses[-1] < losses[0] - 0.1, losses
+        # the two tying rows are IDENTICAL after training
+        u = eng.unpad(w)
+        np.testing.assert_array_equal(np.asarray(u["first"][0]),
+                                      np.asarray(u["last"][2]))
+
+    def test_layer_stage_mismatch_raises(self):
+        from paddle_tpu.models import gpt_compiled_pipeline, gpt_tiny
+        net = gpt_tiny(**self.TINY)
+        with pytest.raises(ValueError, match="num_layers"):
+            gpt_compiled_pipeline(net, n_stages=2, n_micro=2)
