@@ -1,9 +1,10 @@
 """paddle.incubate parity surface (reference: python/paddle/incubate/)."""
+from . import asp  # noqa: F401
 from . import nn  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import (GradientMergeOptimizer, LookAhead,  # noqa: F401
                         ModelAverage)
 
-__all__ = ["nn", "checkpoint", "optimizer", "LookAhead", "ModelAverage",
-           "GradientMergeOptimizer"]
+__all__ = ["asp", "nn", "checkpoint", "optimizer", "LookAhead",
+           "ModelAverage", "GradientMergeOptimizer"]

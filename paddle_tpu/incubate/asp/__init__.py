@@ -1,0 +1,20 @@
+"""paddle.incubate.asp — automatic sparsity (n:m pruning) workflow.
+
+Reference: python/paddle/fluid/contrib/sparsity/ (also exposed as
+paddle.static.sparsity). See utils.py / asp.py here for the TPU notes.
+"""
+from .asp import (ASPHelper, OptimizerWithSparsityGuarantee,  # noqa: F401
+                  decorate, prune_model, reset_excluded_layers,
+                  set_excluded_layers)
+from .utils import (CheckMethod, MaskAlgo, calculate_density,  # noqa: F401
+                    check_mask_1d, check_mask_2d, check_sparsity,
+                    create_mask, get_mask_1d, get_mask_2d_best,
+                    get_mask_2d_greedy)
+
+__all__ = [
+    "MaskAlgo", "CheckMethod", "calculate_density", "check_mask_1d",
+    "get_mask_1d", "check_mask_2d", "get_mask_2d_greedy",
+    "get_mask_2d_best", "create_mask", "check_sparsity",
+    "set_excluded_layers", "reset_excluded_layers", "decorate",
+    "prune_model", "ASPHelper", "OptimizerWithSparsityGuarantee",
+]

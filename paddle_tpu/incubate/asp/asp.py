@@ -1,0 +1,225 @@
+"""ASP workflow: prune supported layers to n:m sparsity and keep them
+sparse through training.
+
+Reference surface: python/paddle/fluid/contrib/sparsity/asp.py:31-235
+(set_excluded_layers / reset_excluded_layers / decorate / prune_model,
+ASPHelper, OptimizerWithSparsityGuarantee).
+
+TPU-first design: the reference appends a mask-multiply op after every
+optimizer op in the static program (ASPHelper's OptimizerWithSparsity-
+Guarantee). Here the mask lives on the parameter itself (``p._asp_mask``,
+a device array) and the static executor's compiled train step multiplies
+the freshly-updated parameter by it INSIDE the same XLA program
+(static/executor.py _run_train) — XLA fuses the multiply into the
+optimizer-update kernel, so sparsity maintenance is free of extra HBM
+round-trips. In dygraph, the decorated ``optimizer.step`` re-applies the
+masks after each update.
+
+The MXU has no sparse unit, so unlike the CUDA sparse-tensor-core target
+there is no 2x matmul speedup to harvest — what this preserves is the
+WORKFLOW parity: models pruned here export with true-zero weights ready
+for sparsity-aware serving.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .utils import CheckMethod, MaskAlgo, check_sparsity, create_mask
+
+__all__ = ["set_excluded_layers", "reset_excluded_layers", "decorate",
+           "prune_model", "ASPHelper", "OptimizerWithSparsityGuarantee"]
+
+_MASK_ALGOS = {
+    "mask_1d": MaskAlgo.MASK_1D,
+    "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+    "mask_2d_best": MaskAlgo.MASK_2D_BEST,
+}
+
+
+def set_excluded_layers(main_program, param_names):
+    """Exclude parameters whose name starts with any entry (static mode:
+    scoped to `main_program`; pass None to set the global/dygraph set)."""
+    ASPHelper.set_excluded_layers(main_program, param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    ASPHelper.reset_excluded_layers(main_program)
+
+
+def decorate(optimizer):
+    """Wrap `optimizer` so sparsity masks survive every update step."""
+    return ASPHelper.decorate(optimizer)
+
+
+def prune_model(main_program=None, n=2, m=4, mask_algo="mask_1d",
+                with_mask=True):
+    """Prune supported parameters of a static program (or, when passed a
+    ``paddle.nn.Layer``, of a dygraph model) to the n:m pattern.
+
+    with_mask=True also pins the mask so a decorated optimizer keeps the
+    pattern through training; False prunes once (inference-only).
+    Returns {param_name: mask ndarray}.
+    """
+    assert mask_algo in _MASK_ALGOS, (
+        'mask_algo must be one of %s, got %r'
+        % (sorted(_MASK_ALGOS), mask_algo))
+    algo = _MASK_ALGOS[mask_algo]
+    from ...nn.layer_base import Layer
+    if isinstance(main_program, Layer):
+        return ASPHelper.prune_layer(main_program, n, m, algo, with_mask)
+    return ASPHelper.prune_program(main_program, n, m, algo, with_mask)
+
+
+class ASPHelper:
+    """Mask bookkeeping + the supported-parameter predicate.
+
+    A parameter is ASP-supported when it feeds a matmul-family or conv2d
+    op (static: scanned from the program's op list; dygraph: the owning
+    layer is Linear/Conv2D) and is not excluded. Mirrors the reference's
+    SUPPORTED_LAYERS = {fc, linear, conv2d} (asp.py:284).
+    """
+
+    # exact op types (substring matching would catch elementwise_mul and
+    # prune gate/scale params that never feed an MXU contraction)
+    _SUPPORTED_OP_TYPES = frozenset({
+        "matmul", "matmul_v2", "mul", "bmm", "fc", "fc_op", "linear",
+        "conv2d", "conv2d_op", "depthwise_conv2d",
+    })
+
+    # id(program) -> set of excluded name prefixes; None key = global
+    _excluded: Dict[Optional[int], set] = {}
+
+    # -- exclusion ----------------------------------------------------------
+    @classmethod
+    def set_excluded_layers(cls, main_program, param_names):
+        key = None if main_program is None else id(main_program)
+        cls._excluded.setdefault(key, set()).update(param_names)
+
+    @classmethod
+    def reset_excluded_layers(cls, main_program=None):
+        if main_program is None:
+            cls._excluded.clear()
+        else:
+            cls._excluded.pop(id(main_program), None)
+
+    @classmethod
+    def _is_excluded(cls, program, name):
+        pools = [cls._excluded.get(None, set())]
+        if program is not None:
+            pools.append(cls._excluded.get(id(program), set()))
+        return any(name.startswith(ex) for pool in pools for ex in pool)
+
+    # -- supported-parameter predicate --------------------------------------
+    @classmethod
+    def _supported_param_names(cls, program) -> set:
+        """Names of captured params consumed by matmul/conv ops."""
+        out = set()
+        for op in program.ops:
+            if op.op_type.lower() not in cls._SUPPORTED_OP_TYPES:
+                continue
+            for kind, ref in op.in_refs:
+                # params enter ops as "cap" (captured Tensor) refs;
+                # "var" covers feeds/intermediates (program.py add_op)
+                if kind in ("var", "cap"):
+                    out.add(ref)
+        return out
+
+    # -- decoration ---------------------------------------------------------
+    @staticmethod
+    def decorate(optimizer):
+        return OptimizerWithSparsityGuarantee(optimizer)
+
+    # -- pruning ------------------------------------------------------------
+    @classmethod
+    def prune_program(cls, main_program, n, m, algo, with_mask):
+        import jax
+
+        from ...static.program import default_main_program
+        program = main_program or default_main_program()
+        eligible = cls._supported_param_names(program)
+        masks: Dict[str, np.ndarray] = {}
+        for pid, p in program.captured.items():
+            name = program.capture_names[pid]
+            if p.stop_gradient or not getattr(p, "trainable", True):
+                continue
+            if name not in eligible and (p.name or name) not in eligible:
+                continue
+            if p.ndim not in (2, 4):
+                continue
+            if cls._is_excluded(program, p.name or name):
+                continue
+            w_np = np.asarray(p.numpy())
+            mask = create_mask(w_np.astype(np.float64),
+                               func_name=algo, n=n, m=m).astype(w_np.dtype)
+            dev_mask = jax.numpy.asarray(mask)
+            p._data = p._data * dev_mask
+            if with_mask:
+                p._asp_mask = dev_mask
+            elif getattr(p, "_asp_mask", None) is not None:
+                # one-shot re-prune: drop the pinned mask so the executor
+                # stops enforcing the stale pattern
+                p._asp_mask = None
+            masks[p.name or name] = mask
+        # masked params change the compiled train step (the executor bakes
+        # the masked-index set at compile): force a re-compile
+        program.version += 1
+        return masks
+
+    @classmethod
+    def prune_layer(cls, layer, n, m, algo, with_mask):
+        import jax
+
+        from ...nn import Conv2D, Linear
+        masks: Dict[str, np.ndarray] = {}
+        for lname, sub in layer.named_sublayers(include_self=True):
+            if not isinstance(sub, (Linear, Conv2D)):
+                continue
+            w = getattr(sub, "weight", None)
+            if w is None or w.ndim not in (2, 4):
+                continue
+            pname = w.name or (lname + ".weight")
+            if cls._is_excluded(None, pname) or cls._is_excluded(None, lname):
+                continue
+            w_np = np.asarray(w.numpy())
+            mask = create_mask(w_np.astype(np.float64),
+                               func_name=algo, n=n, m=m).astype(w_np.dtype)
+            dev_mask = jax.numpy.asarray(mask)
+            w._data = w._data * dev_mask
+            if with_mask:
+                w._asp_mask = dev_mask
+            elif getattr(w, "_asp_mask", None) is not None:
+                w._asp_mask = None
+            masks[pname] = mask
+        return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Delegating optimizer wrapper; flags the optimizer as ASP-decorated
+    (the static executor masks updated params inside the compiled step)
+    and re-applies masks after each dygraph ``step``."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        optimizer._asp_decorated = True
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._optimizer.minimize(loss, startup_program=startup_program,
+                                        parameters=parameters,
+                                        no_grad_set=no_grad_set)
+
+    def step(self):
+        self._optimizer.step()
+        params = self._optimizer._parameter_list or []
+        for p in params:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._data = p._data * mask
+
+    def clear_grad(self, *a, **k):
+        return self._optimizer.clear_grad(*a, **k)

@@ -176,3 +176,4 @@ from .control_flow import case, cond, switch_case, while_loop  # noqa: E402
 
 
 from . import nn  # noqa: E402,F401  (paddle.static.nn layer namespace)
+from . import sparsity  # noqa: E402,F401  (paddle.static.sparsity / ASP)

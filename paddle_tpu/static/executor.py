@@ -83,6 +83,15 @@ class _CompiledProgram:
             self.param_idx = [next(i for i, t in enumerate(self.cap_tensors)
                                    if t is p) for p in self.params]
             self.accs = [opt._get_accumulators(p) for p in self.params]
+            # ASP (incubate/asp): params pruned with with_mask under a
+            # decorated optimizer get their mask re-applied INSIDE the
+            # compiled step — XLA fuses the multiply into the update.
+            # The index set is static per compile; prune_model bumps
+            # program.version so re-pruning recompiles.
+            self.asp_idx = tuple(
+                i for i, p in enumerate(self.params)
+                if getattr(opt, "_asp_decorated", False)
+                and getattr(p, "_asp_mask", None) is not None)
         self._jitted = jax.jit(self._run) if not train else \
             jax.jit(self._run_train)
 
@@ -126,7 +135,7 @@ class _CompiledProgram:
         return self._fetch(env), [env[n] for _, n in self.buffer_updates]
 
     def _run_train(self, feed_arrays, cap_arrays, acc_arrays, t, lr,
-                   rng_arrays):
+                   rng_arrays, mask_arrays=()):
         opt = self.optimizer
 
         def loss_of(param_arrays):
@@ -160,6 +169,8 @@ class _CompiledProgram:
             out = rule(sargs, arr, g, plr, t, *acc)
             new_params.append(out[0])
             new_accs.append(list(out[1:]))
+        for k, i in enumerate(self.asp_idx):
+            new_params[i] = new_params[i] * mask_arrays[k]
         fetches = self._fetch(env)
         buf_vals = [env[n] for _, n in self.buffer_updates]
         return fetches, new_params, new_accs, buf_vals
@@ -184,9 +195,11 @@ class _CompiledProgram:
         acc_names = opt._accumulator_names
         acc_arrays = [[a[n] for n in acc_names] for a in self.accs]
         opt._step_count += 1
+        mask_arrays = tuple(self.params[i]._asp_mask for i in self.asp_idx)
         fetches, new_params, new_accs, buf_vals = self._jitted(
             feed_arrays, cap_arrays, acc_arrays,
-            np.int32(opt._step_count), np.float32(opt.get_lr()), rng_arrays)
+            np.int32(opt._step_count), np.float32(opt.get_lr()), rng_arrays,
+            mask_arrays)
         for p, a in zip(self.params, new_params):
             p._data = a
         for acc, new in zip(self.accs, new_accs):

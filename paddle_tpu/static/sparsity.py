@@ -1,0 +1,13 @@
+"""paddle.static.sparsity — the static-graph ASP entry points.
+
+Reference: python/paddle/static/sparsity/__init__.py (re-exports the
+fluid.contrib.sparsity workflow). Implementation: incubate/asp/.
+"""
+from ..incubate.asp import (CheckMethod, MaskAlgo,  # noqa: F401
+                            calculate_density, check_sparsity, decorate,
+                            prune_model, reset_excluded_layers,
+                            set_excluded_layers)
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers", "check_sparsity",
+           "MaskAlgo", "CheckMethod"]

@@ -1,0 +1,290 @@
+"""ASP (automatic n:m sparsity) — mask utils + pruning workflow.
+
+reference: python/paddle/fluid/contrib/sparsity/utils.py (mask
+generators/checkers; the fixed-value examples below are the reference
+docstring examples), python/paddle/fluid/contrib/sparsity/asp.py
+(decorate/prune_model lifecycle), and the unittests in
+python/paddle/fluid/tests/unittests/asp/.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.incubate import asp
+from paddle_tpu.incubate.asp import CheckMethod, MaskAlgo
+
+
+@pytest.fixture(autouse=True)
+def _clean_exclusions():
+    asp.reset_excluded_layers()
+    yield
+    asp.reset_excluded_layers()
+
+
+# -- mask utils -------------------------------------------------------------
+
+def test_calculate_density():
+    x = np.array([[0, 1, 3, 0], [1, 1, 0, 1]])
+    assert asp.calculate_density(x) == 0.625
+
+
+def test_check_mask_1d_reference_examples():
+    assert asp.check_mask_1d(np.array([[0, 1, 3, 0], [1, 0, 0, 1]]), 2, 4)
+    assert not asp.check_mask_1d(np.array([[0, 1, 5, 4], [1, 0, 0, 1]]), 2, 4)
+    # ragged width: zero-padded to a multiple of m before checking
+    assert asp.check_mask_1d(np.array([[0, 1, 0, 4, 6], [1, 0, 0, 1, 7]]),
+                             2, 4)
+
+
+def test_get_mask_1d_keeps_largest():
+    mat = np.array([[0., 1., 5., 4.], [2., 7., 3., 6.]])
+    mask = asp.get_mask_1d(mat, 2, 4)
+    np.testing.assert_array_equal(mask, [[0, 0, 1, 1], [0, 1, 0, 1]])
+    assert asp.check_mask_1d(mask, 2, 4)
+
+
+def test_get_mask_1d_ragged_and_random():
+    rs = np.random.RandomState(0)
+    for shape in [(3, 10), (7, 4), (1, 9), (16, 64)]:
+        mat = rs.randn(*shape)
+        mask = asp.get_mask_1d(mat, 2, 4)
+        assert mask.shape == mat.shape
+        assert asp.check_mask_1d(mask * mat + mask, 2, 4)
+
+
+def test_check_mask_2d_reference_examples():
+    ok = np.array([[0, 8, 9, 0], [9, 0, 0, 10],
+                   [5, 0, 0, 6], [0, 4, 6, 0]])
+    assert asp.check_mask_2d(ok, 2, 4)
+    bad = np.array([[0, 8, 0, 9], [9, 0, 0, 10],
+                    [0, 5, 0, 6], [0, 4, 6, 0]])
+    assert not asp.check_mask_2d(bad, 2, 4)
+
+
+def test_get_mask_2d_greedy_valid():
+    rs = np.random.RandomState(1)
+    for shape in [(4, 4), (8, 8), (6, 10), (16, 32)]:
+        mat = rs.randn(*shape)
+        mask = asp.get_mask_2d_greedy(mat, 2, 4)
+        assert mask.shape == mat.shape
+        assert asp.check_mask_2d(mask, 2, 4)
+
+
+def test_get_mask_2d_best_beats_greedy():
+    rs = np.random.RandomState(2)
+    for _ in range(5):
+        mat = np.abs(rs.randn(8, 8))
+        greedy = (mat * asp.get_mask_2d_greedy(mat, 2, 4)).sum()
+        best = (mat * asp.get_mask_2d_best(mat, 2, 4)).sum()
+        assert best >= greedy - 1e-9
+        assert asp.check_mask_2d(asp.get_mask_2d_best(mat, 2, 4), 2, 4)
+
+
+def test_create_mask_rank4_conv_layout():
+    """OIHW conv weights prune along input channels (rank-4 contract)."""
+    rs = np.random.RandomState(3)
+    w = rs.randn(8, 16, 3, 3).astype(np.float32)
+    mask = asp.create_mask(w, func_name=MaskAlgo.MASK_1D, n=2, m=4)
+    assert mask.shape == w.shape and mask.dtype == w.dtype
+    # each (o, :, h, w) fiber is 2:4 along I
+    fibers = mask.transpose(0, 2, 3, 1).reshape(-1, 16)
+    groups = fibers.reshape(-1, 4)
+    assert (np.count_nonzero(groups, axis=1) <= 2).all()
+    assert asp.check_sparsity(mask, func_name=CheckMethod.CHECK_1D, n=2, m=4)
+
+
+def test_check_method_mapping():
+    assert CheckMethod.get_checking_method(MaskAlgo.MASK_1D) \
+        == CheckMethod.CHECK_1D
+    assert CheckMethod.get_checking_method(MaskAlgo.MASK_2D_BEST) \
+        == CheckMethod.CHECK_2D
+    assert CheckMethod.get_checking_method(MaskAlgo.MASK_2D_GREEDY) \
+        == CheckMethod.CHECK_2D
+
+
+def test_masks_satisfy_checker_for_any_nm():
+    """Generators and checkers share one convention (n = zeros per
+    group/line), including n != m/2 where the reference's own pair
+    disagrees with itself."""
+    rs = np.random.RandomState(4)
+    mat = rs.randn(8, 8)
+    for n, m in [(1, 4), (2, 4), (3, 4), (2, 8)]:
+        assert asp.check_mask_1d(asp.get_mask_1d(mat, n, m), n, m)
+        assert asp.check_mask_2d(asp.get_mask_2d_greedy(mat, n, m), n, m)
+        if m <= 4:  # exhaustive pattern enumeration; m=8 is intractable
+            assert asp.check_mask_2d(asp.get_mask_2d_best(mat, n, m), n, m)
+
+
+# -- static workflow --------------------------------------------------------
+
+def _build_static_mlp():
+    x = static.data("x", [-1, 32], "float32")
+    label = static.data("label", [-1, 1], "int64")
+    fc1 = paddle.nn.Linear(32, 32)
+    fc2 = paddle.nn.Linear(32, 10)
+    logits = fc2(paddle.nn.functional.relu(fc1(x)))
+    loss = paddle.nn.functional.cross_entropy(logits, label)
+    return x, label, fc1, fc2, loss
+
+
+def test_static_prune_and_train_keeps_sparsity():
+    paddle.enable_static()
+    static.reset_default_programs()
+    try:
+        paddle.seed(0)
+        _, _, fc1, fc2, loss = _build_static_mlp()
+        opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1))
+        opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+
+        masks = asp.prune_model(static.default_main_program(), n=2, m=4)
+        assert len(masks) == 2  # both Linear weights
+        for w in (fc1.weight, fc2.weight):
+            assert asp.check_sparsity(w.numpy(), n=2, m=4)
+
+        rs = np.random.RandomState(0)
+        xv = rs.randn(16, 32).astype(np.float32)
+        yv = rs.randint(0, 10, (16, 1)).astype(np.int64)
+        losses = []
+        for _ in range(5):
+            (lv,) = exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+            losses.append(float(lv))
+        # sparsity survives optimizer updates (mask fused into the step)
+        for w in (fc1.weight, fc2.weight):
+            assert asp.check_sparsity(w.numpy(), n=2, m=4)
+        # and training still learns
+        assert losses[-1] < losses[0]
+    finally:
+        paddle.disable_static()
+
+
+def test_static_excluded_layer_stays_dense():
+    paddle.enable_static()
+    static.reset_default_programs()
+    try:
+        paddle.seed(1)
+        _, _, fc1, fc2, loss = _build_static_mlp()
+        prog = static.default_main_program()
+        asp.set_excluded_layers(prog, [fc2.weight.name])
+        opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1))
+        opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        masks = asp.prune_model(prog, n=2, m=4)
+        assert fc1.weight.name in masks and fc2.weight.name not in masks
+        assert asp.check_sparsity(fc1.weight.numpy(), n=2, m=4)
+        assert not asp.check_sparsity(fc2.weight.numpy(), n=2, m=4)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_undecorated_prune_decays():
+    """Without decorate(), pruning is one-shot: updates re-densify."""
+    paddle.enable_static()
+    static.reset_default_programs()
+    try:
+        paddle.seed(2)
+        _, _, fc1, _, loss = _build_static_mlp()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        asp.prune_model(static.default_main_program(), n=2, m=4)
+        assert asp.check_sparsity(fc1.weight.numpy(), n=2, m=4)
+        rs = np.random.RandomState(1)
+        xv = rs.randn(16, 32).astype(np.float32)
+        yv = rs.randint(0, 10, (16, 1)).astype(np.int64)
+        for _ in range(3):
+            exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+        assert not asp.check_sparsity(fc1.weight.numpy(), n=2, m=4)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_elementwise_param_not_pruned():
+    """A 2-D param consumed only by elementwise ops (a learned gate) is
+    NOT matmul-family and must stay dense after prune_model."""
+    paddle.enable_static()
+    static.reset_default_programs()
+    try:
+        paddle.seed(4)
+        x = static.data("x", [-1, 32], "float32")
+        label = static.data("label", [-1, 1], "int64")
+        fc = paddle.nn.Linear(32, 32)
+        gate = paddle.create_parameter([1, 32], "float32")
+        logits = paddle.nn.Linear(32, 10)(fc(x) * gate)
+        loss = paddle.nn.functional.cross_entropy(logits, label)
+        opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1))
+        opt.minimize(loss)
+        static.Executor().run(static.default_startup_program())
+        masks = asp.prune_model(static.default_main_program(), n=2, m=4)
+        assert gate.name not in masks
+        assert asp.calculate_density(gate.numpy()) == 1.0
+    finally:
+        paddle.disable_static()
+
+
+def test_reprune_without_mask_clears_pin():
+    """prune(with_mask=True) then re-prune(with_mask=False): the stale
+    pinned mask must not keep being enforced by the decorated step."""
+    paddle.seed(5)
+    net = paddle.nn.Linear(32, 32)
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()))
+    asp.prune_model(net, n=2, m=4)
+    assert net.weight._asp_mask is not None
+    asp.prune_model(net, n=2, m=4, mask_algo="mask_2d_greedy",
+                    with_mask=False)
+    assert net.weight._asp_mask is None
+    # one-shot: a step after the mask was dropped re-densifies
+    rs = np.random.RandomState(3)
+    xb = paddle.to_tensor(rs.randn(8, 32).astype(np.float32))
+    loss = (net(xb) ** 2).mean()
+    loss.backward()
+    opt.step()
+    assert not asp.check_sparsity(net.weight.numpy(), n=2, m=4)
+
+
+# -- dygraph workflow -------------------------------------------------------
+
+class _MLP(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = paddle.nn.Linear(32, 32)
+        self.conv = paddle.nn.Conv2D(4, 8, 3, padding=1)
+        self.fc2 = paddle.nn.Linear(32, 10)
+
+    def forward(self, img):
+        h = paddle.nn.functional.relu(self.conv(img))
+        h = h.reshape([h.shape[0], -1])
+        return self.fc2(paddle.nn.functional.relu(self.fc1(
+            h[:, :32])))
+
+
+def test_dygraph_prune_and_step_keeps_sparsity():
+    paddle.seed(3)
+    net = _MLP()
+    opt = asp.decorate(paddle.optimizer.AdamW(
+        parameters=net.parameters(), learning_rate=1e-2))
+    masks = asp.prune_model(net, n=2, m=4, mask_algo="mask_2d_greedy")
+    assert len(masks) == 3  # fc1, conv, fc2 weights
+    assert asp.check_sparsity(net.fc1.weight.numpy(),
+                              func_name=CheckMethod.CHECK_2D, n=2, m=4)
+
+    rs = np.random.RandomState(2)
+    for _ in range(3):
+        img = paddle.to_tensor(rs.randn(4, 4, 4, 4).astype(np.float32))
+        label = paddle.to_tensor(rs.randint(0, 10, (4,)).astype(np.int64))
+        loss = paddle.nn.functional.cross_entropy(net(img), label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert asp.check_sparsity(net.fc1.weight.numpy(),
+                              func_name=CheckMethod.CHECK_2D, n=2, m=4)
+    assert asp.check_sparsity(net.conv.weight.numpy(), n=2, m=4)
+    # greedy 2-D admits at most n per row/col, so density <= 50% (and
+    # close to it — the skipped entries are the row/col-budget conflicts)
+    d = asp.calculate_density(net.fc1.weight.numpy())
+    assert 0.4 <= d <= 0.5
