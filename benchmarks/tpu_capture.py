@@ -110,6 +110,10 @@ def capture(suite_timeout_s: float = 1800.0) -> str | None:
     ok = [r for r in benches if "throughput" in r]
     if not ok:
         print("# capture: no successful bench (%s)" % err, flush=True)
+        for r in benches:  # surface per-bench errors in the watcher log
+            if "error" in r:
+                print("#   %s: %s" % (r.get("config"), r["error"][:300]),
+                      flush=True)
         return None
     try:
         commit = subprocess.run(

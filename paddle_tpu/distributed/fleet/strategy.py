@@ -23,6 +23,7 @@ _DEFAULTS = {
     "dgc": False,
     "lars": False,
     "lamb": False,
+    "asp": False,
     "localsgd": False,
     "adaptive_localsgd": False,
     "gradient_merge": False,

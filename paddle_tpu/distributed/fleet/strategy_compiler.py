@@ -152,13 +152,30 @@ class Fp16AllreduceMeta(MetaOptimizerBase):
         return Fp16AllreduceOptimizer(optimizer, hcg=hcg)
 
 
+class ASPMeta(MetaOptimizerBase):
+    """reference: fleet/meta_optimizers/asp_optimizer.py — decorates the
+    inner optimizer with the n:m sparsity guarantee (incubate/asp), so a
+    fleet-trained model pruned via asp.prune_model keeps its pattern.
+    Pre-stage: the mask re-apply must run where the params are actually
+    updated (inside the hybrid wrapper's inner step)."""
+    switch = "asp"
+    conflicts = ()
+
+    def _can_apply(self, strategy, optimizer):
+        return hasattr(optimizer, "_parameter_list")
+
+    def apply(self, optimizer, strategy, hcg):
+        from ...incubate.asp import decorate
+        return decorate(optimizer)
+
+
 class StrategyCompiler:
     """Resolves which metas fire, in what order, and that none conflict
     (reference: strategy_compiler.py StrategyCompiler.generate_optimizer)."""
 
     METAS: List[MetaOptimizerBase] = [LarsMeta(), LambMeta(),
                                       LocalSGDMeta(), DGCMeta(),
-                                      Fp16AllreduceMeta()]
+                                      Fp16AllreduceMeta(), ASPMeta()]
 
     def select(self, strategy, optimizer) -> List[MetaOptimizerBase]:
         chosen = [m for m in self.METAS if m.enabled(strategy)]

@@ -22,7 +22,8 @@ for sparsity-aware serving.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import weakref
+from typing import Dict
 
 import numpy as np
 
@@ -88,27 +89,33 @@ class ASPHelper:
         "conv2d", "conv2d_op", "depthwise_conv2d",
     })
 
-    # id(program) -> set of excluded name prefixes; None key = global
-    _excluded: Dict[Optional[int], set] = {}
+    # program -> set of excluded name prefixes (weak keys: entries die
+    # with the program, and a recycled id can't misattach exclusions);
+    # _excluded_global holds the program=None / dygraph set
+    _excluded = weakref.WeakKeyDictionary()
+    _excluded_global: set = set()
 
     # -- exclusion ----------------------------------------------------------
     @classmethod
     def set_excluded_layers(cls, main_program, param_names):
-        key = None if main_program is None else id(main_program)
-        cls._excluded.setdefault(key, set()).update(param_names)
+        if main_program is None:
+            cls._excluded_global.update(param_names)
+        else:
+            cls._excluded.setdefault(main_program, set()).update(param_names)
 
     @classmethod
     def reset_excluded_layers(cls, main_program=None):
         if main_program is None:
+            cls._excluded_global.clear()
             cls._excluded.clear()
         else:
-            cls._excluded.pop(id(main_program), None)
+            cls._excluded.pop(main_program, None)
 
     @classmethod
     def _is_excluded(cls, program, name):
-        pools = [cls._excluded.get(None, set())]
+        pools = [cls._excluded_global]
         if program is not None:
-            pools.append(cls._excluded.get(id(program), set()))
+            pools.append(cls._excluded.get(program, set()))
         return any(name.startswith(ex) for pool in pools for ex in pool)
 
     # -- supported-parameter predicate --------------------------------------
@@ -209,14 +216,22 @@ class OptimizerWithSparsityGuarantee:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        return self._optimizer.minimize(loss, startup_program=startup_program,
-                                        parameters=parameters,
-                                        no_grad_set=no_grad_set)
+        out = self._optimizer.minimize(loss, startup_program=startup_program,
+                                       parameters=parameters,
+                                       no_grad_set=no_grad_set)
+        # dygraph minimize runs the INNER step (backward + update), so the
+        # masks must be re-applied here too; in static mode minimize only
+        # stages the optimize directive and this loop is a no-op until
+        # params carry masks (enforcement lives in the compiled step)
+        self._reapply_masks()
+        return out
 
     def step(self):
         self._optimizer.step()
-        params = self._optimizer._parameter_list or []
-        for p in params:
+        self._reapply_masks()
+
+    def _reapply_masks(self):
+        for p in (self._optimizer._parameter_list or []):
             mask = getattr(p, "_asp_mask", None)
             if mask is not None:
                 p._data = p._data * mask

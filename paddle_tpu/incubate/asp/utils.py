@@ -187,8 +187,11 @@ def _valid_2d_patterns(n, m):
         r = np.zeros(m)
         r[list(keep)] = 1.0
         rows.append(r)
-    valid = [np.stack(combo) for combo in product(rows, repeat=m)
-             if np.all(np.stack(combo).sum(axis=0) == n)]
+    valid = []
+    for combo in product(rows, repeat=m):
+        s = np.stack(combo)
+        if np.all(s.sum(axis=0) == n):
+            valid.append(s)
     out = np.stack(valid)
     with _patterns_lock:
         _patterns_cache[key] = out

@@ -242,10 +242,15 @@ class Executor:
             feed_arrays.append(arr)
         train = program.optimize_directive is not None
         opt_id = id(program.optimize_directive[0]) if train else 0
+        # ASP decoration is part of the compiled step (asp_idx baked in
+        # _CompiledProgram.__init__): decorating AFTER a first run must
+        # miss the cache, so the flag is in the key
+        asp_on = train and bool(getattr(program.optimize_directive[0],
+                                        "_asp_decorated", False))
         key = (id(program), program.version, tuple(feed_names),
                tuple(tuple(np.asarray(a).shape) + (str(np.asarray(a).dtype),)
                      for a in feed_arrays),
-               tuple(fetch_names), train, opt_id)
+               tuple(fetch_names), train, opt_id, asp_on)
         cp = self._cache.get(key)
         if cp is None:
             cp = _CompiledProgram(program, feed_names, fetch_names, train)

@@ -247,6 +247,76 @@ def test_reprune_without_mask_clears_pin():
     assert not asp.check_sparsity(net.weight.numpy(), n=2, m=4)
 
 
+def test_dygraph_minimize_keeps_sparsity():
+    """opt.minimize(loss) (backward+step inside) must re-apply masks just
+    like step() does."""
+    paddle.seed(7)
+    net = paddle.nn.Linear(32, 32)
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()))
+    asp.prune_model(net, n=2, m=4)
+    xb = paddle.to_tensor(
+        np.random.RandomState(5).randn(8, 32).astype(np.float32))
+    loss = (net(xb) ** 2).mean()
+    opt.minimize(loss)
+    assert asp.check_sparsity(net.weight.numpy(), n=2, m=4)
+
+
+def test_static_decorate_after_first_run_recompiles():
+    """Decorating the optimizer after the program already compiled must
+    invalidate the cached step (the mask-enforcement set is baked at
+    compile)."""
+    paddle.enable_static()
+    static.reset_default_programs()
+    try:
+        paddle.seed(8)
+        _, _, fc1, _, loss = _build_static_mlp()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        rs = np.random.RandomState(6)
+        xv = rs.randn(16, 32).astype(np.float32)
+        yv = rs.randint(0, 10, (16, 1)).astype(np.int64)
+        # compile + run once UNdecorated
+        exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+        # now decorate and prune: later runs must pick up enforcement
+        asp.decorate(opt)
+        asp.prune_model(static.default_main_program(), n=2, m=4)
+        for _ in range(3):
+            exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+        assert asp.check_sparsity(fc1.weight.numpy(), n=2, m=4)
+    finally:
+        paddle.disable_static()
+
+
+def test_fleet_strategy_asp():
+    """strategy.asp routes through the StrategyCompiler (reference:
+    fleet/meta_optimizers/asp_optimizer.py) — the fleet optimizer keeps
+    pruned params sparse through training."""
+    import paddle_tpu.distributed as dist
+
+    dist.fleet._state.initialized = False
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.asp = True
+    dist.fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(6)
+    net = paddle.nn.Linear(32, 32)
+    opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                               learning_rate=0.1)
+    opt = dist.fleet.distributed_optimizer(opt)
+    asp.prune_model(net, n=2, m=4)
+    rs = np.random.RandomState(4)
+    for _ in range(3):
+        xb = paddle.to_tensor(rs.randn(8, 32).astype(np.float32))
+        loss = (net(xb) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert asp.check_sparsity(net.weight.numpy(), n=2, m=4)
+
+
 # -- dygraph workflow -------------------------------------------------------
 
 class _MLP(paddle.nn.Layer):
