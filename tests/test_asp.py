@@ -297,24 +297,27 @@ def test_fleet_strategy_asp():
     import paddle_tpu.distributed as dist
 
     dist.fleet._state.initialized = False
-    strategy = dist.fleet.DistributedStrategy()
-    strategy.asp = True
-    dist.fleet.init(is_collective=True, strategy=strategy)
+    try:
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.asp = True
+        dist.fleet.init(is_collective=True, strategy=strategy)
 
-    paddle.seed(6)
-    net = paddle.nn.Linear(32, 32)
-    opt = paddle.optimizer.SGD(parameters=net.parameters(),
-                               learning_rate=0.1)
-    opt = dist.fleet.distributed_optimizer(opt)
-    asp.prune_model(net, n=2, m=4)
-    rs = np.random.RandomState(4)
-    for _ in range(3):
-        xb = paddle.to_tensor(rs.randn(8, 32).astype(np.float32))
-        loss = (net(xb) ** 2).mean()
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-    assert asp.check_sparsity(net.weight.numpy(), n=2, m=4)
+        paddle.seed(6)
+        net = paddle.nn.Linear(32, 32)
+        opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                                   learning_rate=0.1)
+        opt = dist.fleet.distributed_optimizer(opt)
+        asp.prune_model(net, n=2, m=4)
+        rs = np.random.RandomState(4)
+        for _ in range(3):
+            xb = paddle.to_tensor(rs.randn(8, 32).astype(np.float32))
+            loss = (net(xb) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert asp.check_sparsity(net.weight.numpy(), n=2, m=4)
+    finally:
+        dist.fleet._state.initialized = False
 
 
 # -- dygraph workflow -------------------------------------------------------
