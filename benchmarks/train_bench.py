@@ -267,8 +267,16 @@ def main():
     import jax
     on_tpu = jax.default_backend() == "tpu"
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    # pallas_healthy explains a capture whose attn_paths.flash == 0: some
+    # tunnel environments serve XLA but 500 every Mosaic remote-compile,
+    # and the framework then degrades to its XLA attention/optimizer paths
+    pallas_healthy = None
+    if on_tpu:
+        from paddle_tpu.ops.pallas_kernels import pallas_tpu_healthy
+        pallas_healthy = pallas_tpu_healthy()
     print(json.dumps({"backend": jax.default_backend(),
-                      "device_kind": jax.devices()[0].device_kind}))
+                      "device_kind": jax.devices()[0].device_kind,
+                      "pallas_healthy": pallas_healthy}))
     benches = {"gpt2": bench_gpt2, "ernie": bench_ernie,
                "resnet50": bench_resnet50}
     for name, fn in benches.items():

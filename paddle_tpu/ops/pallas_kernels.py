@@ -39,6 +39,54 @@ _NEG_INF = -1e30
 # attention uses for its l/m residuals).
 _LANES = 128
 
+# One-shot Mosaic health probe result (None = not probed yet). Some TPU
+# environments (the axon tunnel's remote_compile helper, observed round 5)
+# serve XLA compiles fine but return HTTP 500 for every Mosaic kernel; a
+# single unprotected pallas_call then kills the whole train-step compile.
+# Every TPU Pallas entry point consults pallas_tpu_healthy() so the
+# framework degrades to its XLA paths instead of crashing.
+_PALLAS_TPU_HEALTHY = None
+
+
+def pallas_tpu_healthy():
+    """True iff a trivial Pallas kernel compiles AND runs on the TPU
+    backend (probed once per process; result cached).
+
+    Operator override: env PADDLE_TPU_PALLAS_HEALTH=0|1 skips the probe
+    and forces the answer (0 = never use Pallas on TPU, 1 = trust it).
+    Only meaningful when the default backend is TPU — interpret-mode
+    Pallas (CPU tests) never touches the Mosaic compiler and is not
+    gated by this."""
+    global _PALLAS_TPU_HEALTHY
+    if _PALLAS_TPU_HEALTHY is not None:
+        return _PALLAS_TPU_HEALTHY
+    import os
+    env = os.environ.get("PADDLE_TPU_PALLAS_HEALTH", "")
+    if env in ("0", "1"):
+        _PALLAS_TPU_HEALTHY = env == "1"
+        return _PALLAS_TPU_HEALTHY
+    try:
+        def _probe_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+        # ensure_compile_time_eval: the first consult usually happens at
+        # trace time (inside the train-step jit); the probe must execute
+        # eagerly, outside the ambient trace
+        with jax.ensure_compile_time_eval():
+            x = jnp.ones((8, _LANES), jnp.float32)
+            out = pl.pallas_call(
+                _probe_kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+            ok = bool((np.asarray(out) == 2.0).all())
+        _PALLAS_TPU_HEALTHY = ok
+    except Exception as e:  # MosaicError, RPC/tunnel failures, ...
+        import warnings
+        warnings.warn(
+            "Pallas TPU probe failed (%s: %s); all Pallas kernels fall "
+            "back to XLA paths for this process" %
+            (type(e).__name__, str(e)[:200]))
+        _PALLAS_TPU_HEALTHY = False
+    return _PALLAS_TPU_HEALTHY
+
 # Index-map constant: this framework runs with jax_enable_x64=True (int64
 # tensors are first-class, like the reference), under which a bare `0` in a
 # BlockSpec index map traces to an i64 literal that Mosaic cannot legalize
@@ -749,7 +797,7 @@ def fused_ln_shapes_ok(x):
         n *= s
     if jax.default_backend() != "tpu":
         return n * hdim <= 64 * 1024  # keep interpret mode cheap
-    return (hdim % 128 == 0 and hdim <= 16384
+    return (pallas_tpu_healthy() and hdim % 128 == 0 and hdim <= 16384
             and _fbdrln_block_n(n, hdim) is not None)
 
 
@@ -801,6 +849,8 @@ def fused_adamw_or_none(param, grad, lr, t, m1, m2, *, beta1, beta2,
     if not flag("use_fused_optimizer") or state.current_mesh() is not None:
         return None
     if jax.default_backend() != "tpu" and not interpret:
+        return None
+    if not interpret and not pallas_tpu_healthy():
         return None
     numel = 1
     for s in param.shape:
@@ -881,6 +931,8 @@ def flash_attention_or_none(query, key, value, attn_mask, is_causal,
         return None
     backend = jax.default_backend()
     interpret = backend != "tpu"
+    if not interpret and not pallas_tpu_healthy():
+        return None
     if not _shapes_ok(q, k, bool(is_causal), interpret):
         return None
     if dropout_p > 0.0 and interpret and not flag(
