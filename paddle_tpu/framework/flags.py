@@ -77,6 +77,13 @@ define_flag("flash_dropout_interpret", False,
             "allow the dropout-enabled flash kernel in interpret mode "
             "(CPU kernel tests only — the emulator is too slow for train "
             "loops; on TPU dropout always stays on the flash path)")
+define_flag("sdpa_chunked_threshold", 2048,
+            "key length at which the plain XLA sdpa switches to the "
+            "blockwise online-softmax path (O(T*block) memory, remat'd "
+            "blocks) instead of materialising the [Tq, Tk] score matrix. "
+            "This keeps long-context attention viable when the Pallas "
+            "flash kernel is unavailable (CPU, or a TPU whose Mosaic "
+            "compile path is broken — see pallas_tpu_healthy). 0 disables")
 define_flag("use_flash_attention", True,
             "route F.scaled_dot_product_attention to the Pallas flash "
             "kernel when shapes/backend allow")

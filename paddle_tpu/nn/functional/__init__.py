@@ -882,21 +882,28 @@ def scaled_dot_product_attention(query, key=None, value=None, attn_mask=None,
     value_t = key_t if value is None else value
     rng = RNG.next_key() if (dropout_p > 0.0 and training) else None
     if not return_weights:
-        from ...ops.pallas_kernels import (flash_attention_or_none,
-                                           note_xla_attention_path)
+        from ...framework.flags import flag
+        from ...ops.pallas_kernels import flash_attention_or_none
         out = flash_attention_or_none(
             query, key_t, value_t, attn_mask, is_causal,
             dropout_p=float(dropout_p) if training else 0.0, rng=rng)
         if out is not None:
             return out, None
-        note_xla_attention_path()
+        # chunked decision made HERE per call (concrete bool attr → part
+        # of the jit cache key), so set_flags takes effect immediately
+        # instead of being shadowed by already-compiled shapes. Path
+        # counters (xla_sdpa vs xla_chunked) bump inside the primitive
+        # body, partitioned by the branch actually traced.
+        thr = flag("sdpa_chunked_threshold")
         out = _nn.sdpa(query, key_t, value_t, attn_mask, rng,
                        dropout_p=float(dropout_p) if training else 0.0,
-                       causal=bool(is_causal), return_weights=False)
+                       causal=bool(is_causal), return_weights=False,
+                       chunked=bool(thr and key_t.shape[-2] >= thr))
         return out, None
     out, w = _nn.sdpa(query, key_t, value_t, attn_mask, rng,
                       dropout_p=float(dropout_p) if training else 0.0,
-                      causal=bool(is_causal), return_weights=True)
+                      causal=bool(is_causal), return_weights=True,
+                      chunked=False)
     return out, w
 
 

@@ -12,6 +12,7 @@ import numpy as np
 from jax import lax
 
 from ..framework.dispatch import primitive
+from ..framework.flags import flag
 
 # ---------------------------------------------------------------------------
 # activations (reference activation_op.cc:1240-)
@@ -792,10 +793,38 @@ def fused_add_act(x, y, *, act="relu", act_attrs=None):
 
 @primitive("scaled_dot_product_attention")
 def sdpa(q, k, v, mask, key, *, dropout_p=0.0, causal=False,
-         return_weights=False):
+         return_weights=False, chunked=None):
     """q/k/v: [B, H, T, D]; mask: additive float, broadcastable to
-    [B, H, Tq, Tk]."""
+    [B, H, Tq, Tk].
+
+    Long sequences with no additive mask / weights request / dropout
+    route to the blockwise online-softmax path — O(Tq·block) live memory
+    fwd AND bwd instead of the [Tq, Tk] matrix — so long-context stays
+    usable even where the Pallas flash kernel can't run (CPU; TPU with a
+    broken Mosaic tunnel). `chunked` is an ATTR (part of the jit cache
+    key): callers decide per call, typically Tk >=
+    FLAGS_sdpa_chunked_threshold (what chunked=None falls back to — but
+    the fallback reads the flag at trace time, so flag changes do not
+    invalidate already-compiled shapes; the functional gate passes a
+    concrete bool for exactly that reason)."""
     d = q.shape[-1]
+    if chunked is None:
+        thr = flag("sdpa_chunked_threshold")
+        chunked = bool(thr and k.shape[-2] >= thr)
+    from .pallas_kernels import _ATTN_PATHS
+    if (chunked and mask is None
+            and not return_weights
+            and not (dropout_p > 0.0 and key is not None)
+            # blockwise causal masking assumes the self-attention Tq==Tk
+            # alignment; the dense path's decode convention (diagonal
+            # pinned at the END for Tq<Tk) stays on the dense path
+            and (not causal or q.shape[-2] == k.shape[-2])):
+        from .ring_attention import _blockwise_attention
+        _ATTN_PATHS["xla_chunked"] += 1
+        return _blockwise_attention(q, k, v, causal=bool(causal),
+                                    scale=float(d) ** -0.5,
+                                    checkpoint_blocks=True)
+    _ATTN_PATHS["xla_sdpa"] += 1
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (float(d) ** -0.5)
     if causal:
         Tq, Tk = s.shape[-2], s.shape[-1]

@@ -895,7 +895,8 @@ def fused_adamw_or_none(param, grad, lr, t, m1, m2, *, beta1, beta2,
 # time, so after one compiled step the counters say whether the hot model
 # really hit the Pallas kernels (VERDICT r3: "log which path ran").
 # Read/reset via attention_path_counts().
-_ATTN_PATHS = {"flash": 0, "flash_dropout": 0, "xla_sdpa": 0}
+_ATTN_PATHS = {"flash": 0, "flash_dropout": 0, "xla_sdpa": 0,
+               "xla_chunked": 0}
 
 
 def attention_path_counts(reset=False):
@@ -904,10 +905,6 @@ def attention_path_counts(reset=False):
         for k in _ATTN_PATHS:
             _ATTN_PATHS[k] = 0
     return out
-
-
-def note_xla_attention_path():
-    _ATTN_PATHS["xla_sdpa"] += 1
 
 
 def flash_attention_or_none(query, key, value, attn_mask, is_causal,

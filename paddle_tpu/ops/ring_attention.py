@@ -100,10 +100,17 @@ def ring_attention(q, k, v, mesh: Mesh, *, seq_axis="sep", batch_axes=("dp",),
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
-def _blockwise_attention(q, k, v, *, causal, scale, block_k=512):
+def _blockwise_attention(q, k, v, *, causal, scale, block_k=512,
+                         checkpoint_blocks=False):
     """Single-device flash-style attention: scan K/V in blocks with the
     online-softmax accumulator, so the [Tq, Tk] score matrix never
-    materializes (only [Tq, block_k] tiles). q/k/v: [B,H,T,D]."""
+    materializes (only [Tq, block_k] tiles). q/k/v: [B,H,T,D].
+
+    checkpoint_blocks=True remats each block step, so the BACKWARD pass
+    also avoids the [Tq, Tk] residual (it stores only the per-step
+    carries, O(nblk · B·H·Tq·D), and recomputes the block probs) — the
+    lax-level stand-in for the Pallas flash backward when Mosaic is
+    unavailable (see nn_ops.sdpa chunked gate)."""
     t = k.shape[-2]
     bk = min(block_k, t)
     nblk = -(-t // bk)
@@ -133,6 +140,8 @@ def _blockwise_attention(q, k, v, *, causal, scale, block_k=512):
                                   keep=keep)
         return (acc, l, m, i + 1), ()
 
+    if checkpoint_blocks:
+        step = jax.checkpoint(step)
     (acc, l, m, _), _ = lax.scan(step, (acc, l, m, 0), (kb, vb))
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
