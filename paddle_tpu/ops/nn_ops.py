@@ -814,7 +814,10 @@ def sdpa(q, k, v, mask, key, *, dropout_p=0.0, causal=False,
     from .pallas_kernels import _ATTN_PATHS
     if (chunked and mask is None
             and not return_weights
-            and not (dropout_p > 0.0 and key is not None)
+            # dropout rides the blockwise path (per-block fold_in masks,
+            # numerator-only — see _blockwise_attention); p>=1 drops
+            # everything and keeps the dense path's exact zeros-semantics
+            and not (dropout_p >= 1.0 and key is not None)
             # blockwise causal masking assumes the self-attention Tq==Tk
             # alignment; the dense path's decode convention (diagonal
             # pinned at the END for Tq<Tk) stays on the dense path
@@ -823,7 +826,9 @@ def sdpa(q, k, v, mask, key, *, dropout_p=0.0, causal=False,
         _ATTN_PATHS["xla_chunked"] += 1
         return _blockwise_attention(q, k, v, causal=bool(causal),
                                     scale=float(d) ** -0.5,
-                                    checkpoint_blocks=True)
+                                    checkpoint_blocks=True,
+                                    dropout_p=float(dropout_p),
+                                    dropout_key=key)
     _ATTN_PATHS["xla_sdpa"] += 1
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (float(d) ** -0.5)
     if causal:

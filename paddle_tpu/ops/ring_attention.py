@@ -25,11 +25,17 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _online_block(q, k_blk, v_blk, acc, l, m, *, scale, keep):
+def _online_block(q, k_blk, v_blk, acc, l, m, *, scale, keep,
+                  drop_keep=None, drop_scale=1.0):
     """Fold one K/V block into the online-softmax accumulator.
 
     q [B,H,Tq,D], k_blk/v_blk [B,H,Tk,D], keep [Tq,Tk] bool mask.
-    Returns updated (acc [B,H,Tq,D] f32, l [B,H,Tq] f32, m [B,H,Tq] f32)."""
+    Returns updated (acc [B,H,Tq,D] f32, l [B,H,Tq] f32, m [B,H,Tq] f32).
+
+    drop_keep ([B,H,Tq,Tk] bool) applies attention dropout to the
+    NUMERATOR only: dropout(w)·v == (dropout(p)/l)·v because dropout is
+    an elementwise mask+rescale, so l stays the undropped softmax
+    denominator — same contract as the Pallas flash-dropout kernel."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
                    preferred_element_type=jnp.float32) * scale
     s = jnp.where(keep[None, None], s, jnp.asarray(-1e30, s.dtype))
@@ -38,8 +44,10 @@ def _online_block(q, k_blk, v_blk, acc, l, m, *, scale, keep):
     p = jnp.exp(s - m_new[..., None])
     corr = jnp.exp(m - m_new)                    # rescale old accumulator
     l_new = l * corr + jnp.sum(p, axis=-1)
+    p_acc = p if drop_keep is None else \
+        p * jnp.where(drop_keep, jnp.float32(drop_scale), jnp.float32(0))
     acc_new = acc * corr[..., None] + \
-        jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        jnp.einsum("bhqk,bhkd->bhqd", p_acc, v_blk.astype(jnp.float32))
     return acc_new, l_new, m_new
 
 
@@ -101,7 +109,8 @@ def ring_attention(q, k, v, mesh: Mesh, *, seq_axis="sep", batch_axes=("dp",),
 
 
 def _blockwise_attention(q, k, v, *, causal, scale, block_k=512,
-                         checkpoint_blocks=False):
+                         checkpoint_blocks=False, dropout_p=0.0,
+                         dropout_key=None):
     """Single-device flash-style attention: scan K/V in blocks with the
     online-softmax accumulator, so the [Tq, Tk] score matrix never
     materializes (only [Tq, block_k] tiles). q/k/v: [B,H,T,D].
@@ -110,7 +119,12 @@ def _blockwise_attention(q, k, v, *, causal, scale, block_k=512,
     also avoids the [Tq, Tk] residual (it stores only the per-step
     carries, O(nblk · B·H·Tq·D), and recomputes the block probs) — the
     lax-level stand-in for the Pallas flash backward when Mosaic is
-    unavailable (see nn_ops.sdpa chunked gate)."""
+    unavailable (see nn_ops.sdpa chunked gate).
+
+    Attention dropout (dropout_p>0 with a dropout_key) draws each block's
+    [B,H,Tq,block_k] keep mask from fold_in(dropout_key, block_idx) —
+    deterministic per (key, block), so the remat'd backward regenerates
+    the identical mask."""
     t = k.shape[-2]
     bk = min(block_k, t)
     nblk = -(-t // bk)
@@ -136,8 +150,15 @@ def _blockwise_attention(q, k, v, *, causal, scale, block_k=512,
             keep = keep & (tq_pos[:, None] >= tk[None, :])
         else:
             keep = jnp.broadcast_to(keep, (q.shape[-2], bk))
+        drop_keep, drop_scale = None, 1.0
+        if dropout_p > 0.0 and dropout_key is not None:
+            drop_keep = jax.random.bernoulli(
+                jax.random.fold_in(dropout_key, i), 1.0 - dropout_p,
+                q.shape[:-1] + (bk,))
+            drop_scale = 1.0 / (1.0 - dropout_p)
         acc, l, m = _online_block(q, k_blk, v_blk, acc, l, m, scale=scale,
-                                  keep=keep)
+                                  keep=keep, drop_keep=drop_keep,
+                                  drop_scale=drop_scale)
         return (acc, l, m, i + 1), ()
 
     if checkpoint_blocks:

@@ -88,12 +88,49 @@ def test_decode_shapes_stay_dense():
         rtol=2e-5, atol=2e-5)
 
 
-def test_mask_dropout_weights_stay_dense():
+def test_mask_and_weights_stay_dense():
     t = 256
     q = jnp.asarray(_rand((1, 1, t, 8), 8))
     attention_path_counts(reset=True)
     mask = jnp.zeros((1, 1, t, t), jnp.float32)
     nn_ops.sdpa(q, q, q, mask, None)
-    nn_ops.sdpa(q, q, q, None, jax.random.PRNGKey(0), dropout_p=0.5)
     nn_ops.sdpa(q, q, q, None, None, return_weights=True)
+    nn_ops.sdpa(q, q, q, None, jax.random.PRNGKey(0), dropout_p=1.0)
     assert attention_path_counts()["xla_chunked"] == 0
+
+
+def test_dropout_parity_exact():
+    """Chunked attention dropout == dense attention with the SAME
+    per-block fold_in masks applied to the normalized weights (dropout on
+    the numerator only; denominator stays undropped)."""
+    B, H, t, d, bk, p = 1, 2, 640, 8, 512, 0.3  # 640: two blocks + pad
+    q, k, v = (jnp.asarray(_rand((B, H, t, d), s)) for s in (9, 10, 11))
+    key = jax.random.PRNGKey(42)
+    attention_path_counts(reset=True)
+    out = nn_ops.sdpa(q, k, v, None, key, dropout_p=p, causal=True)
+    assert attention_path_counts()["xla_chunked"] >= 1
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+    cm = jnp.tril(jnp.ones((t, t), bool))
+    w = jax.nn.softmax(jnp.where(cm, s, -jnp.inf), axis=-1)
+    keep = jnp.concatenate(
+        [jax.random.bernoulli(jax.random.fold_in(key, i), 1.0 - p,
+                              (B, H, t, bk)) for i in range(2)],
+        axis=-1)[..., :t]
+    want = jnp.einsum("bhqk,bhkd->bhqd",
+                      w * keep / (1.0 - p), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_dropout_grads_flow_chunked():
+    t = 256
+    qn = _rand((1, 2, t, 8), 12)
+    qt = paddle.to_tensor(qn, stop_gradient=False)
+    attention_path_counts(reset=True)
+    out, _ = F.scaled_dot_product_attention(qt, qt, qt, dropout_p=0.25,
+                                            is_causal=True)
+    (out ** 2).sum().backward()
+    assert attention_path_counts()["xla_chunked"] >= 1
+    g = np.asarray(qt.grad.numpy())
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
