@@ -1,0 +1,76 @@
+"""Opportunistic TPU tuning sweep: find the best GPT-2 batch size for
+whichever attention tier the backend can actually run (Pallas flash when
+Mosaic is healthy, blockwise/XLA otherwise — see pallas_tpu_healthy).
+
+The r3 sweep that picked B=16 was measured WITH the flash kernel; a
+tunnel whose Mosaic compile path is broken runs the XLA tier, whose
+optimum may differ. Run this whenever the tunnel is up:
+
+  python benchmarks/tpu_tune.py                 # sweep 8..32, default
+  python benchmarks/tpu_tune.py 16 32 48        # explicit batches
+
+Writes TUNE_TPU_<ts>.json at the repo root with one entry per batch
+(throughput, step_ms, mfu, attn_paths, pallas_healthy) and prints the
+winner; feed that into PADDLE_TPU_GPT2_BATCH for the next capture
+(benchmarks/train_bench.py reads it)."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "benchmarks"))
+
+from tpu_capture import _parse_lines, probe_tpu, run_timed_child  # noqa: E402
+
+
+def run_one(batch: int, timeout_s: float = 900.0):
+    stdout, stderr_tail, err = run_timed_child(
+        [sys.executable, os.path.join(_ROOT, "benchmarks", "train_bench.py"),
+         "gpt2"], timeout_s, env={"PADDLE_TPU_GPT2_BATCH": str(batch)})
+    results = _parse_lines(stdout)
+    backend = next((r for r in results if "backend" in r), {})
+    bench = next((r for r in results if "throughput" in r), None)
+    return {"batch": batch, "backend": backend.get("backend"),
+            "pallas_healthy": backend.get("pallas_healthy"),
+            "result": bench, "error": err}
+
+
+def main():
+    batches = [int(a) for a in sys.argv[1:]] or [8, 16, 24, 32]
+    if not probe_tpu():
+        # fail fast: a wedged tunnel would otherwise burn the full child
+        # timeout per batch
+        print("# tune: TPU probe timed out, aborting sweep", flush=True)
+        return
+    rows = []
+    for b in batches:
+        row = run_one(b)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    ok = [r for r in rows if r["result"] and r["backend"] == "tpu"]
+    artifact = {
+        "timestamp": time.strftime("%Y%m%dT%H%M%S"),
+        "unix_time": time.time(),
+        "sweep": rows,
+        "best": max(ok, key=lambda r: r["result"]["throughput"])
+        if ok else None,
+    }
+    path = os.path.join(_ROOT,
+                        "TUNE_TPU_%s.json" % artifact["timestamp"])
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    if ok:
+        best = artifact["best"]
+        print("# best: B=%d  %.1f tok/s  mfu=%s" % (
+            best["batch"], best["result"]["throughput"],
+            best["result"]["mfu"]), flush=True)
+    else:
+        print("# no successful TPU run in sweep", flush=True)
+
+
+if __name__ == "__main__":
+    main()
