@@ -49,8 +49,9 @@ _PALLAS_TPU_HEALTHY = None
 
 
 def pallas_tpu_healthy():
-    """True iff a trivial Pallas kernel compiles AND runs on the TPU
-    backend (probed once per process; result cached).
+    """True iff the real flash-attention kernels (fwd + dq + dk/dv, with
+    the in-kernel PRNG dropout variant) compile AND run on the TPU
+    backend at minimal shapes (probed once per process; result cached).
 
     Operator override: env PADDLE_TPU_PALLAS_HEALTH=0|1 skips the probe
     and forces the answer (0 = never use Pallas on TPU, 1 = trust it).
@@ -66,18 +67,58 @@ def pallas_tpu_healthy():
         _PALLAS_TPU_HEALTHY = env == "1"
         return _PALLAS_TPU_HEALTHY
     try:
-        def _probe_kernel(x_ref, o_ref):
-            o_ref[...] = x_ref[...] * 2.0
-        # ensure_compile_time_eval: the first consult usually happens at
-        # trace time (inside the train-step jit); the probe must execute
-        # eagerly, outside the ambient trace
-        with jax.ensure_compile_time_eval():
-            x = jnp.ones((8, _LANES), jnp.float32)
-            out = pl.pallas_call(
-                _probe_kernel,
-                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
-            ok = bool((np.asarray(out) == 2.0).all())
-        _PALLAS_TPU_HEALTHY = ok
+        # probe with the REAL flash kernels at minimal shapes (fwd + dq +
+        # dk/dv via the custom vjp), not a trivial add: a tunnel whose
+        # Mosaic service fails only on non-trivial kernels must still
+        # read as unhealthy, or the first train step dies anyway. The
+        # dropout variant is probed (dropout_p>0 + seed) because it is a
+        # superset: it additionally exercises the in-kernel PRNG ops
+        # (pltpu.prng_seed / prng_random_bits) that training with
+        # attention dropout compiles.
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(1, 1, 128, 8), jnp.float32)
+        seed = jnp.zeros((1,), jnp.int32)
+
+        def f(q):
+            # dp=0 term is VALUE-checked against the dense oracle below
+            # (a miscompiling-but-finite backend must read unhealthy);
+            # the dp>0 term additionally compiles the in-kernel PRNG
+            # variant, checkable only for finiteness
+            return (_flash(q, q, q, None, True, False, 0.0),
+                    _flash(q, q, q, seed, True, False, 0.1).sum())
+
+        def run(q):
+            out, dsum = f(q)
+            return dsum + out.sum(), out
+
+        vg = jax.value_and_grad(run, has_aux=True)
+        try:
+            from jax.core import trace_ctx
+            clean = type(trace_ctx.trace).__name__ == "EvalTrace"
+        except Exception:
+            clean = False
+        if clean:
+            # normal case: make_train_step and friends pre-probe before
+            # any tracing starts, so the probe is an ordinary jit compile
+            (val, out), grad = jax.jit(vg)(q)
+        else:
+            # first consult happened INSIDE an ambient trace (eager-op
+            # jit, a user's own jit): escape it and evaluate eagerly —
+            # each pallas_call still round-trips the Mosaic compiler
+            with jax.ensure_compile_time_eval():
+                (val, out), grad = vg(q)
+        want = _xla_attention(q, q, q, True)
+        _PALLAS_TPU_HEALTHY = bool(
+            np.isfinite(np.asarray(val))
+            and np.isfinite(np.asarray(grad)).all()
+            and np.allclose(np.asarray(out), np.asarray(want),
+                            rtol=2e-3, atol=2e-3))
+        if not _PALLAS_TPU_HEALTHY:
+            import warnings
+            warnings.warn(
+                "Pallas TPU probe produced non-finite or wrong values; "
+                "all Pallas kernels fall back to XLA paths for this "
+                "process")
     except Exception as e:  # MosaicError, RPC/tunnel failures, ...
         import warnings
         warnings.warn(
