@@ -8,10 +8,10 @@ all-to-all attention (strategy.hybrid_configs["sep_method"] =
 "alltoall") via shard_map over the traced arrays, else returns None and
 the caller falls back to the dense/flash path.
 
-Attention-probability dropout is not implemented on the sep path: when
-the caller passes an active dropout_p this returns None and the caller's
-dense path (which does apply it) runs under the sep sharding constraints
-instead — semantics never silently change with parallelism layout."""
+Attention-probability dropout rides the sep path natively: ring/Ulysses
+draw per-block keep masks from fold_in of a replicated key (plus each
+dp/mp/sep shard's mesh index, so examples/heads draw independent masks)
+— see ops/ring_attention.py."""
 from __future__ import annotations
 
 from ....framework import state
@@ -29,16 +29,19 @@ def sep_attention_or_none(q: Tensor, k: Tensor, v: Tensor, *,
                           causal=True, method=None, dropout_p=0.0,
                           training=False):
     """q/k/v: [B, H, T, D] Tensors inside a mesh trace. Returns the
-    attention output Tensor, or None when sequence parallelism is off or
-    attention dropout is active (dense fallback keeps semantics)."""
+    attention output Tensor, or None when sequence parallelism is off."""
     mesh = state.current_mesh()
     if mesh is None or "sep" not in mesh.shape or mesh.shape["sep"] <= 1:
         return None
+    key = None
     if dropout_p > 0.0 and training:
-        return None
+        from ....framework.random import RNG
+        key = RNG.next_key()
     method = method or sep_method()
     batch_axes = tuple(a for a in ("dp", "sharding") if a in mesh.shape)
     fn = ulysses_attention if method == "alltoall" else ring_attention
     out = fn(q._data, k._data, v._data, mesh, seq_axis="sep",
-             batch_axes=batch_axes, head_axis="mp", causal=causal)
+             batch_axes=batch_axes, head_axis="mp", causal=causal,
+             dropout_p=float(dropout_p) if key is not None else 0.0,
+             key=key)
     return Tensor(out, _internal=True)

@@ -51,17 +51,29 @@ def _online_block(q, k_blk, v_blk, acc, l, m, *, scale, keep,
     return acc_new, l_new, m_new
 
 
-def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
+def _ring_attention_local(q, k, v, *, axis_name, causal, scale,
+                          dropout_p=0.0, key=None, drop_axes=()):
     """Per-shard body (inside shard_map). q/k/v: [B, H, T_local, D] — the
     sequence dim is the axis_name shard. Online-softmax across ring steps;
     causal masking is done by GLOBAL positions so the result equals
     full-sequence causal attention. Block 0 (the local K/V) is folded
     before the scan so only size-1 ppermute rotations happen — none of
-    them wasted."""
+    them wasted.
+
+    Attention dropout (dropout_p>0 + key): each [Tq_local, Tk_local]
+    block draws its keep mask from fold_in(key, my_idx·size + kb) —
+    globally consistent block ids, so the result is a well-defined
+    dropout sample of full-sequence attention — after folding the
+    replicated key by each `drop_axes` mesh index (dp/mp shards hold
+    different examples/heads and must draw independent masks)."""
     size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     t_local = q.shape[-2]
     tq_pos = jnp.arange(t_local) + my_idx * t_local
+
+    if dropout_p > 0.0 and key is not None:
+        for ax in drop_axes:
+            key = jax.random.fold_in(key, lax.axis_index(ax))
 
     def keep_for(kb):
         if not causal:
@@ -69,11 +81,21 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
         tk = jnp.arange(t_local) + kb * t_local
         return tq_pos[:, None] >= tk[None, :]
 
+    def drop_for(kb):
+        if dropout_p <= 0.0 or key is None:
+            return None, 1.0
+        bkey = jax.random.fold_in(key, my_idx * size + kb)
+        return (jax.random.bernoulli(bkey, 1.0 - dropout_p,
+                                     q.shape[:-1] + (t_local,)),
+                1.0 / (1.0 - dropout_p))
+
     acc0 = jnp.zeros(q.shape[:-1] + (q.shape[-1],), jnp.float32)
     l0 = jnp.zeros(q.shape[:-1], jnp.float32)
     m0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    dk0, ds0 = drop_for(my_idx)
     acc0, l0, m0 = _online_block(q, k, v, acc0, l0, m0, scale=scale,
-                                 keep=keep_for(my_idx))
+                                 keep=keep_for(my_idx), drop_keep=dk0,
+                                 drop_scale=ds0)
 
     perm = [(i, (i + 1) % size) for i in range(size)]
 
@@ -82,8 +104,10 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
         k_cur = lax.ppermute(k_cur, axis_name, perm)
         v_cur = lax.ppermute(v_cur, axis_name, perm)
         kb = (my_idx - i) % size                 # global block id of k_cur
+        dk, ds = drop_for(kb)
         acc, l, m = _online_block(q, k_cur, v_cur, acc, l, m, scale=scale,
-                                  keep=keep_for(kb))
+                                  keep=keep_for(kb), drop_keep=dk,
+                                  drop_scale=ds)
         return (acc, l, m, k_cur, v_cur), ()
 
     (acc, l, m, _, _), _ = lax.scan(
@@ -92,20 +116,42 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
     return out.astype(q.dtype)
 
 
+def _shard_dispatch(body, mesh, spec, q, k, v, key=None):
+    """shard_map the attention body over q/k/v (+ an optional replicated
+    PRNG key operand) — single dispatch point shared by ring/Ulysses,
+    dropout and not."""
+    if key is not None:
+        return jax.shard_map(lambda a, b, c, kk: body(a, b, c, key=kk),
+                             mesh=mesh, in_specs=(spec, spec, spec, P()),
+                             out_specs=spec, check_vma=False)(q, k, v, key)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
 def ring_attention(q, k, v, mesh: Mesh, *, seq_axis="sep", batch_axes=("dp",),
-                   head_axis="mp", causal=True, scale=None):
+                   head_axis="mp", causal=True, scale=None, dropout_p=0.0,
+                   key=None):
     """Full-sequence attention with q/k/v sharded over `seq_axis` on dim 2.
 
     q/k/v: jax arrays [B, H, T, D] (T = GLOBAL sequence). Returns [B,H,T,D]
-    with the same sharding. Differentiable (scan+ppermute transpose)."""
+    with the same sharding. Differentiable (scan+ppermute transpose).
+
+    dropout_p>0 with a PRNG `key` applies attention dropout on the ring
+    (per-block fold_in masks; dp/mp shards fold their mesh index in so
+    different examples/heads draw independent masks)."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     spec = P(batch_axes, head_axis if head_axis in mesh.shape else None,
              seq_axis, None)
-    fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
-                           causal=causal, scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    use_drop = dropout_p > 0.0 and key is not None
+    fn = functools.partial(
+        _ring_attention_local, axis_name=seq_axis, causal=causal,
+        scale=scale,
+        dropout_p=float(dropout_p) if use_drop else 0.0,
+        drop_axes=tuple(a for a in (*batch_axes, head_axis)
+                        if a in mesh.shape))
+    return _shard_dispatch(fn, mesh, spec, q, k, v,
+                           key if use_drop else None)
 
 
 def _blockwise_attention(q, k, v, *, causal, scale, block_k=512,
@@ -167,11 +213,17 @@ def _blockwise_attention(q, k, v, *, causal, scale, block_k=512,
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
-def _ulysses_local(q, k, v, *, axis_name, causal, scale):
+def _ulysses_local(q, k, v, *, axis_name, causal, scale, dropout_p=0.0,
+                   key=None, drop_axes=()):
     """Ulysses (all-to-all) body: exchange sequence shards for head shards,
     run blockwise (online-softmax) local attention on the full sequence /
     subset of heads, exchange back. q/k/v local: [B, H, T_local, D]; H
-    divisible by ring size."""
+    divisible by ring size.
+
+    Attention dropout folds the replicated key by this shard's axis index
+    (each shard holds a DIFFERENT head group post-exchange) and by every
+    `drop_axes` mesh index, then rides _blockwise_attention's per-block
+    fold_in masks."""
     def seq2head(x):
         # [B,H,Tl,D] -> [B, H/size, T, D]
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
@@ -179,22 +231,33 @@ def _ulysses_local(q, k, v, *, axis_name, causal, scale):
     def head2seq(x):
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
+    if dropout_p > 0.0 and key is not None:
+        for ax in (*drop_axes, axis_name):
+            key = jax.random.fold_in(key, lax.axis_index(ax))
     qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
-    o = _blockwise_attention(qh, kh, vh, causal=causal, scale=scale)
+    o = _blockwise_attention(qh, kh, vh, causal=causal, scale=scale,
+                             dropout_p=dropout_p, dropout_key=key)
     return head2seq(o)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis="sep",
                       batch_axes=("dp",), head_axis="mp", causal=True,
-                      scale=None):
+                      scale=None, dropout_p=0.0, key=None):
     """DeepSpeed-Ulysses-style sequence parallelism: all_to_all turns the
     sequence shard into a head shard, local attention sees the FULL
-    sequence. Needs num_heads_local % sep_degree == 0."""
+    sequence. Needs num_heads_local % sep_degree == 0.
+
+    dropout_p>0 with a PRNG `key` applies attention dropout in the local
+    blockwise attention (independent masks per head/batch shard)."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     spec = P(batch_axes, head_axis if head_axis in mesh.shape else None,
              seq_axis, None)
-    fn = functools.partial(_ulysses_local, axis_name=seq_axis,
-                           causal=causal, scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    use_drop = dropout_p > 0.0 and key is not None
+    fn = functools.partial(
+        _ulysses_local, axis_name=seq_axis, causal=causal, scale=scale,
+        dropout_p=float(dropout_p) if use_drop else 0.0,
+        drop_axes=tuple(a for a in (*batch_axes, head_axis)
+                        if a in mesh.shape))
+    return _shard_dispatch(fn, mesh, spec, q, k, v,
+                           key if use_drop else None)
