@@ -132,7 +132,8 @@ def init(role_maker=None, is_collective=True, strategy=None):
     topo = CommunicateTopology(("data", "pipe", "sharding", "model", "sep"),
                                (dp, pp, sd, mp, sep))
     _state.hcg = HybridCommunicateGroup(
-        topo, sep_method=hybrid.get("sep_method", "ring"))
+        topo, sep_method=hybrid.get("sep_method", "ring"),
+        sep_remat=hybrid.get("sep_remat", False))
     _set_hcg(_state.hcg)
     _state.initialized = True
     return _state

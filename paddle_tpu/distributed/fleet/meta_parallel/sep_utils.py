@@ -39,9 +39,13 @@ def sep_attention_or_none(q: Tensor, k: Tensor, v: Tensor, *,
         key = RNG.next_key()
     method = method or sep_method()
     batch_axes = tuple(a for a in ("dp", "sharding") if a in mesh.shape)
+    kw = {}
+    if method != "alltoall":
+        hcg = _topo.get_hybrid_communicate_group()
+        kw["checkpoint_steps"] = bool(getattr(hcg, "sep_remat", False))
     fn = ulysses_attention if method == "alltoall" else ring_attention
     out = fn(q._data, k._data, v._data, mesh, seq_axis="sep",
              batch_axes=batch_axes, head_axis="mp", causal=causal,
              dropout_p=float(dropout_p) if key is not None else 0.0,
-             key=key)
+             key=key, **kw)
     return Tensor(out, _internal=True)

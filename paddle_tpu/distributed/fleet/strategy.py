@@ -81,6 +81,7 @@ _CONFIG_DEFAULTS = {
         "dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
         "sharding_degree": 1, "sep_degree": 1,
         "sep_method": "ring",        # "ring" | "alltoall" (Ulysses)
+        "sep_remat": False,          # remat ring steps in backward
     },
     "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
                        "send_queue_size": 16,

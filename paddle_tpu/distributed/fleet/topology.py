@@ -119,9 +119,12 @@ class HybridCommunicateGroup:
 
     def __init__(self, topology: CommunicateTopology = None,
                  dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
-                 sep_degree=1, sep_method="ring",
+                 sep_degree=1, sep_method="ring", sep_remat=False,
                  devices: Optional[Sequence] = None):
         self.sep_method = sep_method
+        # remat each ring step in backward (O(size*Tl*D) residuals instead
+        # of O(T^2/size)) — hybrid_configs["sep_remat"]
+        self.sep_remat = bool(sep_remat)
         if topology is not None:
             dims = dict(zip(topology.get_hybrid_group_names(),
                             topology._dims))

@@ -52,7 +52,8 @@ def _online_block(q, k_blk, v_blk, acc, l, m, *, scale, keep,
 
 
 def _ring_attention_local(q, k, v, *, axis_name, causal, scale,
-                          dropout_p=0.0, key=None, drop_axes=()):
+                          dropout_p=0.0, key=None, drop_axes=(),
+                          checkpoint_steps=False):
     """Per-shard body (inside shard_map). q/k/v: [B, H, T_local, D] — the
     sequence dim is the axis_name shard. Online-softmax across ring steps;
     causal masking is done by GLOBAL positions so the result equals
@@ -110,6 +111,14 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale,
                                   drop_scale=ds)
         return (acc, l, m, k_cur, v_cur), ()
 
+    if checkpoint_steps:
+        # backward otherwise saves each ring step's [Tq_l, Tk_l] probs
+        # (O(T^2/size) residuals); remat keeps only the carries and
+        # replays the block compute + ppermute — O(size · Tl · D).
+        # prevent_cse=False: safe and recommended for scan bodies, and
+        # avoids optimization barriers that would inhibit the
+        # ppermute/matmul overlap this module relies on
+        step = jax.checkpoint(step, prevent_cse=False)
     (acc, l, m, _, _), _ = lax.scan(
         step, (acc0, l0, m0, k, v), jnp.arange(1, size))
     out = acc / jnp.maximum(l, 1e-30)[..., None]
@@ -130,7 +139,7 @@ def _shard_dispatch(body, mesh, spec, q, k, v, key=None):
 
 def ring_attention(q, k, v, mesh: Mesh, *, seq_axis="sep", batch_axes=("dp",),
                    head_axis="mp", causal=True, scale=None, dropout_p=0.0,
-                   key=None):
+                   key=None, checkpoint_steps=False):
     """Full-sequence attention with q/k/v sharded over `seq_axis` on dim 2.
 
     q/k/v: jax arrays [B, H, T, D] (T = GLOBAL sequence). Returns [B,H,T,D]
@@ -146,7 +155,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, seq_axis="sep", batch_axes=("dp",),
     use_drop = dropout_p > 0.0 and key is not None
     fn = functools.partial(
         _ring_attention_local, axis_name=seq_axis, causal=causal,
-        scale=scale,
+        scale=scale, checkpoint_steps=checkpoint_steps,
         dropout_p=float(dropout_p) if use_drop else 0.0,
         drop_axes=tuple(a for a in (*batch_axes, head_axis)
                         if a in mesh.shape))
