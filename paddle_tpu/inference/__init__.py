@@ -120,6 +120,8 @@ class Predictor:
     def _compiled(self, sig):
         if sig in self._exec_cache:
             return self._exec_cache[sig]
+        from ..ops.pallas_kernels import preprobe_pallas_health
+        preprobe_pallas_health()
         prog = self._program
         bf16 = self._config._bf16
         cap_names = sorted(self._captures)

@@ -112,12 +112,8 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
     data axes — XLA inserts grad all-reduces and TP collectives over ICI
     (the compiled replacement for the reference's Reducer
     imperative/reducer.h:130 and mp_layers' hand-inserted c_* ops)."""
-    if jax.default_backend() == "tpu":
-        # pre-probe Mosaic health NOW, at a clean (untraced) moment, so
-        # the flash/fused gates consulted during the step trace read a
-        # cached verdict instead of probing mid-trace
-        from ..ops.pallas_kernels import pallas_tpu_healthy
-        pallas_tpu_healthy()
+    from ..ops.pallas_kernels import preprobe_pallas_health
+    preprobe_pallas_health()
     if mesh is None:
         mesh = getattr(network, "_pt_mesh", None)
     # ZeRO stage over the "sharding" axis: 1 = optimizer state only,

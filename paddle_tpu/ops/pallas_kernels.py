@@ -948,6 +948,15 @@ def attention_path_counts(reset=False):
     return out
 
 
+def preprobe_pallas_health():
+    """Run the Mosaic health probe now IF the backend is TPU — called by
+    compile entry points (make_train_step, static executor, predictor) at
+    a clean, untraced moment so the gates consulted during their traces
+    read a cached verdict instead of probing mid-trace. No-op elsewhere."""
+    if jax.default_backend() == "tpu":
+        pallas_tpu_healthy()
+
+
 def flash_attention_or_none(query, key, value, attn_mask, is_causal,
                             dropout_p=0.0, rng=None):
     """Tensor-level gate: return flash-attention output, or None to signal

@@ -92,6 +92,8 @@ class _CompiledProgram:
                 i for i, p in enumerate(self.params)
                 if getattr(opt, "_asp_decorated", False)
                 and getattr(p, "_asp_mask", None) is not None)
+        from ..ops.pallas_kernels import preprobe_pallas_health
+        preprobe_pallas_health()
         self._jitted = jax.jit(self._run) if not train else \
             jax.jit(self._run_train)
 
