@@ -94,17 +94,52 @@ def _parse_lines(text: str):
     return out
 
 
+_CONFIGS = ("gpt2", "ernie", "resnet50", "gpt2_long")
+
+
 def capture(suite_timeout_s: float = 1800.0) -> str | None:
-    """Run the full suite on TPU and persist BENCH_TPU_<ts>.json.
+    """Run the bench configs on TPU and persist BENCH_TPU_<ts>.json.
+
+    Each config runs in its OWN timed child (budget split across
+    configs): the tunnel can wedge mid-suite, and one hung config must
+    not forfeit the others' measurements — whatever completed is banked.
 
     Returns the artifact path on success (at least one result with a
     throughput recorded on a tpu backend), else None."""
     ts = time.strftime("%Y%m%dT%H%M%S")
-    results, err = _run_suite_child("all", suite_timeout_s)
-    backend = next((r for r in results if "backend" in r), {})
-    if backend.get("backend") != "tpu":
-        print("# capture: backend came up as %r, not persisting"
-              % backend.get("backend"), flush=True)
+    deadline = time.monotonic() + suite_timeout_s
+    results, errs = [], []
+    backend = {}
+    for which in _CONFIGS:
+        remaining = deadline - time.monotonic()
+        if remaining < 60.0:
+            errs.append("%s: skipped (budget exhausted)" % which)
+            continue
+        per = min(remaining, max(300.0, suite_timeout_s / len(_CONFIGS)))
+        res, err = _run_suite_child(which, per)
+        if err:
+            errs.append("%s: %s" % (which, err))
+        b = next((r for r in res if "backend" in r), None)
+        if b is not None and b.get("backend") != "tpu":
+            # tunnel fell off TPU mid-capture: stop burning budget, but
+            # KEEP the tpu results already banked (and exclude this
+            # config's off-TPU rows)
+            errs.append("%s: backend came up as %r"
+                        % (which, b.get("backend")))
+            break
+        if b is not None and not backend:
+            backend = b  # artifact metadata = FIRST tpu child's backend
+        for r in res:
+            if "config" in r:
+                if b is not None:
+                    # per-result health: a mid-capture Mosaic flap must
+                    # not misattribute health across configs
+                    r.setdefault("pallas_healthy", b.get("pallas_healthy"))
+                results.append(r)
+    err = "; ".join(errs) or None
+    if not backend:
+        print("# capture: no TPU backend in any child, not persisting "
+              "(%s)" % err, flush=True)
         return None
     benches = [r for r in results if "config" in r]
     ok = [r for r in benches if "throughput" in r]
@@ -127,6 +162,7 @@ def capture(suite_timeout_s: float = 1800.0) -> str | None:
         "commit": commit,
         "platform": "tpu",
         "device_kind": backend.get("device_kind"),
+        "pallas_healthy": backend.get("pallas_healthy"),
         "results": benches,
         "error": err,
     }
