@@ -94,7 +94,12 @@ def _parse_lines(text: str):
     return out
 
 
-_CONFIGS = ("gpt2", "ernie", "resnet50", "gpt2_long")
+try:
+    from train_bench import BENCH_CONFIGS as _CONFIGS
+except Exception as _e:  # keep the watcher alive even if train_bench breaks
+    print("# capture: BENCH_CONFIGS import failed (%s: %s), using stale "
+          "fallback list" % (type(_e).__name__, _e), flush=True)
+    _CONFIGS = ("gpt2", "ernie", "resnet50", "gpt2_long")
 
 
 def capture(suite_timeout_s: float = 1800.0) -> str | None:
@@ -110,12 +115,16 @@ def capture(suite_timeout_s: float = 1800.0) -> str | None:
     deadline = time.monotonic() + suite_timeout_s
     results, errs = [], []
     backend = {}
-    for which in _CONFIGS:
+    for i, which in enumerate(_CONFIGS):
         remaining = deadline - time.monotonic()
         if remaining < 60.0:
             errs.append("%s: skipped (budget exhausted)" % which)
             continue
-        per = min(remaining, max(300.0, suite_timeout_s / len(_CONFIGS)))
+        # split the REMAINING budget over the remaining configs: time a
+        # fast config doesn't use flows to the slow ones (gpt2_long's
+        # compile lost its measurement to a fixed per-config share in r5)
+        per = min(remaining,
+                  max(300.0, remaining / (len(_CONFIGS) - i)))
         res, err = _run_suite_child(which, per)
         if err:
             errs.append("%s: %s" % (which, err))

@@ -311,6 +311,11 @@ def bench_resnet50(on_tpu, conv_algo="direct"):
             "mfu": _mfu(flops, dt)}
 
 
+# single source of truth for the TPU capture tooling (tpu_capture.py,
+# tpu_window.py): a bench added here is automatically captured in-round
+BENCH_CONFIGS = ("gpt2", "ernie", "resnet50", "gpt2_long")
+
+
 def main():
     import jax
     on_tpu = jax.default_backend() == "tpu"
@@ -322,21 +327,39 @@ def main():
     if on_tpu:
         from paddle_tpu.ops.pallas_kernels import pallas_tpu_healthy
         pallas_healthy = pallas_tpu_healthy()
+    # flush: a capture child killed on timeout must still yield this line
+    # to the parent's stdout salvage, or the whole run is misread as
+    # "no TPU backend"
     print(json.dumps({"backend": jax.default_backend(),
                       "device_kind": jax.devices()[0].device_kind,
-                      "pallas_healthy": pallas_healthy}))
-    benches = {"gpt2": bench_gpt2, "ernie": bench_ernie,
-               "resnet50": bench_resnet50, "gpt2_long": bench_gpt2_long}
+                      "pallas_healthy": pallas_healthy}), flush=True)
+    benches = {name: globals()["bench_" + name] for name in BENCH_CONFIGS}
     for name, fn in benches.items():
         if which not in ("all", name):
             continue
         try:
-            print(json.dumps(fn(on_tpu)), flush=True)
             if name == "resnet50" and on_tpu:
-                # r4 conv-path comparison (VERDICT item 5): same config,
-                # matmul-routed convs — recorded next to the direct run
-                print(json.dumps(fn(on_tpu, conv_algo="im2col")),
-                      flush=True)
+                # r4 conv-path comparison (VERDICT item 5). The algo list
+                # is an env knob so a short tunnel window can measure just
+                # the missing path (the first capture banked only `direct`
+                # before its child's time share ran out)
+                algos = os.environ.get("PADDLE_TPU_RESNET_ALGOS",
+                                       "direct,im2col")
+                for algo in [a.strip() for a in algos.split(",")
+                             if a.strip()]:
+                    if algo not in ("direct", "im2col"):
+                        # a typo'd algo would silently run the direct
+                        # lowering but label the row with the bogus name,
+                        # corrupting the conv-path comparison
+                        print(json.dumps({
+                            "config": "resnet50_static_train",
+                            "error": "unknown conv_algo %r" % algo}),
+                            flush=True)
+                        continue
+                    print(json.dumps(fn(on_tpu, conv_algo=algo)),
+                          flush=True)
+            else:
+                print(json.dumps(fn(on_tpu)), flush=True)
         except Exception as e:
             print(json.dumps({"config": name,
                               "error": f"{type(e).__name__}: {e}"}),
