@@ -205,6 +205,7 @@ def main():
         gpt2 = next((r for r in cap.get("results", [])
                      if isinstance(r, dict)
                      and str(r.get("config", "")).startswith("gpt2")
+                     and "long" not in str(r.get("config", ""))
                      and "throughput" in r), None)
         out["last_tpu_capture"] = {"file": name, **cap}
         if gpt2 is not None:

@@ -68,13 +68,14 @@ def run_timed_child(cmd, timeout_s: float, env=None):
     return out.stdout, out.stderr[-300:], err
 
 
-def _run_suite_child(which: str, timeout_s: float):
+def _run_suite_child(which: str, timeout_s: float, env=None):
     """Run `python benchmarks/train_bench.py <which>` in a timed child,
-    returning (list-of-parsed-json-lines, err)."""
+    returning (list-of-parsed-json-lines, err). Shared with
+    tpu_window.py (which passes per-child env knobs)."""
     stdout, stderr_tail, err = run_timed_child(
         [sys.executable,
          os.path.join(_ROOT, "benchmarks", "train_bench.py"), which],
-        timeout_s)
+        timeout_s, env=env)
     lines = _parse_lines(stdout)
     if not lines:
         err = "%s; stderr tail: %s" % (err or "no JSON in child stdout",

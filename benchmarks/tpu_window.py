@@ -36,18 +36,13 @@ import time
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(_ROOT, "benchmarks"))
 
-from tpu_capture import _parse_lines, probe_tpu, run_timed_child  # noqa: E402
+from tpu_capture import _run_suite_child, probe_tpu  # noqa: E402
 
 
 def _bench_child(which: str, timeout_s: float, env=None):
-    stdout, stderr_tail, err = run_timed_child(
-        [sys.executable, os.path.join(_ROOT, "benchmarks", "train_bench.py"),
-         which], timeout_s, env=env)
-    lines = _parse_lines(stdout)
+    lines, err = _run_suite_child(which, timeout_s, env=env)
     backend = next((r for r in lines if "backend" in r), None)
     results = [r for r in lines if "config" in r]
-    if not results and err:
-        err = "%s; stderr tail: %s" % (err, stderr_tail.replace("\n", " "))
     return backend, results, err
 
 

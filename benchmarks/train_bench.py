@@ -323,16 +323,19 @@ def main():
     # pallas_healthy explains a capture whose attn_paths.flash == 0: some
     # tunnel environments serve XLA but 500 every Mosaic remote-compile,
     # and the framework then degrades to its XLA attention/optimizer paths
-    pallas_healthy = None
+    pallas_healthy = pallas_prng = None
     if on_tpu:
-        from paddle_tpu.ops.pallas_kernels import pallas_tpu_healthy
+        from paddle_tpu.ops.pallas_kernels import (pallas_prng_healthy,
+                                                   pallas_tpu_healthy)
         pallas_healthy = pallas_tpu_healthy()
+        pallas_prng = pallas_prng_healthy()
     # flush: a capture child killed on timeout must still yield this line
     # to the parent's stdout salvage, or the whole run is misread as
     # "no TPU backend"
     print(json.dumps({"backend": jax.default_backend(),
                       "device_kind": jax.devices()[0].device_kind,
-                      "pallas_healthy": pallas_healthy}), flush=True)
+                      "pallas_healthy": pallas_healthy,
+                      "pallas_prng_healthy": pallas_prng}), flush=True)
     benches = {name: globals()["bench_" + name] for name in BENCH_CONFIGS}
     for name, fn in benches.items():
         if which not in ("all", name):

@@ -121,7 +121,7 @@ class Predictor:
         if sig in self._exec_cache:
             return self._exec_cache[sig]
         from ..ops.pallas_kernels import preprobe_pallas_health
-        preprobe_pallas_health()
+        preprobe_pallas_health(needs_prng=False)  # eval: no dropout PRNG
         prog = self._program
         bf16 = self._config._bf16
         cap_names = sorted(self._captures)

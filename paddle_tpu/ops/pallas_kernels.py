@@ -39,25 +39,60 @@ _NEG_INF = -1e30
 # attention uses for its l/m residuals).
 _LANES = 128
 
-# One-shot Mosaic health probe result (None = not probed yet). Some TPU
+# One-shot Mosaic health probe results (None = not probed yet). Some TPU
 # environments (the axon tunnel's remote_compile helper, observed round 5)
-# serve XLA compiles fine but return HTTP 500 for every Mosaic kernel; a
+# serve XLA compiles fine but return HTTP 500 for Mosaic kernels; a
 # single unprotected pallas_call then kills the whole train-step compile.
 # Every TPU Pallas entry point consults pallas_tpu_healthy() so the
 # framework degrades to its XLA paths instead of crashing.
+#
+# Health is TIERED: the base tier probes the plain flash kernels
+# (fwd + dq + dk/dv), the PRNG tier additionally probes the in-kernel
+# hardware-PRNG dropout variant (pltpu.prng_seed / prng_random_bits). A
+# backend whose Mosaic serves ordinary kernels but rejects the PRNG ops
+# (they are newer and legalize separately) must only cost the dropout
+# kernels — not the whole flash/fused-optimizer family.
 _PALLAS_TPU_HEALTHY = None
+_PALLAS_PRNG_HEALTHY = None
+
+
+def _run_probe(vg, q):
+    """Run a value_and_grad probe at a clean moment: an ordinary jit when
+    no ambient trace is active (make_train_step and friends pre-probe
+    before tracing starts), else escape the trace and evaluate eagerly —
+    each pallas_call still round-trips the Mosaic compiler."""
+    try:
+        from jax.core import trace_ctx
+        clean = type(trace_ctx.trace).__name__ == "EvalTrace"
+    except Exception:
+        clean = False
+    if clean:
+        (val, out), grad = jax.jit(vg)(q)
+    else:
+        with jax.ensure_compile_time_eval():
+            (val, out), grad = vg(q)
+    return val, out, grad
+
+
+def _probe_q():
+    rs = np.random.RandomState(0)
+    return jnp.asarray(rs.randn(1, 1, 128, 8), jnp.float32)
 
 
 def pallas_tpu_healthy():
-    """True iff the real flash-attention kernels (fwd + dq + dk/dv, with
-    the in-kernel PRNG dropout variant) compile AND run on the TPU
+    """True iff the real plain flash-attention kernels (fwd + dq + dk/dv
+    via the custom vjp, no in-kernel PRNG) compile AND run on the TPU
     backend at minimal shapes (probed once per process; result cached).
+    Probing the REAL kernels, not a trivial add: a tunnel whose Mosaic
+    service fails only on non-trivial kernels must still read unhealthy,
+    or the first train step dies anyway.
 
     Operator override: env PADDLE_TPU_PALLAS_HEALTH=0|1 skips the probe
     and forces the answer (0 = never use Pallas on TPU, 1 = trust it).
     Only meaningful when the default backend is TPU — interpret-mode
     Pallas (CPU tests) never touches the Mosaic compiler and is not
-    gated by this."""
+    gated by this. Kernels that use the in-kernel PRNG additionally
+    consult pallas_prng_healthy()."""
     global _PALLAS_TPU_HEALTHY
     if _PALLAS_TPU_HEALTHY is not None:
         return _PALLAS_TPU_HEALTHY
@@ -67,46 +102,16 @@ def pallas_tpu_healthy():
         _PALLAS_TPU_HEALTHY = env == "1"
         return _PALLAS_TPU_HEALTHY
     try:
-        # probe with the REAL flash kernels at minimal shapes (fwd + dq +
-        # dk/dv via the custom vjp), not a trivial add: a tunnel whose
-        # Mosaic service fails only on non-trivial kernels must still
-        # read as unhealthy, or the first train step dies anyway. The
-        # dropout variant is probed (dropout_p>0 + seed) because it is a
-        # superset: it additionally exercises the in-kernel PRNG ops
-        # (pltpu.prng_seed / prng_random_bits) that training with
-        # attention dropout compiles.
-        rs = np.random.RandomState(0)
-        q = jnp.asarray(rs.randn(1, 1, 128, 8), jnp.float32)
-        seed = jnp.zeros((1,), jnp.int32)
-
-        def f(q):
-            # dp=0 term is VALUE-checked against the dense oracle below
-            # (a miscompiling-but-finite backend must read unhealthy);
-            # the dp>0 term additionally compiles the in-kernel PRNG
-            # variant, checkable only for finiteness
-            return (_flash(q, q, q, None, True, False, 0.0),
-                    _flash(q, q, q, seed, True, False, 0.1).sum())
+        q = _probe_q()
 
         def run(q):
-            out, dsum = f(q)
-            return dsum + out.sum(), out
+            # VALUE-checked against the dense oracle below: a
+            # miscompiling-but-finite backend must read unhealthy
+            out = _flash(q, q, q, None, True, False, 0.0)
+            return out.sum(), out
 
-        vg = jax.value_and_grad(run, has_aux=True)
-        try:
-            from jax.core import trace_ctx
-            clean = type(trace_ctx.trace).__name__ == "EvalTrace"
-        except Exception:
-            clean = False
-        if clean:
-            # normal case: make_train_step and friends pre-probe before
-            # any tracing starts, so the probe is an ordinary jit compile
-            (val, out), grad = jax.jit(vg)(q)
-        else:
-            # first consult happened INSIDE an ambient trace (eager-op
-            # jit, a user's own jit): escape it and evaluate eagerly —
-            # each pallas_call still round-trips the Mosaic compiler
-            with jax.ensure_compile_time_eval():
-                (val, out), grad = vg(q)
+        val, out, grad = _run_probe(jax.value_and_grad(run, has_aux=True),
+                                    q)
         want = _xla_attention(q, q, q, True)
         _PALLAS_TPU_HEALTHY = bool(
             np.isfinite(np.asarray(val))
@@ -127,6 +132,58 @@ def pallas_tpu_healthy():
             (type(e).__name__, str(e)[:200]))
         _PALLAS_TPU_HEALTHY = False
     return _PALLAS_TPU_HEALTHY
+
+
+def pallas_prng_healthy():
+    """True iff the base tier is healthy AND the in-kernel-PRNG flash
+    dropout variant (pltpu.prng_seed / prng_random_bits) compiles and
+    produces finite values+grads (its stochastic output has no dense
+    oracle). Consulted by the kernels that generate dropout bits on-chip
+    (flash attention with dropout_p>0, the fused dropout-LN chain); when
+    only this tier is broken those fall back to the XLA dropout paths
+    while plain flash / fused AdamW keep their Pallas kernels.
+
+    Override: env PADDLE_TPU_PALLAS_PRNG_HEALTH=0|1 forces just this
+    tier (PADDLE_TPU_PALLAS_HEALTH=0 still forces it False via the base
+    tier)."""
+    global _PALLAS_PRNG_HEALTHY
+    if _PALLAS_PRNG_HEALTHY is not None:
+        return _PALLAS_PRNG_HEALTHY
+    if not pallas_tpu_healthy():
+        _PALLAS_PRNG_HEALTHY = False
+        return _PALLAS_PRNG_HEALTHY
+    import os
+    env = os.environ.get("PADDLE_TPU_PALLAS_PRNG_HEALTH", "")
+    if env in ("0", "1"):
+        _PALLAS_PRNG_HEALTHY = env == "1"
+        return _PALLAS_PRNG_HEALTHY
+    try:
+        q = _probe_q()
+        seed = jnp.zeros((1,), jnp.int32)
+
+        def run(q):
+            out = _flash(q, q, q, seed, True, False, 0.1)
+            return out.sum(), out
+
+        val, out, grad = _run_probe(jax.value_and_grad(run, has_aux=True),
+                                    q)
+        _PALLAS_PRNG_HEALTHY = bool(
+            np.isfinite(np.asarray(val))
+            and np.isfinite(np.asarray(grad)).all()
+            and np.isfinite(np.asarray(out)).all())
+        if not _PALLAS_PRNG_HEALTHY:
+            import warnings
+            warnings.warn(
+                "Pallas PRNG probe produced non-finite values; in-kernel "
+                "dropout falls back to XLA paths for this process")
+    except Exception as e:
+        import warnings
+        warnings.warn(
+            "Pallas PRNG probe failed (%s: %s); in-kernel dropout falls "
+            "back to XLA paths (plain Pallas kernels stay on)" %
+            (type(e).__name__, str(e)[:200]))
+        _PALLAS_PRNG_HEALTHY = False
+    return _PALLAS_PRNG_HEALTHY
 
 # Index-map constant: this framework runs with jax_enable_x64=True (int64
 # tensors are first-class, like the reference), under which a bare `0` in a
@@ -828,7 +885,13 @@ def fused_bias_dropout_residual_ln_arrays(x, residual, bias, gamma, beta,
     return y.reshape(shape), z.reshape(shape)
 
 
-def fused_ln_shapes_ok(x):
+def fused_ln_shapes_ok(x, dropout_p=None, training=True):
+    """Gate for the fused dropout-LN chain. On TPU an ACTIVE dropout
+    (training and p>0 — or unknown: dropout_p=None is conservative)
+    additionally requires the PRNG health tier, because the kernel then
+    generates its keep-mask from the on-chip PRNG; a PRNG-only Mosaic
+    regression must route those calls to the composed XLA fallback while
+    p=0/eval calls may still fuse."""
     from ..framework.flags import flag
     if not flag("use_fused_dropout_ln"):
         return False
@@ -838,6 +901,9 @@ def fused_ln_shapes_ok(x):
         n *= s
     if jax.default_backend() != "tpu":
         return n * hdim <= 64 * 1024  # keep interpret mode cheap
+    uses_prng = dropout_p is None or (training and float(dropout_p) > 0.0)
+    if uses_prng and not pallas_prng_healthy():
+        return False
     return (pallas_tpu_healthy() and hdim % 128 == 0 and hdim <= 16384
             and _fbdrln_block_n(n, hdim) is not None)
 
@@ -948,13 +1014,21 @@ def attention_path_counts(reset=False):
     return out
 
 
-def preprobe_pallas_health():
-    """Run the Mosaic health probe now IF the backend is TPU — called by
+def preprobe_pallas_health(needs_prng=True):
+    """Run the Mosaic health probes now IF the backend is TPU — called by
     compile entry points (make_train_step, static executor, predictor) at
     a clean, untraced moment so the gates consulted during their traces
-    read a cached verdict instead of probing mid-trace. No-op elsewhere."""
+    read cached verdicts instead of probing mid-trace. No-op elsewhere.
+
+    needs_prng=False (inference entry points) skips the PRNG-tier probe:
+    eval-time traces never consult it (dropout_p=0 / training=False), and
+    the extra flash-dropout compile is a whole Mosaic round trip on
+    tunnel backends."""
     if jax.default_backend() == "tpu":
-        pallas_tpu_healthy()
+        if needs_prng:
+            pallas_prng_healthy()  # probes the base tier first internally
+        else:
+            pallas_tpu_healthy()
 
 
 def flash_attention_or_none(query, key, value, attn_mask, is_causal,
@@ -979,6 +1053,11 @@ def flash_attention_or_none(query, key, value, attn_mask, is_causal,
     backend = jax.default_backend()
     interpret = backend != "tpu"
     if not interpret and not pallas_tpu_healthy():
+        return None
+    if dropout_p > 0.0 and not interpret and not pallas_prng_healthy():
+        # the dropout kernels seed the on-chip PRNG; when only that
+        # Mosaic tier is broken, dropout attention takes the XLA path
+        # while dropout-free flash stays on
         return None
     if not _shapes_ok(q, k, bool(is_causal), interpret):
         return None

@@ -17,10 +17,14 @@ from paddle_tpu.ops import pallas_kernels as pk
 @pytest.fixture(autouse=True)
 def _reset_probe_cache(monkeypatch):
     monkeypatch.delenv("PADDLE_TPU_PALLAS_HEALTH", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_PALLAS_PRNG_HEALTH", raising=False)
     old = pk._PALLAS_TPU_HEALTHY
+    old_prng = pk._PALLAS_PRNG_HEALTHY
     pk._PALLAS_TPU_HEALTHY = None
+    pk._PALLAS_PRNG_HEALTHY = None
     yield
     pk._PALLAS_TPU_HEALTHY = old
+    pk._PALLAS_PRNG_HEALTHY = old_prng
 
 
 def test_env_override(monkeypatch):
@@ -67,6 +71,69 @@ def test_unhealthy_gates_flash_attention():
         assert pk.flash_attention_or_none(q, q, q, None, True) is None
     # the gated call must not have counted a flash trace
     assert pk.attention_path_counts()["flash"] == 0
+
+
+def test_prng_env_override_and_base_dependency():
+    # base tier broken -> prng tier is False regardless of its own env
+    monkey_env = {"PADDLE_TPU_PALLAS_HEALTH": "0",
+                  "PADDLE_TPU_PALLAS_PRNG_HEALTH": "1"}
+    with mock.patch.dict("os.environ", monkey_env):
+        assert pk.pallas_prng_healthy() is False
+    pk._PALLAS_TPU_HEALTHY = None
+    pk._PALLAS_PRNG_HEALTHY = None
+    # base forced on, prng forced off: the split the axon tunnel needs
+    monkey_env = {"PADDLE_TPU_PALLAS_HEALTH": "1",
+                  "PADDLE_TPU_PALLAS_PRNG_HEALTH": "0"}
+    with mock.patch.dict("os.environ", monkey_env):
+        assert pk.pallas_tpu_healthy() is True
+        assert pk.pallas_prng_healthy() is False
+
+
+def test_prng_probe_failure_keeps_base_kernels():
+    """A Mosaic service that compiles plain kernels but 500s the PRNG ops
+    (pltpu.prng_seed is newer and legalizes separately) must cost only the
+    dropout kernels: plain flash stays on, dropout attention gates off."""
+    pk._PALLAS_TPU_HEALTHY = True  # base tier already probed healthy
+
+    with mock.patch.object(pk, "_flash",
+                           side_effect=RuntimeError("HTTP 500 prng")):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert pk.pallas_prng_healthy() is False
+        assert any("Pallas PRNG probe failed" in str(x.message) for x in w)
+    assert pk.pallas_tpu_healthy() is True  # base verdict untouched
+
+    rs = np.random.RandomState(0)
+    q = paddle.to_tensor(rs.randn(1, 2, 128, 64).astype(np.float32))
+    key = pk.jax.random.PRNGKey(0)
+    with mock.patch.object(pk.jax, "default_backend",
+                           return_value="tpu"):
+        # dropout path gated off by the prng tier...
+        assert pk.flash_attention_or_none(
+            q, q, q, None, True, dropout_p=0.1, rng=key) is None
+        # ...while the plain flash gate still passes the health checks
+        # (deeper shape gates may still apply; health must not be the
+        # blocker, so assert via the gate pieces)
+        assert pk.pallas_tpu_healthy() is True
+
+
+def test_fused_ln_gate_consults_prng_tier():
+    pk._PALLAS_TPU_HEALTHY = True
+    pk._PALLAS_PRNG_HEALTHY = False
+    x = np.zeros((256, 256), np.float32)
+    paddle.set_flags({"FLAGS_use_fused_dropout_ln": True})
+    try:
+        with mock.patch.object(pk.jax, "default_backend",
+                               return_value="tpu"):
+            # active dropout (and the conservative no-info default) need
+            # the PRNG tier
+            assert not pk.fused_ln_shapes_ok(x, 0.1, True)
+            assert not pk.fused_ln_shapes_ok(x)
+            # p=0 / eval-mode calls never touch the PRNG: base tier rules
+            assert pk.fused_ln_shapes_ok(x, 0.0, True)
+            assert pk.fused_ln_shapes_ok(x, 0.1, False)
+    finally:
+        paddle.set_flags({"FLAGS_use_fused_dropout_ln": False})
 
 
 def test_unhealthy_gates_fused_adamw_and_ln():
