@@ -68,14 +68,16 @@ def run_timed_child(cmd, timeout_s: float, env=None):
     return out.stdout, out.stderr[-300:], err
 
 
-def _run_suite_child(which: str, timeout_s: float, env=None):
-    """Run `python benchmarks/train_bench.py <which>` in a timed child,
+def _run_suite_child(which: str, timeout_s: float, env=None,
+                     script="train_bench.py"):
+    """Run `python benchmarks/<script> [which]` in a timed child,
     returning (list-of-parsed-json-lines, err). Shared with
-    tpu_window.py (which passes per-child env knobs)."""
-    stdout, stderr_tail, err = run_timed_child(
-        [sys.executable,
-         os.path.join(_ROOT, "benchmarks", "train_bench.py"), which],
-        timeout_s, env=env)
+    tpu_window.py (per-child env knobs; the micro-bench passes a
+    different script with no argument)."""
+    cmd = [sys.executable, os.path.join(_ROOT, "benchmarks", script)]
+    if which:
+        cmd.append(which)
+    stdout, stderr_tail, err = run_timed_child(cmd, timeout_s, env=env)
     lines = _parse_lines(stdout)
     if not lines:
         err = "%s; stderr tail: %s" % (err or "no JSON in child stdout",

@@ -36,7 +36,8 @@ import time
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(_ROOT, "benchmarks"))
 
-from tpu_capture import _run_suite_child, probe_tpu  # noqa: E402
+from tpu_capture import (_parse_lines, _run_suite_child,  # noqa: E402
+                         probe_tpu, run_timed_child)
 
 
 def _bench_child(which: str, timeout_s: float, env=None):
@@ -44,6 +45,17 @@ def _bench_child(which: str, timeout_s: float, env=None):
     backend = next((r for r in lines if "backend" in r), None)
     results = [r for r in lines if "config" in r]
     return backend, results, err
+
+
+def _micro_bench_child(timeout_s: float):
+    """Last-priority: re-measure the Pallas-vs-XLA micro-benches
+    (fused_kernels_bench.py). Mostly interesting when the tiered health
+    probe has re-enabled flash; rows land under 'kernel' keys. The
+    backend row is returned too so an off-TPU run is detectable."""
+    lines, err = _run_suite_child(None, timeout_s,
+                                  script="fused_kernels_bench.py")
+    backend = next((r for r in lines if "backend" in r), None)
+    return backend, [r for r in lines if "kernel" in r], err
 
 
 def run_window(gpt2_batches, deadline_s: float = 2700.0) -> str | None:
@@ -57,6 +69,7 @@ def run_window(gpt2_batches, deadline_s: float = 2700.0) -> str | None:
     plan.append(("gpt2_long", 1200.0, None, "gpt2_long"))
 
     backend, results, errs = {}, [], []
+    fell_off = False
     for which, budget, env, label in plan:
         remaining = deadline - time.monotonic()
         if remaining < 120.0:
@@ -66,8 +79,10 @@ def run_window(gpt2_batches, deadline_s: float = 2700.0) -> str | None:
         if err:
             errs.append("%s: %s" % (label, err))
         if b is not None and b.get("backend") != "tpu":
+            # tunnel fell off TPU: stop burning budget; keep what's banked
             errs.append("%s: backend came up as %r" % (label,
                                                        b.get("backend")))
+            fell_off = True
             break
         if b is not None and not backend:
             backend = b
@@ -87,6 +102,18 @@ def run_window(gpt2_batches, deadline_s: float = 2700.0) -> str | None:
         print("# window: no successful bench (%s)" % "; ".join(errs),
               flush=True)
         return None
+    micro = []
+    remaining = deadline - time.monotonic()
+    if not fell_off and remaining > 300.0:
+        mb, micro, merr = _micro_bench_child(min(remaining, 900.0))
+        if merr:
+            errs.append("micro: %s" % merr)
+        if mb is not None and mb.get("backend") != "tpu":
+            # off-TPU interpret-mode timings are meaningless; drop them
+            errs.append("micro: backend came up as %r (rows dropped)"
+                        % mb.get("backend"))
+            micro = []
+        print("# window: micro-bench -> %d rows" % len(micro), flush=True)
     # best gpt2 first: bench.py promotes the first gpt2* row it finds
     gpt2s = sorted((r for r in ok
                     if str(r.get("config", "")).startswith("gpt2")
@@ -110,6 +137,7 @@ def run_window(gpt2_batches, deadline_s: float = 2700.0) -> str | None:
         "note": "priority window plan (tpu_window.py): gpt2 batch sweep + "
                 "resnet im2col + long-context; best gpt2 ordered first",
         "results": gpt2s + rest,
+        "micro_kernels": micro or None,
         "error": "; ".join(errs) or None,
     }
     path = os.path.join(_ROOT, "BENCH_TPU_%s.json" % ts)

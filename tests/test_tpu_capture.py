@@ -1,0 +1,220 @@
+"""The in-round TPU capture tooling decides the round's headline artifact
+(bench.py promotes the newest BENCH_TPU_<ts>.json when the end-of-round
+live probe fails), so its banking/ordering logic is tested with mocked
+bench children — no TPU needed.
+
+Covers: capture() budget redistribution + off-TPU break semantics,
+tpu_window's best-gpt2-first ordering with gpt2_long excluded from the
+headline slot, latest_capture()'s staleness/malformed-file rules, and
+bench.py's promotion predicate skipping long-context rows."""
+import json
+import os
+import sys
+from unittest import mock
+
+_BENCH_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks")
+if _BENCH_DIR not in sys.path:
+    sys.path.insert(0, _BENCH_DIR)
+
+import tpu_capture
+import tpu_window
+
+
+def _chdir_artifacts(monkeypatch, tmp_path):
+    """Artifacts land in _ROOT; point both modules' _ROOT at tmp_path."""
+    monkeypatch.setattr(tpu_capture, "_ROOT", str(tmp_path))
+    monkeypatch.setattr(tpu_window, "_ROOT", str(tmp_path))
+
+
+def test_capture_banks_tpu_results_and_breaks_off_tpu(monkeypatch,
+                                                      tmp_path):
+    _chdir_artifacts(monkeypatch, tmp_path)
+    calls = []
+
+    def fake_child(which, timeout_s, env=None):
+        calls.append(which)
+        if which == "gpt2":
+            return [{"backend": "tpu", "device_kind": "TPU v5 lite",
+                     "pallas_healthy": True},
+                    {"config": "gpt2_small_train", "throughput": 50000.0}
+                    ], None
+        if which == "ernie":
+            # tunnel fell off TPU mid-suite
+            return [{"backend": "cpu", "device_kind": "cpu",
+                     "pallas_healthy": None},
+                    {"config": "bert_tiny_amp_o2_train",
+                     "throughput": 10.0}], None
+        raise AssertionError("must break before " + which)
+
+    monkeypatch.setattr(tpu_capture, "_run_suite_child", fake_child)
+    path = tpu_capture.capture(suite_timeout_s=1800.0)
+    assert path is not None
+    art = json.load(open(path))
+    # gpt2's TPU result banked; the off-TPU config's rows excluded; the
+    # remaining configs never ran (break, not continue)
+    assert [r["config"] for r in art["results"]] == ["gpt2_small_train"]
+    assert calls == ["gpt2", "ernie"]
+    assert art["platform"] == "tpu"
+    assert art["results"][0]["pallas_healthy"] is True
+    assert "backend came up as" in art["error"]
+
+
+def test_capture_no_tpu_returns_none(monkeypatch, tmp_path):
+    _chdir_artifacts(monkeypatch, tmp_path)
+    monkeypatch.setattr(
+        tpu_capture, "_run_suite_child",
+        lambda which, t, env=None: ([], "child timed out"))
+    assert tpu_capture.capture(suite_timeout_s=1800.0) is None
+    assert not [n for n in os.listdir(tmp_path)
+                if n.startswith("BENCH_TPU_")]
+
+
+def test_capture_budget_flows_to_later_configs(monkeypatch, tmp_path):
+    """Time a fast config doesn't use must flow to the slow ones: with a
+    2000s budget and instant children, the LAST config's share must be
+    near the whole remaining budget, not a fixed quarter."""
+    _chdir_artifacts(monkeypatch, tmp_path)
+    shares = []
+
+    def fake_child(which, timeout_s, env=None):
+        shares.append(timeout_s)
+        return [{"backend": "tpu", "device_kind": "TPU v5 lite",
+                 "pallas_healthy": False},
+                {"config": which + "_train", "throughput": 1.0}], None
+
+    monkeypatch.setattr(tpu_capture, "_run_suite_child", fake_child)
+    assert tpu_capture.capture(suite_timeout_s=2000.0) is not None
+    assert len(shares) == len(tpu_capture._CONFIGS)
+    # first share: remaining/4; last share: everything left (~2000s)
+    assert shares[0] <= 2000.0 / len(tpu_capture._CONFIGS) + 1.0
+    assert shares[-1] > 1900.0
+
+
+def test_window_orders_best_gpt2_first_and_excludes_long(monkeypatch,
+                                                         tmp_path):
+    _chdir_artifacts(monkeypatch, tmp_path)
+
+    def fake_child(which, timeout_s, env=None):
+        b = {"backend": "tpu", "device_kind": "TPU v5 lite",
+             "pallas_healthy": False}
+        if which == "gpt2":
+            batch = int(env["PADDLE_TPU_GPT2_BATCH"])
+            thr = {24: 60000.0, 32: 64000.0}[batch]
+            return [b, {"config": "gpt2_small_train", "batch": batch,
+                        "throughput": thr}], None
+        if which == "resnet50":
+            assert env == {"PADDLE_TPU_RESNET_ALGOS": "im2col"}
+            return [b, {"config": "resnet50_static_train",
+                        "conv_algo": "im2col", "throughput": 200.0}], None
+        if which == "gpt2_long":
+            return [b, {"config": "gpt2_long8k_train",
+                        "throughput": 99999.0}], None
+        raise AssertionError(which)
+
+    monkeypatch.setattr(tpu_window, "_run_suite_child", fake_child)
+    monkeypatch.setattr(
+        tpu_window, "_micro_bench_child",
+        lambda t: ({"backend": "tpu"},
+                   [{"kernel": "flash_attention", "speedup": 1.0}], None))
+    path = tpu_window.run_window([24, 32], deadline_s=2700.0)
+    assert path is not None
+    art = json.load(open(path))
+    assert art["micro_kernels"][0]["kernel"] == "flash_attention"
+    configs = [(r["config"], r.get("batch")) for r in art["results"]]
+    # best sweep batch first (B=32 at 64k); gpt2_long NOT in the headline
+    # slot despite its higher number — bench.py promotes results[0]
+    assert configs[0] == ("gpt2_small_train", 32)
+    assert configs[1] == ("gpt2_small_train", 24)
+    assert set(c for c, _ in configs[2:]) == {"resnet50_static_train",
+                                              "gpt2_long8k_train"}
+
+
+def test_window_all_sweeps_failed_long_not_promotable(monkeypatch,
+                                                      tmp_path):
+    """If every sweep child dies and only gpt2_long lands, the artifact
+    must not let bench.py promote the B=1 long number as the gpt2_small
+    headline — the promotion predicate skips configs containing 'long'."""
+    _chdir_artifacts(monkeypatch, tmp_path)
+
+    def fake_child(which, timeout_s, env=None):
+        b = {"backend": "tpu", "device_kind": "TPU v5 lite",
+             "pallas_healthy": False}
+        if which == "gpt2":
+            return [b], "child timed out (salvaged stdout)"
+        if which == "resnet50":
+            return [b], "child timed out (salvaged stdout)"
+        return [b, {"config": "gpt2_long8k_train",
+                    "throughput": 7000.0}], None
+
+    monkeypatch.setattr(tpu_window, "_run_suite_child", fake_child)
+    monkeypatch.setattr(tpu_window, "_micro_bench_child",
+                        lambda t: (None, [], "skipped in test"))
+    path = tpu_window.run_window([24, 32], deadline_s=2700.0)
+    art = json.load(open(path))
+    # bench.py's promotion predicate (mirrored here) must find nothing
+    gpt2 = next((r for r in art["results"]
+                 if str(r.get("config", "")).startswith("gpt2")
+                 and "long" not in str(r.get("config", ""))
+                 and "throughput" in r), None)
+    assert gpt2 is None
+
+
+def test_window_micro_skipped_after_fell_off_and_offtpu_rows_dropped(
+        monkeypatch, tmp_path):
+    """(a) once the tunnel falls off TPU mid-plan, the micro-bench must
+    not burn more budget; (b) an off-TPU micro child's interpret-mode
+    timings must never be banked in a platform=tpu artifact."""
+    _chdir_artifacts(monkeypatch, tmp_path)
+    tpu_b = {"backend": "tpu", "device_kind": "TPU v5 lite",
+             "pallas_healthy": True}
+
+    def fell_off_child(which, timeout_s, env=None):
+        if which == "gpt2":
+            return [tpu_b, {"config": "gpt2_small_train",
+                            "throughput": 1.0}], None
+        return [{"backend": "cpu"}], None
+
+    micro_calls = []
+    monkeypatch.setattr(tpu_window, "_run_suite_child", fell_off_child)
+    monkeypatch.setattr(
+        tpu_window, "_micro_bench_child",
+        lambda t: micro_calls.append(t) or (tpu_b, [], None))
+    path = tpu_window.run_window([24], deadline_s=2700.0)
+    art = json.load(open(path))
+    assert micro_calls == []  # (a): never invoked after the break
+    assert art["micro_kernels"] is None
+
+    def healthy_child(which, timeout_s, env=None):
+        return [tpu_b, {"config": "gpt2_small_train",
+                        "throughput": 1.0}], None
+
+    monkeypatch.setattr(tpu_window, "_run_suite_child", healthy_child)
+    monkeypatch.setattr(
+        tpu_window, "_micro_bench_child",
+        lambda t: ({"backend": "cpu"},
+                   [{"kernel": "flash_attention", "speedup": 9.0}], None))
+    path = tpu_window.run_window([24], deadline_s=2700.0)
+    art = json.load(open(path))
+    assert art["micro_kernels"] is None  # (b): off-TPU rows dropped
+    assert "micro: backend came up as 'cpu'" in art["error"]
+
+
+def test_latest_capture_staleness_and_malformed(monkeypatch, tmp_path):
+    _chdir_artifacts(monkeypatch, tmp_path)
+    import time as _time
+    now = _time.time()
+    # malformed: half-written json
+    (tmp_path / "BENCH_TPU_20260701T000001.json").write_text('{"timest')
+    # stale: older than the max age
+    json.dump({"timestamp": "old", "unix_time": now - 15 * 3600,
+               "results": []},
+              open(tmp_path / "BENCH_TPU_20260701T000002.json", "w"))
+    # fresh + well-formed but OLDER filename than the malformed one above
+    json.dump({"timestamp": "fresh", "unix_time": now - 60,
+               "results": [{"config": "gpt2_small_train",
+                            "throughput": 1.0}]},
+              open(tmp_path / "BENCH_TPU_20260630T000003.json", "w"))
+    name, cap = tpu_capture.latest_capture()
+    assert name == "BENCH_TPU_20260630T000003.json"
+    assert cap["timestamp"] == "fresh"
