@@ -82,6 +82,10 @@ class _CompiledProgram:
             # identity lookup (Tensor __eq__ is elementwise)
             self.param_idx = [next(i for i, t in enumerate(self.cap_tensors)
                                    if t is p) for p in self.params]
+            # static split used every step: params ride the donated jit
+            # argument, the rest stay un-donated captures
+            self.rest_idx = [i for i in range(len(self.cap_tensors))
+                             if i not in set(self.param_idx)]
             self.accs = [opt._get_accumulators(p) for p in self.params]
             # ASP (incubate/asp): params pruned with with_mask under a
             # decorated optimizer get their mask re-applied INSIDE the
@@ -94,8 +98,15 @@ class _CompiledProgram:
                 and getattr(p, "_asp_mask", None) is not None)
         from ..ops.pallas_kernels import preprobe_pallas_health
         preprobe_pallas_health()
+        # train step: params (2) and accumulators (3) are donated — they
+        # are replaced wholesale by run() after the call, so XLA may
+        # update them in place instead of allocating fresh output buffers
+        # (the eager engine's make_train_step donates the same way;
+        # reference analogue: share_tensor_buffer_op_handle's in-place
+        # reuse). Params are passed as their OWN argument, split out of
+        # cap_arrays, so donation never aliases the non-donated captures.
         self._jitted = jax.jit(self._run) if not train else \
-            jax.jit(self._run_train)
+            jax.jit(self._run_train, donate_argnums=(2, 3))
 
     # -- pure interpreters ---------------------------------------------------
     def _forward_env(self, feed_arrays, cap_arrays, rng_arrays=()):
@@ -136,19 +147,21 @@ class _CompiledProgram:
         env = self._forward_env(feed_arrays, cap_arrays, rng_arrays)
         return self._fetch(env), [env[n] for _, n in self.buffer_updates]
 
-    def _run_train(self, feed_arrays, cap_arrays, acc_arrays, t, lr,
-                   rng_arrays, mask_arrays=()):
+    def _run_train(self, feed_arrays, cap_rest, param_arrays, acc_arrays,
+                   t, lr, rng_arrays, mask_arrays=()):
         opt = self.optimizer
 
         def loss_of(param_arrays):
-            caps = list(cap_arrays)
+            caps = [None] * len(self.cap_tensors)
             for i, a in zip(self.param_idx, param_arrays):
+                caps[i] = a
+            for i, a in zip(self.rest_idx, cap_rest):
                 caps[i] = a
             env = self._forward_env(feed_arrays, caps, rng_arrays)
             loss = env[self.loss_name]
             return loss.reshape(()), env
 
-        params0 = [cap_arrays[i] for i in self.param_idx]
+        params0 = list(param_arrays)
         (loss, env), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params0)
 
@@ -198,8 +211,13 @@ class _CompiledProgram:
         acc_arrays = [[a[n] for n in acc_names] for a in self.accs]
         opt._step_count += 1
         mask_arrays = tuple(self.params[i]._asp_mask for i in self.asp_idx)
+        # split params out of the captures: they ride the donated argument
+        # (the jit donates argnums 2/3) and must not also appear in the
+        # non-donated cap_rest, or XLA would see aliased donated buffers
+        cap_rest = [cap_arrays[i] for i in self.rest_idx]
+        param_arrays = [cap_arrays[i] for i in self.param_idx]
         fetches, new_params, new_accs, buf_vals = self._jitted(
-            feed_arrays, cap_arrays, acc_arrays,
+            feed_arrays, cap_rest, param_arrays, acc_arrays,
             np.int32(opt._step_count), np.float32(opt.get_lr()), rng_arrays,
             mask_arrays)
         for p, a in zip(self.params, new_params):
