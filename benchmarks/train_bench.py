@@ -215,11 +215,14 @@ def bench_ernie(on_tpu):
     args = ([paddle.to_tensor(ids)],
             [paddle.to_tensor(labels), paddle.to_tensor(nsp)])
 
+    from paddle_tpu.ops.pallas_kernels import attention_path_counts
     import paddle_tpu.amp as amp
+    attention_path_counts(reset=True)
     with amp.auto_cast(level="O2"):
         for _ in range(warmup):
             loss, _ = step(*args)
         float(loss.numpy())
+        attn_paths = attention_path_counts()
         t0 = time.perf_counter()
         for _ in range(steps):
             loss, _ = step(*args)
@@ -237,6 +240,7 @@ def bench_ernie(on_tpu):
             "unit": "tokens/sec/chip",
             "step_ms": round(dt * 1e3, 2),
             "batch": B, "seq_len": T, "params": n_params,
+            "attn_paths": attn_paths,
             "mfu": _mfu(flops, dt)}
 
 

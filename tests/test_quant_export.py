@@ -1,6 +1,7 @@
 """Quantized-program export (reference: contrib/slim/quantization export —
 QuantizationFreezePass + save_inference_model: the artifact carries the
 fake-quant ops and their calibrated scales)."""
+import contextlib
 import os
 import pickle
 
@@ -207,9 +208,6 @@ class TestInt8Path:
         np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
 
 
-import contextlib
-
-
 @contextlib.contextmanager
 def _synth_samples_floor(n):
     """Make the synthetic datasets at least `n` samples for the block.
@@ -218,7 +216,6 @@ def _synth_samples_floor(n):
     winner depends on collection order; the accuracy-bound tests below
     need enough data that their trained models reach the asserted
     accuracies, so they must not inherit a smaller leaked value."""
-    import os
     old = os.environ.get("PADDLE_TPU_SYNTH_SAMPLES")
     # empty/garbage values are treated as unset, like the dataset's own
     # `if env_n:` guard
