@@ -1,0 +1,167 @@
+"""Inference benchmarks: Predictor latency/throughput on TPU.
+
+The training benches (train_bench.py) cover BASELINE configs 1-5; this
+script covers the deploy path — the reference's headline includes its
+"High-Performance Inference Engines", so the capture artifacts should
+carry serving numbers too. Two configs:
+
+  resnet50_infer  — vision serving, B=8 and B=64 (latency + throughput)
+  bert_infer      — encoder serving, B=8, T=128
+
+Each config: build model → static export (the export-time fusion passes
+run: conv+BN fold, fc fuse, add+act) → save/load inference model →
+Predictor with shape-cached compiled executables → timed run loop with a
+true host-transfer sync per batch (serving semantics: the caller needs
+the output back).
+
+Run:  python benchmarks/inference_bench.py [resnet50|bert|all]
+Prints one JSON line per (config, batch): {"config", "infer": true,
+"batch", "latency_ms", "throughput", "unit"}.
+
+Reference analogue: paddle/fluid/inference/tests/api benchmarks.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _serve_loop(pred, feed_name, out_name, make_batch, steps, warmup):
+    inh = pred.get_input_handle(feed_name)
+    oh = pred.get_output_handle(out_name)
+    for _ in range(warmup):
+        inh.copy_from_cpu(make_batch())
+        pred.run()
+        oh.copy_to_cpu()  # host sync — serving returns the result
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        inh.copy_from_cpu(make_batch())
+        pred.run()
+        oh.copy_to_cpu()
+    dt = (time.perf_counter() - t0) / steps
+    return dt
+
+
+_TMPDIRS = []
+
+
+def _export(build_fn, feed_specs, tag):
+    """Build under static graph, export via save_inference_model (fusion
+    passes fold conv+bn etc.), return (path, feed_names). The artifact
+    dir is cleaned up at process exit — the watcher re-runs this script
+    every window and must not accumulate weight files in /tmp."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    static.reset_default_programs()
+    try:
+        paddle.seed(0)
+        feeds = [static.data(n, shape, dtype)
+                 for n, shape, dtype in feed_specs]
+        out = build_fn(*feeds)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        d = tempfile.TemporaryDirectory(prefix="infer_bench_")
+        _TMPDIRS.append(d)  # keep alive until process exit, then removed
+        path = os.path.join(d.name, tag)
+        static.save_inference_model(path, feeds, [out], exe)
+    finally:
+        paddle.disable_static()
+    return path, [n for n, _, _ in feed_specs]
+
+
+def bench_resnet50(on_tpu):
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.vision.models import resnet50
+
+    hw = 224 if on_tpu else 32
+    batches = ([8, 64] if on_tpu else [2])
+    steps, warmup = (20, 3) if on_tpu else (2, 2)
+
+    def build(img):
+        net = resnet50(num_classes=100)
+        net.eval()  # serving: BN uses running stats, dropout identity
+        return net(img)
+
+    path, feeds = _export(build, [("image", [-1, 3, hw, hw], "float32")],
+                          "resnet50")
+    cfg = Config(path + ".pdmodel", path + ".pdiparams")
+    pred = create_predictor(cfg)
+    out_name = pred.get_output_names()[0]
+    rows = []
+    for B in batches:
+        rs = np.random.RandomState(0)
+        x = rs.rand(B, 3, hw, hw).astype(np.float32)
+        dt = _serve_loop(pred, feeds[0], out_name, lambda: x, steps,
+                         warmup)
+        rows.append({"config": "resnet50_infer", "infer": True,
+                     "batch": B, "image": hw,
+                     "latency_ms": round(dt * 1e3, 2),
+                     "throughput": round(B / dt, 1),
+                     "unit": "images/sec/chip"})
+    return rows
+
+
+def bench_bert(on_tpu):
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.models import bert_base, bert_tiny
+
+    T = 128 if on_tpu else 32
+    B = 8 if on_tpu else 2
+    steps, warmup = (20, 3) if on_tpu else (2, 2)
+
+    net = bert_base() if on_tpu else bert_tiny()
+    net.eval()  # serving export: dropout identity, no rng feeds recorded
+    core = getattr(net, "bert", net)
+    vocab = core.embeddings.word_embeddings.weight.shape[0]
+
+    def build(ids):
+        out = net(ids)
+        # BertForPretraining heads return (mlm_logits, nsp_logits)
+        return out[0] if isinstance(out, (list, tuple)) else out
+
+    # fixed batch in the spec: the encoder derives masks/position ids
+    # from the shape, and the predictor shape-caches per signature anyway
+    path, feeds = _export(build, [("ids", [B, T], "int64")], "bert")
+    cfg = Config(path + ".pdmodel", path + ".pdiparams")
+    pred = create_predictor(cfg)
+    out_name = pred.get_output_names()[0]
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, vocab, (B, T)).astype(np.int64)
+    dt = _serve_loop(pred, feeds[0], out_name, lambda: x, steps,
+                     warmup)
+    return [{"config": "bert_infer", "infer": True, "batch": B,
+             "seq_len": T, "latency_ms": round(dt * 1e3, 2),
+             "throughput": round(B * T / dt, 1),
+             "unit": "tokens/sec/chip"}]
+
+
+def main():
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device_kind": jax.devices()[0].device_kind}),
+          flush=True)
+    for name, fn in (("resnet50", bench_resnet50), ("bert", bench_bert)):
+        if which not in ("all", name):
+            continue
+        try:
+            for row in fn(on_tpu):
+                print(json.dumps(row), flush=True)
+        except Exception as e:
+            print(json.dumps({"config": name + "_infer",
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -47,15 +47,35 @@ def _bench_child(which: str, timeout_s: float, env=None):
     return backend, results, err
 
 
+def _script_child(script: str, row_key, timeout_s: float):
+    """Run an auxiliary bench script in a timed child; returns
+    (backend_row, result_rows, err). Per-row `error` entries are folded
+    into err so a child that exits 0 with only failure rows stays
+    diagnosable in the artifact."""
+    lines, err = _run_suite_child(None, timeout_s, script=script)
+    backend = next((r for r in lines if "backend" in r), None)
+    rows = [r for r in lines if row_key(r) and "error" not in r]
+    row_errs = ["%s: %s" % (r.get("config") or r.get("kernel"),
+                            str(r["error"])[:200])
+                for r in lines if "error" in r]
+    if row_errs:
+        err = "; ".join(filter(None, [err] + row_errs))
+    return backend, rows, err
+
+
 def _micro_bench_child(timeout_s: float):
     """Last-priority: re-measure the Pallas-vs-XLA micro-benches
     (fused_kernels_bench.py). Mostly interesting when the tiered health
-    probe has re-enabled flash; rows land under 'kernel' keys. The
-    backend row is returned too so an off-TPU run is detectable."""
-    lines, err = _run_suite_child(None, timeout_s,
-                                  script="fused_kernels_bench.py")
-    backend = next((r for r in lines if "backend" in r), None)
-    return backend, [r for r in lines if "kernel" in r], err
+    probe has re-enabled flash; rows land under 'kernel' keys."""
+    return _script_child("fused_kernels_bench.py",
+                         lambda r: "kernel" in r, timeout_s)
+
+
+def _infer_bench_child(timeout_s: float):
+    """Serving numbers (inference_bench.py): predictor latency/throughput
+    for resnet50 + bert — the deploy-path half of the perf story."""
+    return _script_child("inference_bench.py",
+                         lambda r: r.get("infer"), timeout_s)
 
 
 def run_window(gpt2_batches, deadline_s: float = 2700.0) -> str | None:
@@ -102,18 +122,26 @@ def run_window(gpt2_batches, deadline_s: float = 2700.0) -> str | None:
         print("# window: no successful bench (%s)" % "; ".join(errs),
               flush=True)
         return None
-    micro = []
-    remaining = deadline - time.monotonic()
-    if not fell_off and remaining > 300.0:
-        mb, micro, merr = _micro_bench_child(min(remaining, 900.0))
-        if merr:
-            errs.append("micro: %s" % merr)
-        if mb is not None and mb.get("backend") != "tpu":
-            # off-TPU interpret-mode timings are meaningless; drop them
-            errs.append("micro: backend came up as %r (rows dropped)"
-                        % mb.get("backend"))
-            micro = []
-        print("# window: micro-bench -> %d rows" % len(micro), flush=True)
+    def extra_bench(child_fn, label):
+        """Shared tail-step runner: budget gate, off-TPU row drop (the
+        interpret-mode timings are meaningless), error surfacing."""
+        nonlocal fell_off
+        remaining = deadline - time.monotonic()
+        if fell_off or remaining < 300.0:
+            return []
+        b, rows, err = child_fn(min(remaining, 900.0))
+        if err:
+            errs.append("%s: %s" % (label, err))
+        if b is not None and b.get("backend") != "tpu":
+            errs.append("%s: backend came up as %r (rows dropped)"
+                        % (label, b.get("backend")))
+            rows = []
+            fell_off = True  # don't burn later steps' budget either
+        print("# window: %s -> %d rows" % (label, len(rows)), flush=True)
+        return rows
+
+    infer = extra_bench(_infer_bench_child, "infer")
+    micro = extra_bench(_micro_bench_child, "micro")
     # best gpt2 first: bench.py promotes the first gpt2* row it finds
     gpt2s = sorted((r for r in ok
                     if str(r.get("config", "")).startswith("gpt2")
@@ -137,6 +165,7 @@ def run_window(gpt2_batches, deadline_s: float = 2700.0) -> str | None:
         "note": "priority window plan (tpu_window.py): gpt2 batch sweep + "
                 "resnet im2col + long-context; best gpt2 ordered first",
         "results": gpt2s + rest,
+        "inference": infer or None,
         "micro_kernels": micro or None,
         "error": "; ".join(errs) or None,
     }

@@ -117,10 +117,16 @@ def test_window_orders_best_gpt2_first_and_excludes_long(monkeypatch,
         tpu_window, "_micro_bench_child",
         lambda t: ({"backend": "tpu"},
                    [{"kernel": "flash_attention", "speedup": 1.0}], None))
+    monkeypatch.setattr(
+        tpu_window, "_infer_bench_child",
+        lambda t: ({"backend": "tpu"},
+                   [{"config": "bert_infer", "infer": True,
+                     "throughput": 1.0}], None))
     path = tpu_window.run_window([24, 32], deadline_s=2700.0)
     assert path is not None
     art = json.load(open(path))
     assert art["micro_kernels"][0]["kernel"] == "flash_attention"
+    assert art["inference"][0]["config"] == "bert_infer"
     configs = [(r["config"], r.get("batch")) for r in art["results"]]
     # best sweep batch first (B=32 at 64k); gpt2_long NOT in the headline
     # slot despite its higher number — bench.py promotes results[0]
@@ -149,6 +155,8 @@ def test_window_all_sweeps_failed_long_not_promotable(monkeypatch,
 
     monkeypatch.setattr(tpu_window, "_run_suite_child", fake_child)
     monkeypatch.setattr(tpu_window, "_micro_bench_child",
+                        lambda t: (None, [], "skipped in test"))
+    monkeypatch.setattr(tpu_window, "_infer_bench_child",
                         lambda t: (None, [], "skipped in test"))
     path = tpu_window.run_window([24, 32], deadline_s=2700.0)
     art = json.load(open(path))
@@ -180,24 +188,52 @@ def test_window_micro_skipped_after_fell_off_and_offtpu_rows_dropped(
     monkeypatch.setattr(
         tpu_window, "_micro_bench_child",
         lambda t: micro_calls.append(t) or (tpu_b, [], None))
+    monkeypatch.setattr(
+        tpu_window, "_infer_bench_child",
+        lambda t: micro_calls.append(t) or (tpu_b, [], None))
     path = tpu_window.run_window([24], deadline_s=2700.0)
     art = json.load(open(path))
     assert micro_calls == []  # (a): never invoked after the break
     assert art["micro_kernels"] is None
+    assert art["inference"] is None
 
     def healthy_child(which, timeout_s, env=None):
         return [tpu_b, {"config": "gpt2_small_train",
                         "throughput": 1.0}], None
 
+    # (b) micro child falls off TPU while infer was fine: the micro rows
+    # are dropped, the banked infer rows stay
     monkeypatch.setattr(tpu_window, "_run_suite_child", healthy_child)
+    monkeypatch.setattr(
+        tpu_window, "_infer_bench_child",
+        lambda t: (tpu_b, [{"config": "bert_infer", "infer": True,
+                            "throughput": 9.0}], None))
     monkeypatch.setattr(
         tpu_window, "_micro_bench_child",
         lambda t: ({"backend": "cpu"},
                    [{"kernel": "flash_attention", "speedup": 9.0}], None))
     path = tpu_window.run_window([24], deadline_s=2700.0)
     art = json.load(open(path))
-    assert art["micro_kernels"] is None  # (b): off-TPU rows dropped
+    assert art["micro_kernels"] is None  # off-TPU rows dropped
+    assert art["inference"][0]["config"] == "bert_infer"
     assert "micro: backend came up as 'cpu'" in art["error"]
+
+    # (c) the INFER child falls off TPU: its rows are dropped AND the
+    # micro step is skipped (no more budget burned off-TPU)
+    micro_calls.clear()
+    monkeypatch.setattr(
+        tpu_window, "_infer_bench_child",
+        lambda t: ({"backend": "cpu"},
+                   [{"config": "bert_infer", "infer": True,
+                     "throughput": 9.0}], None))
+    monkeypatch.setattr(
+        tpu_window, "_micro_bench_child",
+        lambda t: micro_calls.append(t) or (tpu_b, [], None))
+    path = tpu_window.run_window([24], deadline_s=2700.0)
+    art = json.load(open(path))
+    assert art["inference"] is None
+    assert micro_calls == []
+    assert "infer: backend came up as 'cpu'" in art["error"]
 
 
 def test_latest_capture_staleness_and_malformed(monkeypatch, tmp_path):
