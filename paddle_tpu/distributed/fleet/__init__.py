@@ -125,12 +125,14 @@ def init(role_maker=None, is_collective=True, strategy=None):
     pp = int(hybrid.get("pp_degree", 1))
     sd = int(hybrid.get("sharding_degree", 1))
     sep = int(hybrid.get("sep_degree", 1))
+    ep = int(hybrid.get("ep_degree", 1))
     dp = int(hybrid.get("dp_degree", -1))
     if dp == -1:
-        denom = mp * pp * sd * sep
+        denom = mp * pp * sd * sep * ep
         dp = max(1, n_dev // denom)
-    topo = CommunicateTopology(("data", "pipe", "sharding", "model", "sep"),
-                               (dp, pp, sd, mp, sep))
+    topo = CommunicateTopology(
+        ("data", "pipe", "sharding", "model", "sep", "expert"),
+        (dp, pp, sd, mp, sep, ep))
     _state.hcg = HybridCommunicateGroup(
         topo, sep_method=hybrid.get("sep_method", "ring"),
         sep_remat=hybrid.get("sep_remat", False))
@@ -157,7 +159,11 @@ def distributed_model(model):
     if hcg.get_sharding_parallel_world_size() > 1:
         return ShardingParallel(model, hcg=hcg, strategy=strategy)
     if hcg.get_model_parallel_world_size() > 1 \
-            or hcg.get_sep_parallel_world_size() > 1:
+            or hcg.get_sep_parallel_world_size() > 1 \
+            or hcg.get_expert_parallel_world_size() > 1:
+        # ep rides the TP wrapper: expert params carry P("ep", ...) specs
+        # (incubate/moe.py) and the compiled step places them like any
+        # sharded parameter; the token all-to-alls come out of GSPMD
         return TensorParallel(model, hcg=hcg, strategy=strategy)
     return DataParallel(model, mesh=hcg.global_mesh)
 

@@ -82,6 +82,7 @@ _CONFIG_DEFAULTS = {
         "sharding_degree": 1, "sep_degree": 1,
         "sep_method": "ring",        # "ring" | "alltoall" (Ulysses)
         "sep_remat": False,          # remat ring steps in backward
+        "ep_degree": 1,              # expert parallel (incubate.moe)
     },
     "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
                        "send_queue_size": 16,

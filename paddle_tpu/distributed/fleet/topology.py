@@ -104,8 +104,11 @@ class CommunicateTopology:
         return self._coord2rank[tuple(coord)]
 
 
-# axis-name mapping: reference hybrid_configs keys → mesh axis names
-_AXES = ("dp", "pp", "sharding", "mp", "sep")
+# axis-name mapping: reference hybrid_configs keys → mesh axis names.
+# "sep" (sequence/context parallel) and "ep" (expert parallel for MoE —
+# paddle_tpu.incubate.moe) are TPU-build additions beyond the reference's
+# 4-axis hybrid.
+_AXES = ("dp", "pp", "sharding", "mp", "sep", "ep")
 
 
 class HybridCommunicateGroup:
@@ -119,8 +122,8 @@ class HybridCommunicateGroup:
 
     def __init__(self, topology: CommunicateTopology = None,
                  dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
-                 sep_degree=1, sep_method="ring", sep_remat=False,
-                 devices: Optional[Sequence] = None):
+                 sep_degree=1, ep_degree=1, sep_method="ring",
+                 sep_remat=False, devices: Optional[Sequence] = None):
         self.sep_method = sep_method
         # remat each ring step in backward (O(size*Tl*D) residuals instead
         # of O(T^2/size)) — hybrid_configs["sep_remat"]
@@ -133,21 +136,26 @@ class HybridCommunicateGroup:
             sharding_degree = dims.get("sharding", 1)
             mp_degree = dims.get("model", 1)
             sep_degree = dims.get("sep", 1)
+            ep_degree = dims.get("expert", 1)
         self._topo = topology or CommunicateTopology(
-            ("data", "pipe", "sharding", "model"),
-            (dp_degree, pp_degree, sharding_degree, mp_degree))
+            ("data", "pipe", "sharding", "model", "sep", "expert"),
+            (dp_degree, pp_degree, sharding_degree, mp_degree,
+             sep_degree, ep_degree))
         self._dp_degree = dp_degree
         self._mp_degree = mp_degree
         self._pp_degree = pp_degree
         self._sharding_degree = sharding_degree
         self._sep_degree = sep_degree
-        n = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        self._ep_degree = ep_degree
+        n = (dp_degree * mp_degree * pp_degree * sharding_degree
+             * sep_degree * ep_degree)
         devs = list(devices) if devices is not None else jax.devices()
         if len(devs) < n:
             raise ValueError(
                 f"hybrid topology needs {n} devices, have {len(devs)}")
         arr = np.array(devs[:n]).reshape(
-            dp_degree, pp_degree, sharding_degree, mp_degree, sep_degree)
+            dp_degree, pp_degree, sharding_degree, mp_degree, sep_degree,
+            ep_degree)
         self.global_mesh = Mesh(arr, _AXES)
         self.nranks = n
         self.global_rank = 0
@@ -230,6 +238,14 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self):
         return self._groups["sep"]
+
+    # expert parallel (MoE — paddle_tpu.incubate.moe; the reference's MoE
+    # groups live outside its 4-axis hybrid topology)
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
+
+    def get_expert_parallel_group(self):
+        return self._groups["ep"]
 
     def get_check_parallel_group(self):
         return self._groups["mp"]

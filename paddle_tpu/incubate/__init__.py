@@ -2,9 +2,11 @@
 from . import asp  # noqa: F401
 from . import nn  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import moe  # noqa: F401
 from . import optimizer  # noqa: F401
+from .moe import MoELayer  # noqa: F401
 from .optimizer import (GradientMergeOptimizer, LookAhead,  # noqa: F401
                         ModelAverage)
 
-__all__ = ["asp", "nn", "checkpoint", "optimizer", "LookAhead",
-           "ModelAverage", "GradientMergeOptimizer"]
+__all__ = ["asp", "nn", "checkpoint", "moe", "MoELayer", "optimizer",
+           "LookAhead", "ModelAverage", "GradientMergeOptimizer"]

@@ -1,0 +1,234 @@
+"""MoE (expert parallel) — paddle_tpu.incubate.moe.MoELayer.
+
+TPU-native GShard-style realization of the reference's MoE stack
+(global_scatter/global_gather all-to-all dispatch,
+reference python/paddle/distributed/utils.py:57,151): fixed capacity,
+one-hot dispatch/combine einsums, experts sharded over the "ep" mesh
+axis. Correctness = dense per-token gating reference; distribution =
+ep=4 vs ep=1 parity on the 8-virtual-device mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.incubate import MoELayer
+
+
+@pytest.fixture(autouse=True)
+def _reset_fleet():
+    yield
+    dist.fleet._state.initialized = False
+    from paddle_tpu.distributed import collective
+    collective.destroy_process_group()
+
+
+def _dense_reference(moe, x_np):
+    """Per-token dense evaluation of the same gating + experts (no
+    capacity: assumes the layer was built with ample capacity_factor)."""
+    wg = moe.gate_weight.numpy()
+    w1, b1 = moe.w1.numpy(), moe.b1.numpy()
+    w2, b2 = moe.w2.numpy(), moe.b2.numpy()
+    S, M = x_np.shape
+    logits = x_np @ wg
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+
+    def ffn(ei, t):
+        h = t @ w1[ei] + b1[ei]
+        h = np.asarray(paddle.nn.functional.gelu(
+            paddle.to_tensor(h.astype(np.float32))).numpy())
+        return h @ w2[ei] + b2[ei]
+
+    out = np.zeros_like(x_np)
+    for s in range(S):
+        p = probs[s].copy()
+        i1 = int(p.argmax())
+        g1 = p[i1]
+        p[i1] = 0.0
+        i2 = int(p.argmax())
+        g2 = p[i2]
+        z = g1 + g2 + 1e-9
+        out[s] = (g1 / z) * ffn(i1, x_np[s]) + (g2 / z) * ffn(i2, x_np[s])
+    return out
+
+
+def test_moe_matches_dense_top2():
+    paddle.seed(7)
+    moe = MoELayer(d_model=16, d_hidden=24, num_experts=4, top_k=2,
+                   capacity_factor=8.0)   # ample: nothing dropped
+    rs = np.random.RandomState(0)
+    x = rs.randn(12, 16).astype(np.float32)
+    y = moe(paddle.to_tensor(x)).numpy()
+    ref = _dense_reference(moe, x)
+    np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_aux_loss_uniform_gate_is_one():
+    paddle.seed(1)
+    moe = MoELayer(d_model=8, d_hidden=8, num_experts=4, top_k=1,
+                   capacity_factor=8.0)
+    with paddle.no_grad():
+        moe.gate_weight.set_value(np.zeros((8, 4), np.float32))
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(16, 8).astype(np.float32))
+    moe(x)
+    # uniform probs: mean_prob_e = 1/E; argmax ties all resolve to expert
+    # 0, so Σ_e me*ce = 1/E and l_aux = E * 1/E... with all tokens on one
+    # expert: Σ me*ce = (1/E)*1 = 1/E → l_aux = E*(1/E)*... compute:
+    # l_aux = E * Σ_e (1/E)*ce = Σ_e ce = 1
+    np.testing.assert_allclose(float(moe.l_aux.numpy()), 1.0, rtol=1e-5)
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """All tokens prefer expert 0 (forced gate); with capacity C < S the
+    overflow tokens lose their first-choice contribution."""
+    paddle.seed(2)
+    S, M = 8, 8
+    moe = MoELayer(d_model=M, d_hidden=8, num_experts=2, top_k=1,
+                   capacity_factor=0.5)   # C = ceil(8/2*0.5) = 2
+    g = np.zeros((M, 2), np.float32)
+    g[:, 0] = 0.0
+    with paddle.no_grad():
+        moe.gate_weight.set_value(g)  # uniform → argmax picks expert 0
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(S, M).astype(np.float32))
+    y = moe(x).numpy()
+    assert moe.capacity(S) == 2
+    # first 2 tokens served, the rest dropped (zero output, residual
+    # carries them in a real transformer)
+    assert np.abs(y[:2]).sum() > 0
+    np.testing.assert_allclose(y[2:], 0.0, atol=1e-6)
+
+
+def test_moe_aux_alone_moves_gate():
+    """The aux loss must backprop into the gate on the eager tape even
+    when it is the ONLY loss term (the buffer aliasing keeps the tape
+    node attached)."""
+    paddle.seed(11)
+    moe = MoELayer(d_model=8, d_hidden=8, num_experts=4, top_k=1,
+                   capacity_factor=8.0)
+    x = paddle.to_tensor(np.random.RandomState(4)
+                         .randn(16, 8).astype(np.float32))
+    moe(x)
+    loss = moe.l_aux * 1.0
+    loss.backward()
+    g = moe.gate_weight.grad
+    assert g is not None and float(paddle.sum(paddle.abs(g)).numpy()) > 0
+
+
+def test_moe_l_aux_readable_after_compiled_step():
+    """After a jitted train step, `float(net.moe.l_aux.numpy())` must be
+    the step's concrete aux value (buffer round-trip), not a leaked
+    tracer."""
+    from paddle_tpu.jit.engine import make_train_step
+
+    paddle.seed(12)
+    net = _MoENet()
+    opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                               learning_rate=0.1)
+    rs = np.random.RandomState(13)
+    x = rs.randn(4, 4, 16).astype(np.float32)
+    t = rs.randn(4, 4, 1).astype(np.float32)
+
+    def loss_fn(pred, lab):
+        return paddle.mean((pred - lab) ** 2) + 0.01 * net.moe.l_aux
+
+    step = make_train_step(net, loss_fn, opt)
+    step([paddle.to_tensor(x)], [paddle.to_tensor(t)])
+    v = float(net.moe.l_aux.numpy())   # must not raise UnexpectedTracer
+    assert np.isfinite(v) and v > 0
+
+
+def test_moe_grads_flow_and_aux_backprops():
+    paddle.seed(3)
+    moe = MoELayer(d_model=8, d_hidden=8, num_experts=4, top_k=2,
+                   capacity_factor=4.0)
+    x = paddle.to_tensor(np.random.RandomState(2)
+                         .randn(8, 8).astype(np.float32))
+    y = moe(x)
+    loss = paddle.mean(y * y) + 0.01 * moe.l_aux
+    loss.backward()
+    for p in (moe.gate_weight, moe.w1, moe.b1, moe.w2, moe.b2):
+        assert p.grad is not None
+        assert float(paddle.sum(paddle.abs(p.grad)).numpy()) > 0
+
+
+class _MoENet(paddle.nn.Layer):
+    def __init__(self, d=16, e=4):
+        super().__init__()
+        self.inp = paddle.nn.Linear(d, d)
+        self.moe = MoELayer(d_model=d, d_hidden=2 * d, num_experts=e,
+                            top_k=2, capacity_factor=4.0)
+        self.out = paddle.nn.Linear(d, 1)
+
+    def forward(self, x):
+        h = paddle.nn.functional.relu(self.inp(x))
+        h = h + self.moe(h)          # residual carries dropped tokens
+        return self.out(h)
+
+
+def _run_training(ep, steps=3):
+    from paddle_tpu.jit.engine import make_train_step
+
+    dist.fleet._state.initialized = False
+    from paddle_tpu.distributed import collective
+    collective.destroy_process_group()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "ep_degree": ep}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(55)
+    net = _MoENet()
+    dist.fleet.distributed_model(net)
+    opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                               learning_rate=0.1)
+
+    rs = np.random.RandomState(9)
+    x = rs.randn(8, 6, 16).astype(np.float32)
+    t = rs.randn(8, 6, 1).astype(np.float32)
+
+    def loss_fn(pred, lab):
+        return paddle.mean((pred - lab) ** 2) + 0.01 * net.moe.l_aux
+
+    step = make_train_step(net, loss_fn, opt)
+    losses = []
+    for _ in range(steps):
+        loss, _ = step([paddle.to_tensor(x)], [paddle.to_tensor(t)])
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def test_moe_ep4_training_matches_ep1():
+    """Three jitted train steps on a dp=2 x ep=4 mesh == the ep=1 run:
+    the expert all-to-alls + sharded expert weights are numerically
+    invisible. Also asserts training moves the loss."""
+    l4 = _run_training(4)
+    l1 = _run_training(1)
+    np.testing.assert_allclose(l4, l1, rtol=2e-4, atol=2e-5)
+    assert l4[-1] < l4[0]
+
+
+def test_moe_expert_params_actually_sharded():
+    """Under the ep mesh the expert weights are physically partitioned:
+    each device holds E/ep experts' rows (like the ZeRO/giant-embedding
+    assertions)."""
+    from paddle_tpu.jit.engine import make_train_step
+
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "ep_degree": 4}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(5)
+    net = _MoENet()
+    dist.fleet.distributed_model(net)
+    opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                               learning_rate=0.1)
+    rs = np.random.RandomState(3)
+    x = rs.randn(4, 4, 16).astype(np.float32)
+    t = rs.randn(4, 4, 1).astype(np.float32)
+    step = make_train_step(net, lambda p, l: paddle.mean((p - l) ** 2),
+                           opt)
+    step([paddle.to_tensor(x)], [paddle.to_tensor(t)])
+    w1 = net.moe.w1._data
+    shard_shapes = {tuple(s.data.shape) for s in w1.addressable_shards}
+    # E=4 over ep=4: one expert per ep slice
+    assert shard_shapes == {(1, 16, 32)}, shard_shapes
