@@ -151,18 +151,29 @@ class GPTMLP(Layer):
 
 
 class GPTDecoderLayer(Layer):
-    """Pre-LN transformer decoder block."""
+    """Pre-LN transformer decoder block.
+
+    moe_num_experts > 0 swaps the dense MLP for an expert-parallel
+    MoELayer (incubate/moe.py, GShard dispatch over the "ep" mesh axis)
+    — the GPT-MoE configuration of the reference ecosystem, TPU-native."""
 
     def __init__(self, hidden_size, num_heads, intermediate_size=None,
                  attn_dropout_prob=0.1, hidden_dropout_prob=0.1,
-                 layer_norm_epsilon=1e-5):
+                 layer_norm_epsilon=1e-5, moe_num_experts=0, moe_top_k=2,
+                 moe_capacity_factor=1.25):
         super().__init__()
         inter = intermediate_size or 4 * hidden_size
         self.ln_1 = LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
         self.attn = GPTAttention(hidden_size, num_heads, attn_dropout_prob,
                                  hidden_dropout_prob)
         self.ln_2 = LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
-        self.mlp = GPTMLP(hidden_size, inter, hidden_dropout_prob)
+        if moe_num_experts:
+            from ..incubate.moe import MoELayer
+            self.mlp = MoELayer(hidden_size, inter, moe_num_experts,
+                                top_k=moe_top_k,
+                                capacity_factor=moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(hidden_size, inter, hidden_dropout_prob)
         self.dropout = Dropout(hidden_dropout_prob)
 
     def _residual_dropout(self, h, residual):
@@ -196,19 +207,43 @@ class GPTModel(Layer):
                  num_heads=12, intermediate_size=None,
                  max_position_embeddings=1024, attn_dropout_prob=0.1,
                  hidden_dropout_prob=0.1, layer_norm_epsilon=1e-5,
-                 initializer_range=0.02):
+                 initializer_range=0.02, moe_every_n_layers=0,
+                 moe_num_experts=8, moe_top_k=2, moe_capacity_factor=1.25):
         super().__init__()
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.embeddings = GPTEmbeddings(
             vocab_size, hidden_size, max_position_embeddings,
             hidden_dropout_prob, initializer_range)
+        # moe_every_n_layers=n: every n-th block's MLP is an MoELayer
+        # (GPT-MoE, e.g. n=2 = alternating dense/MoE like GShard)
         self.layers = LayerList([
-            GPTDecoderLayer(hidden_size, num_heads, intermediate_size,
-                            attn_dropout_prob, hidden_dropout_prob,
-                            layer_norm_epsilon)
-            for _ in range(num_layers)])
+            GPTDecoderLayer(
+                hidden_size, num_heads, intermediate_size,
+                attn_dropout_prob, hidden_dropout_prob, layer_norm_epsilon,
+                moe_num_experts=(moe_num_experts if moe_every_n_layers
+                                 and (i + 1) % moe_every_n_layers == 0
+                                 else 0),
+                moe_top_k=moe_top_k,
+                moe_capacity_factor=moe_capacity_factor)
+            for i in range(num_layers)])
         self.ln_f = LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
+
+    def moe_aux_loss(self):
+        """Sum of the MoE load-balance losses of the latest forward —
+        add `coef * model.moe_aux_loss()` to the training loss. A zero
+        scalar Tensor when the model has no MoE blocks, so config-generic
+        code can call .numpy() either way."""
+        from ..framework.tensor import Tensor
+        from ..incubate.moe import MoELayer
+        total = None
+        for blk in self.layers:
+            if isinstance(blk.mlp, MoELayer):
+                total = blk.mlp.l_aux if total is None \
+                    else total + blk.mlp.l_aux
+        if total is None:
+            return Tensor(np.zeros((), np.float32), _internal=True)
+        return total
 
     def forward(self, input_ids, position_ids=None, caches=None):
         x = self.embeddings(input_ids, position_ids)
@@ -255,6 +290,12 @@ class GPTForPretraining(Layer):
         distributed/auto_parallel/partitioner.py:846)."""
         from functools import partial as _partial
 
+        from ..incubate.moe import MoELayer
+        if any(isinstance(b.mlp, MoELayer) for b in self.gpt.layers):
+            raise NotImplementedError(
+                "to_pipeline for MoE blocks is not supported yet — "
+                "expert-parallel GPT shards over the ep axis instead "
+                "(hybrid_configs['ep_degree'])")
         g = self.gpt
         emb = g.embeddings
         blk = g.layers[0]
