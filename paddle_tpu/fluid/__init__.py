@@ -52,6 +52,9 @@ class _OptimizerCompat:
     Ftrl = FtrlOptimizer = _opt_mod.Ftrl
     Dpsgd = DpsgdOptimizer = _opt_mod.Dpsgd
     LarsMomentum = LarsMomentumOptimizer = _opt_mod.Lars
+    DecayedAdagrad = DecayedAdagradOptimizer = _opt_mod.DecayedAdagrad
+    ProximalGD = ProximalGDOptimizer = _opt_mod.ProximalGD
+    ProximalAdagrad = ProximalAdagradOptimizer = _opt_mod.ProximalAdagrad
 
 
 optimizer = _OptimizerCompat

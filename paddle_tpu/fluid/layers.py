@@ -532,3 +532,123 @@ def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
         import numpy as np2
         print(f"{head} value={np2.asarray(arr).reshape(-1)[:n]}")
     return input
+
+
+# -- r5 honest-audit batch (multi-seed op-sample misses) --------------------
+# reference: fluid/layers/loss.py rank_loss/bpr_loss/hinge_loss,
+# fluid/layers/nn.py row_conv/pad_constant_like/shuffle_batch/fsp_matrix/
+# conv_shift/py_func, fluid/layers/rnn.py beam_search (dense [B, W] layout
+# here instead of LoD; see ops/misc_ops.py beam_search_step docstring).
+
+
+def squared_l2_norm(x):
+    from ..ops.misc_ops import squared_l2_norm as _op
+    return _op(x)
+
+
+def hinge_loss(input, label):  # noqa: A002
+    from ..ops.misc_ops import hinge_loss as _op
+    return _op(input, label)
+
+
+def rank_loss(label, left, right, name=None):
+    from ..ops.misc_ops import rank_loss as _op
+    return _op(label, left, right)
+
+
+def bpr_loss(input, label, name=None):  # noqa: A002
+    from ..ops.misc_ops import bpr_loss as _op
+    return _op(input, label)
+
+
+def fsp_matrix(x, y):
+    from ..ops.misc_ops import fsp_matrix as _op
+    return _op(x, y)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    from ..ops.misc_ops import pad_constant_like as _op
+    return _op(x, y, pad_value=float(pad_value))
+
+
+def shuffle_batch(x, seed=None):
+    """Random batch-dim permutation; returns (shuffled, order). Seeded
+    from the framework RNG (paddle.seed) unless `seed` is given."""
+    import jax as _jax
+    from ..framework.random import RNG
+    from ..ops.misc_ops import shuffle_batch as _op
+    from ..framework.tensor import Tensor as _T
+    key = (_jax.random.PRNGKey(int(seed)) if seed is not None
+           else RNG.next_key())
+    if not isinstance(key, Tensor):
+        key = _T(key, _internal=True)
+    return _op(x, key)
+
+
+def conv_shift(x, y, name=None):
+    from ..ops.misc_ops import conv_shift as _op
+    return _op(x, y)
+
+
+def row_conv(input, future_context_size=None, filter=None, name=None):  # noqa: A002
+    """Dense [B, T, D] form. Pass `filter` ([future_len, D] tensor) —
+    the reference's parameter-creating form belongs to the static
+    param-attr machinery; here the caller owns the filter."""
+    from ..ops.misc_ops import row_conv as _op
+    if filter is None:
+        raise ValueError("row_conv: pass the [future_len, D] filter tensor")
+    return _op(input, filter)
+
+
+def correlation(x1, x2, max_displacement=4, pad_size=4, name=None):
+    from ..ops.misc_ops import correlation as _op
+    return _op(x1, x2, max_displacement=int(max_displacement),
+               pad_size=int(pad_size))
+
+
+def positive_negative_pair(score, label, query_id):
+    from ..ops.misc_ops import positive_negative_pair as _op
+    return _op(score, label, query_id)
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    from ..ops.misc_ops import filter_by_instag as _op
+    return _op(ins, ins_tag, filter_tag,
+               out_val_if_empty=int(out_val_if_empty))
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=True):
+    """Dense-layout beam step: see ops/misc_ops.py beam_search_step.
+    `ids` is unused in the dense form (token ids are recovered from the
+    flat top-k index); kept for reference signature parity."""
+    from ..ops.misc_ops import beam_search_step as _op
+    token, total, parent = _op(pre_ids, pre_scores, scores,
+                               beam_size=int(beam_size), end_id=int(end_id),
+                               is_accumulated=bool(is_accumulated))
+    if return_parent_idx:
+        return token, total, parent
+    return token, total
+
+
+def py_func(func, x, out_shape, out_dtype="float32"):
+    """Host-python op (reference: fluid/layers/nn.py py_func over
+    py_func_op.cc): eager it calls straight through; under jit it lowers
+    to jax.pure_callback with the declared result spec."""
+    from ..ops.misc_ops import py_func_call as _op
+    return _op(x, func=func, out_shape=tuple(int(s) for s in out_shape),
+               out_dtype=str(out_dtype))
+
+
+def data_norm(input, batch_size, batch_sum, batch_square_sum,  # noqa: A002
+              epsilon=1e-4, name=None):
+    from ..ops.misc_ops import data_norm as _op
+    return _op(input, batch_size, batch_sum, batch_square_sum,
+               epsilon=float(epsilon))
+
+
+def linear_chain_crf(input, transition, label, length, name=None):  # noqa: A002
+    from ..ops.misc_ops import linear_chain_crf as _op
+    return _op(input, transition, label, length)

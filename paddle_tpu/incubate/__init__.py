@@ -8,5 +8,48 @@ from .moe import MoELayer  # noqa: F401
 from .optimizer import (GradientMergeOptimizer, LookAhead,  # noqa: F401
                         ModelAverage)
 
+def segment_sum(data, segment_ids, name=None):
+    """reference: python/paddle/incubate/tensor/math.py segment_sum over
+    operators/segment_pool_op.cc."""
+    from ..ops.misc_ops import segment_pool
+    return segment_pool(data, segment_ids, pooltype="SUM")
+
+
+def segment_mean(data, segment_ids, name=None):
+    from ..ops.misc_ops import segment_pool
+    return segment_pool(data, segment_ids, pooltype="MEAN")
+
+
+def segment_max(data, segment_ids, name=None):
+    from ..ops.misc_ops import segment_pool
+    return segment_pool(data, segment_ids, pooltype="MAX")
+
+
+def segment_min(data, segment_ids, name=None):
+    from ..ops.misc_ops import segment_pool
+    return segment_pool(data, segment_ids, pooltype="MIN")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: incubate/operators/softmax_mask_fuse.py over
+    fused_softmax_mask_op.cu — softmax(x + mask); one XLA fusion here."""
+    import paddle_tpu.nn.functional as F
+    return F.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """reference: fused_softmax_mask_upper_triangle_op.cu — causal-masked
+    softmax over the last two dims (no materialized mask input)."""
+    import numpy as _np
+    import jax.numpy as _jnp
+    import paddle_tpu.nn.functional as F
+    from ..framework.tensor import Tensor as _T
+    T_ = x.shape[-1]
+    neg = _np.triu(_np.full((T_, T_), -1e30, _np.float32), k=1)
+    return F.softmax(x + _T(_jnp.asarray(neg), _internal=True), axis=-1)
+
+
 __all__ = ["asp", "nn", "checkpoint", "moe", "MoELayer", "optimizer",
-           "LookAhead", "ModelAverage", "GradientMergeOptimizer"]
+           "LookAhead", "ModelAverage", "GradientMergeOptimizer",
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]

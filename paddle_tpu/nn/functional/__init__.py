@@ -713,6 +713,11 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
                              channel_last=data_format == "NHWC")
 
 
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _nn.pixel_unshuffle(x, downscale_factor=int(downscale_factor),
+                               channel_last=data_format == "NHWC")
+
+
 def channel_shuffle(x, groups, data_format="NCHW", name=None):
     return _nn.channel_shuffle(x, groups=int(groups),
                                channel_last=data_format == "NHWC")
@@ -982,3 +987,10 @@ def class_center_sample(label, num_classes, num_samples, group=None):
 relu_ = relu
 elu_ = elu
 softmax_ = softmax
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search hypotheses (reference:
+    nn.functional.gather_tree over operators/gather_tree_op.cc)."""
+    from ...ops.misc_ops import gather_tree as _op
+    return _op(ids, parents)

@@ -322,3 +322,369 @@ def fused_embedding_seq_pool(w, ids, lengths, *, combiner="sum",
     if padding_idx >= 0:
         mask = mask & (ids != padding_idx)
     return jnp.sum(emb * mask[..., None].astype(w.dtype), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# r5 honest-audit batch: ops surfaced by multi-seed samples of the
+# reference's REGISTER_OPERATOR sites (tools/op_sample_check.py).
+# ---------------------------------------------------------------------------
+
+
+@primitive("squared_l2_norm_op")
+def squared_l2_norm(x):
+    """reference: operators/squared_l2_norm_op.cc — scalar sum(x^2)
+    (the building block of the reference's global-norm grad clip)."""
+    return jnp.sum(jnp.square(x.astype(jnp.float32))).reshape(1)
+
+
+@primitive("hinge_loss_op")
+def hinge_loss(logits, labels):
+    """reference: operators/hinge_loss_op.cc — elementwise
+    max(0, 1 - (2*label - 1) * logit), labels in {0, 1}."""
+    sign = 2.0 * labels.astype(jnp.float32) - 1.0
+    return jnp.maximum(0.0, 1.0 - sign * logits.astype(jnp.float32))
+
+
+@primitive("rank_loss_op")
+def rank_loss(label, left, right):
+    """reference: operators/rank_loss_op.cc — pairwise RankNet loss
+    log(1 + exp(l - r)) - label * (l - r)."""
+    d = left.astype(jnp.float32) - right.astype(jnp.float32)
+    return jnp.log1p(jnp.exp(-jnp.abs(d))) + jnp.maximum(d, 0.0) \
+        - label.astype(jnp.float32) * d
+
+
+@primitive("bpr_loss_op")
+def bpr_loss(x, label):
+    """reference: operators/bpr_loss_op.cc — Bayesian Personalized
+    Ranking: loss_i = -sum_{j != y_i} log(sigmoid(x_iy - x_ij)) / (C-1);
+    x [N, C] raw scores, label [N, 1] or [N]."""
+    xf = x.astype(jnp.float32)
+    N, C = xf.shape
+    lab = label.reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(xf, lab[:, None], axis=1)      # [N, 1]
+    d = pos - xf                                             # [N, C]
+    # -log(sigmoid(d)) = softplus(-d), numerically stable
+    sp = jnp.logaddexp(0.0, -d)
+    mask = 1.0 - jax.nn.one_hot(lab, C, dtype=xf.dtype)
+    return (jnp.sum(sp * mask, axis=1, keepdims=True)
+            / jnp.maximum(C - 1, 1))
+
+
+@primitive("fsp_op")
+def fsp_matrix(x, y):
+    """reference: operators/fsp_op.cc — flow-of-solution-procedure matrix
+    for distillation: [B, Cx, Cy] = (1/(H*W)) sum_hw x[b,i,hw] y[b,j,hw]."""
+    B, Cx, H, W = x.shape
+    Cy = y.shape[1]
+    xf = x.reshape(B, Cx, H * W).astype(jnp.float32)
+    yf = y.reshape(B, Cy, H * W).astype(jnp.float32)
+    return jnp.einsum("bik,bjk->bij", xf, yf) / float(H * W)
+
+
+@primitive("pad_constant_like_op")
+def pad_constant_like(x, y, *, pad_value=0.0):
+    """reference: operators/pad_constant_like_op.cc — place y at the
+    origin of an x-shaped tensor filled with pad_value."""
+    pads = [(0, int(xs) - int(ys)) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=jnp.asarray(pad_value, y.dtype))
+
+
+@primitive("shuffle_batch_op")
+def shuffle_batch(x, key):
+    """reference: operators/shuffle_batch_op.cc — random permutation of
+    the batch (first) dim. The permutation indices come from the key so
+    the op is deterministic under jit; gradients scatter back through
+    jnp.take's vjp."""
+    perm = jax.random.permutation(key, x.shape[0])
+    return jnp.take(x, perm, axis=0), perm
+
+
+@primitive("conv_shift_op")
+def conv_shift(x, y):
+    """reference: operators/conv_shift_op.cc — circular correlation:
+    out[b, i] = sum_j x[b, (i + j - N//2) mod M] * y[b, j]
+    (x [B, M], y [B, N], N odd, N <= M)."""
+    B, M = x.shape
+    N = y.shape[1]
+    half = N // 2
+    idx = (jnp.arange(M)[:, None] + jnp.arange(N)[None, :] - half) % M
+    # gathered [B, M, N] contracted with y [B, N]
+    return jnp.einsum("bmn,bn->bm", x[:, idx], y)
+
+
+@primitive("row_conv_op")
+def row_conv(x, filt):
+    """reference: operators/row_conv_op.cc — lookahead row convolution
+    (DeepSpeech2): out[b, t, d] = sum_i x[b, t+i, d] * filt[i, d],
+    zero-padded beyond T. x [B, T, D], filt [future_len, D]."""
+    B, T, D = x.shape
+    F_ = filt.shape[0]
+    xp = jnp.pad(x, ((0, 0), (0, F_ - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for i in range(F_):  # static, small future context
+        out = out + xp[:, i:i + T, :] * filt[i][None, None, :]
+    return out
+
+
+@primitive("correlation_op")
+def correlation(x1, x2, *, max_displacement=4, pad_size=4):
+    """reference: operators/correlation_op.cc (PWC-Net cost volume),
+    kernel_size=1/stride=1 case: out[b, k, h, w] = (1/C) <x1[b,:,h,w],
+    x2[b,:,h+dy,w+dx]> for (dy, dx) in [-d, d]^2 (k enumerates them)."""
+    B, C, H, W = x1.shape
+    d = int(max_displacement)
+    p = int(pad_size)
+    if p != d:
+        # the general InferShape (H + 2p - 2d) isn't realized here; with
+        # p < d the window slice would clamp and silently duplicate
+        # border windows
+        raise NotImplementedError(
+            "correlation: only pad_size == max_displacement is "
+            "supported (got pad_size=%d, max_displacement=%d)" % (p, d))
+    x2p = jnp.pad(x2, ((0, 0), (0, 0), (p, p), (p, p)))
+    outs = []
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            win = jax.lax.dynamic_slice(
+                x2p, (0, 0, p + dy, p + dx), (B, C, H, W))
+            outs.append(jnp.mean(x1 * win, axis=1))
+    return jnp.stack(outs, axis=1)
+
+
+@primitive("segment_pool_op", dynamic=True)
+def segment_pool(x, segment_ids, *, pooltype="SUM"):
+    """reference: operators/segment_pool_op.cc — pool rows of x by
+    (sorted) segment id: SUM / MEAN / MAX / MIN. Output has
+    max(segment_ids)+1 rows (dynamic — eager / concrete-shape use)."""
+    ids = np.asarray(segment_ids)
+    n = int(ids.max()) + 1 if ids.size else 0
+    if pooltype == "SUM":
+        return jax.ops.segment_sum(x, segment_ids, num_segments=n)
+    if pooltype == "MEAN":
+        s = jax.ops.segment_sum(x, segment_ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype),
+                                  segment_ids, num_segments=n)
+        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (x.ndim - 1)]
+    if pooltype == "MAX":
+        return jax.ops.segment_max(x, segment_ids, num_segments=n)
+    if pooltype == "MIN":
+        return jax.ops.segment_min(x, segment_ids, num_segments=n)
+    raise ValueError(f"unknown pooltype {pooltype!r}")
+
+
+@primitive("positive_negative_pair_op", nondiff=True)
+def positive_negative_pair(score, label, query_id):
+    """reference: operators/positive_negative_pair_op.cc — LTR metric:
+    over same-query pairs with label_i > label_j, count score_i > score_j
+    (positive), < (negative), == (neutral). Returns three [1] counts."""
+    s = score.reshape(-1).astype(jnp.float32)
+    l = label.reshape(-1).astype(jnp.float32)
+    q = query_id.reshape(-1)
+    same_q = (q[:, None] == q[None, :])
+    higher = (l[:, None] > l[None, :]) & same_q
+    pos = jnp.sum(jnp.where(higher & (s[:, None] > s[None, :]), 1.0, 0.0))
+    neg = jnp.sum(jnp.where(higher & (s[:, None] < s[None, :]), 1.0, 0.0))
+    neu = jnp.sum(jnp.where(higher & (s[:, None] == s[None, :]), 1.0, 0.0))
+    return pos.reshape(1), neg.reshape(1), neu.reshape(1)
+
+
+@primitive("filter_by_instag_op", nondiff=True, dynamic=True)
+def filter_by_instag(x, ins_tags, filter_tags, *, out_val_if_empty=0):
+    """reference: operators/filter_by_instag_op.cc — CTR instance
+    filtering: keep rows whose tag set (padded with -1) intersects
+    filter_tags; returns (filtered rows, kept row indices, loss_weight).
+    Dynamic output size — eager path (the reference's is LoD-native)."""
+    tags = np.asarray(ins_tags)
+    want = set(np.asarray(filter_tags).reshape(-1).tolist())
+    keep = [i for i in range(tags.shape[0])
+            if want & set(t for t in tags[i].tolist() if t >= 0)]
+    if not keep:
+        out = jnp.full((1,) + tuple(x.shape[1:]), out_val_if_empty,
+                       x.dtype)
+        return out, jnp.zeros((1,), jnp.int64), jnp.zeros((1,), jnp.float32)
+    idx = jnp.asarray(np.asarray(keep, np.int64))
+    return (jnp.take(x, idx, axis=0), idx,
+            jnp.ones((len(keep),), jnp.float32))
+
+
+@primitive("beam_search_step_op", nondiff=True)
+def beam_search_step(pre_ids, pre_scores, scores, *, beam_size, end_id,
+                     is_accumulated=True):
+    """reference: operators/beam_search_op.cc, batched dense layout
+    instead of LoD: pre_ids [B, W], pre_scores [B, W], scores [B, W, V]
+    -> (selected token ids [B, W], total scores [B, W], parent beam
+    indices [B, W]).
+
+    is_accumulated=True (reference math/beam_search.cc:267): `scores`
+    already contain the accumulated beam totals and are used directly.
+    False: `scores` are per-step probabilities; total = pre_score +
+    log(score). Finished beams (pre_id == end_id) only extend with
+    end_id at their unchanged pre_score."""
+    B, W, V = scores.shape
+    if is_accumulated:
+        base = scores.astype(jnp.float32)
+    else:
+        base = (pre_scores[..., None].astype(jnp.float32)
+                + jnp.log(jnp.maximum(scores.astype(jnp.float32), 1e-30)))
+    finished = (pre_ids == end_id)                          # [B, W]
+    neg_inf = jnp.asarray(-1e30, jnp.float32)
+    is_end = (jnp.arange(V)[None, None, :] == end_id)
+    total = jnp.where(
+        finished[..., None],
+        jnp.where(is_end, pre_scores[..., None].astype(jnp.float32),
+                  neg_inf),
+        base)                                               # [B, W, V]
+    flat = total.reshape(B, W * V)
+    top_scores, top_idx = jax.lax.top_k(flat, W)            # [B, W]
+    parent = top_idx // V
+    token = (top_idx % V).astype(pre_ids.dtype)
+    return token, top_scores, parent
+
+
+@primitive("py_func_op", nondiff=True)
+def py_func_call(x, *, func, out_shape, out_dtype):
+    """reference: operators/py_func_op.cc — host-python escape hatch.
+    Under jit this lowers to jax.pure_callback with the declared result
+    spec; eager it is a plain call."""
+    spec = jax.ShapeDtypeStruct(tuple(out_shape), jnp.dtype(out_dtype))
+    return jax.pure_callback(
+        lambda a: np.asarray(func(np.asarray(a)), dtype=out_dtype)
+        .reshape(out_shape), spec, x)
+
+
+@primitive("data_norm_op")
+def data_norm(x, batch_size, batch_sum, batch_square_sum, *,
+              epsilon=1e-4):
+    """reference: operators/data_norm_op.cc (CTR feature normalization):
+    per-feature mean = batch_sum / batch_size and
+    scale = sqrt(batch_size / batch_square_sum); y = (x - mean) * scale.
+    The stat accumulators are inputs (the reference updates them
+    asynchronously through the PS; here the caller owns them)."""
+    bs = batch_size.astype(jnp.float32)
+    mean = batch_sum.astype(jnp.float32) / bs
+    scale = jnp.sqrt(bs / (batch_square_sum.astype(jnp.float32) + epsilon))
+    return ((x.astype(jnp.float32) - mean) * scale).astype(x.dtype)
+
+
+@primitive("linear_chain_crf_op")
+def linear_chain_crf(emission, transition, label, length):
+    """reference: operators/linear_chain_crf_op.cc — negative
+    log-likelihood of a linear-chain CRF.
+
+    emission [B, T, N] (unnormalized tag scores), transition [N+2, N]
+    (row 0 = start scores, row 1 = stop scores, rows 2.. = pairwise
+    transition[from, to], the reference's layout), label [B, T] int,
+    length [B] int. Returns nll [B, 1] = logZ - score(label path).
+    The partition function runs as a masked forward scan over T."""
+    B, T, N = emission.shape
+    em = emission.astype(jnp.float32)
+    start = transition[0].astype(jnp.float32)        # [N]
+    stop = transition[1].astype(jnp.float32)         # [N]
+    trans = transition[2:].astype(jnp.float32)       # [N, N]
+    lab = label.astype(jnp.int32)
+    ln = length.reshape(-1).astype(jnp.int32)
+
+    # ---- logZ via forward algorithm (masked beyond each length) ----
+    alpha0 = start[None, :] + em[:, 0, :]            # [B, N]
+
+    def step(alpha, inputs):
+        e_t, t_idx = inputs                          # [B, N], scalar
+        # alpha' = logsumexp_i(alpha_i + trans[i, j]) + e_j
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + trans[None, :, :], axis=1) + e_t
+        live = (t_idx < ln)[:, None]
+        return jnp.where(live, nxt, alpha), None
+
+    alphaT, _ = jax.lax.scan(
+        step, alpha0, (jnp.swapaxes(em, 0, 1)[1:], jnp.arange(1, T)))
+    logZ = jax.scipy.special.logsumexp(
+        alphaT + stop[None, :], axis=1)               # [B]
+
+    # ---- gold path score ----
+    first = start[lab[:, 0]] + em[:, 0, :][jnp.arange(B), lab[:, 0]]
+
+    def gold_step(acc, inputs):
+        e_t, y_prev, y_cur, t_idx = inputs
+        sc = trans[y_prev, y_cur] + e_t[jnp.arange(B), y_cur]
+        live = t_idx < ln
+        return acc + jnp.where(live, sc, 0.0), None
+
+    gold, _ = jax.lax.scan(
+        gold_step, first,
+        (jnp.swapaxes(em, 0, 1)[1:], jnp.swapaxes(lab, 0, 1)[:-1],
+         jnp.swapaxes(lab, 0, 1)[1:], jnp.arange(1, T)))
+    last_tag = lab[jnp.arange(B), ln - 1]
+    gold = gold + stop[last_tag]
+    return (logZ - gold).reshape(B, 1)
+
+
+@primitive("hash_op", nondiff=True)
+def hash_bucket(x, *, num_hash=1, mod_by=100000007):
+    """reference: operators/hash_op.cc — bucketed integer hashing of id
+    features (CTR): out[..., k] = hash_k(x) % mod_by. XXHash is replaced
+    by a splitmix64-style mix per hash index — the contract (stable
+    int -> [0, mod_by) buckets, num_hash independent functions) is what
+    models rely on, not the exact hash family."""
+    ids = x.astype(jnp.uint64)
+    outs = []
+    for k in range(int(num_hash)):
+        h = ids + jnp.uint64((0x9E3779B97F4A7C15 * (k + 1)) % (1 << 64))
+        h = (h ^ (h >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+        h = (h ^ (h >> 27)) * jnp.uint64(0x94D049BB133111EB)
+        h = h ^ (h >> 31)
+        outs.append((h % jnp.uint64(mod_by)).astype(jnp.int64))
+    return jnp.stack(outs, axis=-1)
+
+
+@primitive("gather_tree_op", nondiff=True)
+def gather_tree(ids, parents):
+    """reference: operators/gather_tree_op.cc (python
+    nn.functional.gather_tree): backtrace full beam hypotheses from the
+    per-step (token, parent) records. ids/parents [T, B, W] -> [T, B, W]
+    where out[:, b, w] is the token path ending at beam w."""
+    T_, B, W = ids.shape
+
+    def step(beam, t):
+        # beam [B, W]: which beam slot each final hypothesis occupied at
+        # step t+1; move to its parent at step t
+        tok = jnp.take_along_axis(ids[t], beam, axis=1)
+        par = jnp.take_along_axis(parents[t], beam, axis=1)
+        return par.astype(beam.dtype), tok
+
+    beam0 = jnp.tile(jnp.arange(W)[None, :], (B, 1)).astype(parents.dtype)
+    _, toks = jax.lax.scan(step, beam0, jnp.arange(T_ - 1, -1, -1))
+    return toks[::-1]
+
+
+@primitive("fill_diagonal_op")
+def fill_diagonal(x, *, value=0.0, offset=0, wrap=False):
+    """reference: operators/fill_diagonal_op.cc — set the (offset)
+    diagonal of a matrix to `value`. Non-wrap fills only within the
+    leading W x W region; wrap restarts the diagonal every W+1 rows down
+    a tall matrix. Entries whose column would leave the row are skipped
+    (both per the reference kernel). Shapes are static, so the position
+    mask is built host-side."""
+    n, m = x.shape[-2], x.shape[-1]
+    mask = np.zeros((n, m), bool)
+    starts = range(0, n, m + 1) if wrap else [0]
+    for start in starts:
+        for k in range(m if wrap else min(n, m)):
+            r, c = start + k, k + offset
+            if r < n and 0 <= c < m:
+                mask[r, c] = True
+    return jnp.where(jnp.asarray(mask), jnp.asarray(value, x.dtype), x)
+
+
+@primitive("space_to_depth_op")
+def space_to_depth(x, *, blocksize):
+    """reference: operators/space_to_depth_op.cc — NCHW block-major
+    packing: output channel index = (fy*r + fx)*C + c (the reference's
+    ordering, which DIFFERS from pixel_unshuffle's (c, fy, fx) — models
+    ported between the two would load conv weights against permuted
+    channels)."""
+    r = int(blocksize)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    out = out.transpose(0, 3, 5, 1, 2, 4)     # n, fy, fx, c, h2, w2
+    return out.reshape(n, r * r * c, h // r, w // r)

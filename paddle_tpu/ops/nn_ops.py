@@ -742,6 +742,22 @@ def pixel_shuffle(x, *, upscale_factor, channel_last=False):
     return out.reshape(n, c // (r * r), h * r, w * r)
 
 
+@primitive("pixel_unshuffle_op")
+def pixel_unshuffle(x, *, downscale_factor, channel_last=False):
+    """Inverse of pixel_shuffle (reference: space_to_depth_op.cc /
+    pixel_unshuffle): blocks of r x r pixels move into channels."""
+    r = downscale_factor
+    if channel_last:
+        n, h, w, c = x.shape
+        out = x.reshape(n, h // r, r, w // r, r, c)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h // r, w // r, c * r * r)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    out = out.transpose(0, 1, 3, 5, 2, 4)
+    return out.reshape(n, c * r * r, h // r, w // r)
+
+
 @primitive("channel_shuffle_op")
 def channel_shuffle(x, *, groups, channel_last=False):
     if channel_last:

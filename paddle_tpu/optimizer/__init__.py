@@ -696,6 +696,92 @@ class Ftrl(Optimizer):
         return new_p.astype(param.dtype), new_sq, lin
 
 
+class DecayedAdagrad(Optimizer):
+    """reference: operators/optimizers/decayed_adagrad_op.cc — Adagrad
+    with an exponentially decayed squared-gradient accumulator."""
+
+    _accumulator_names = ["moment"]
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._decay = float(decay)
+        self._epsilon = float(epsilon)
+
+    def _static_args(self):
+        return (self._decay, self._epsilon)
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.zeros(p._data.shape, jnp.float32)}
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, moment):
+        decay, eps = static_args
+        g = grad.astype(jnp.float32)
+        mn = decay * moment + (1.0 - decay) * jnp.square(g)
+        return (param.astype(jnp.float32)
+                - lr * g / (jnp.sqrt(mn) + eps)).astype(param.dtype), mn
+
+
+def _proximal_shrink(prox, lr, l1, l2):
+    """Closed-form proximal operator of lr*(l1|w|_1 + l2/2 |w|_2^2)."""
+    return (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+            / (1.0 + lr * l2))
+
+
+class ProximalGD(Optimizer):
+    """reference: operators/optimizers/proximal_gd_op.cc — SGD followed
+    by the l1/l2 proximal shrink."""
+
+    _accumulator_names = []
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+
+    def _static_args(self):
+        return (self._l1, self._l2)
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t):
+        l1, l2 = static_args
+        prox = param.astype(jnp.float32) - lr * grad.astype(jnp.float32)
+        return _proximal_shrink(prox, lr, l1, l2).astype(param.dtype),
+
+
+class ProximalAdagrad(Optimizer):
+    """reference: operators/optimizers/proximal_adagrad_op.cc — Adagrad
+    step with the l1/l2 proximal shrink at the adapted learning rate."""
+
+    _accumulator_names = ["moment"]
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+        self._epsilon = float(epsilon)
+
+    def _static_args(self):
+        return (self._l1, self._l2, self._epsilon)
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.zeros(p._data.shape, jnp.float32)}
+
+    @staticmethod
+    def _update_rule(static_args, param, grad, lr, t, moment):
+        l1, l2, eps = static_args
+        g = grad.astype(jnp.float32)
+        mn = moment + jnp.square(g)
+        alr = lr / (jnp.sqrt(mn) + eps)
+        prox = param.astype(jnp.float32) - alr * g
+        return _proximal_shrink(prox, alr, l1, l2).astype(param.dtype), mn
+
+
 @functools.lru_cache(maxsize=None)
 def _dpsgd_exec(clip, batch_size):
     def fn(param, grad, lr, noise):

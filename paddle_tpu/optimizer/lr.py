@@ -319,3 +319,28 @@ class OneCycleLR(LRScheduler):
                                 step / max(up_steps, 1))
         return self._interp(self.max_lr, self.end_lr,
                             (step - up_steps) / max(self.total_steps - up_steps, 1))
+
+
+class Pow2DecayWithLinearWarmup(LRScheduler):
+    """reference: operators/optimizers/pow2_decay_with_linear_warmup_op.cc
+    (python: paddle.optimizer.lr in later versions): linear warmup from
+    0 to base_lr over `warmup_steps`, then quadratic decay to `end_lr`
+    at `total_steps`."""
+
+    def __init__(self, warmup_steps, total_steps, base_lr, end_lr,
+                 last_epoch=-1, verbose=False):
+        if total_steps < warmup_steps:
+            raise ValueError("total_steps must be >= warmup_steps")
+        self.warmup_steps = int(warmup_steps)
+        self.total_steps = int(total_steps)
+        self.base_lr = float(base_lr)
+        self.end_lr = float(end_lr)
+        super().__init__(base_lr, last_epoch, verbose)
+
+    def get_lr(self):
+        step = self.last_epoch
+        if step < self.warmup_steps:
+            return self.base_lr * (step / max(1, self.warmup_steps))
+        frac = 1.0 - (min(step, self.total_steps) - self.warmup_steps) \
+            / max(1, self.total_steps - self.warmup_steps)
+        return self.end_lr + (self.base_lr - self.end_lr) * frac * frac

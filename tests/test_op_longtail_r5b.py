@@ -1,0 +1,430 @@
+"""r5 honest-audit op batch: ops surfaced as real misses by MULTI-SEED
+samples of the reference register sites (tools/op_sample_check.py seeds
+1/7/42/123/999 — the seed-60 sample alone read 100% while others read
+~58%): squared_l2_norm, hinge_loss, rank_loss, bpr_loss, fsp_matrix,
+pad_constant_like, shuffle_batch, conv_shift, row_conv, correlation,
+segment_pool family, positive_negative_pair, filter_by_instag,
+beam_search (dense layout), py_func, and the DecayedAdagrad /
+ProximalGD / ProximalAdagrad optimizers. Oracles: the reference kernels'
+formulas (hinge_loss_op.h, rank_loss_op.h, bpr_loss_op.h, fsp_op.h,
+conv_shift_op.h, row_conv_op.h, segment_pool_op.h,
+optimizers/decayed_adagrad_op.h, proximal_gd_op.h,
+proximal_adagrad_op.h)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers as L
+
+
+def T(a, stop_gradient=True):
+    t = paddle.to_tensor(np.asarray(a))
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def num_grad(fn, x, eps=1e-3):
+    """Central-difference dL/dx for scalar-reducing fn."""
+    g = np.zeros_like(x)
+    for i in np.ndindex(*x.shape):
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (fn(xp) - fn(xm)) / (2 * eps)
+    return g
+
+
+class TestSimpleLosses:
+    def test_squared_l2_norm(self):
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        out = L.squared_l2_norm(T(x)).numpy()
+        np.testing.assert_allclose(out, [np.sum(x * x)], rtol=1e-5)
+
+    def test_squared_l2_norm_grad(self):
+        x = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+        xt = T(x, stop_gradient=False)
+        L.squared_l2_norm(xt).backward()
+        np.testing.assert_allclose(xt.grad.numpy(), 2 * x, rtol=1e-4)
+
+    def test_hinge_loss(self):
+        rs = np.random.RandomState(2)
+        logits = rs.randn(6, 1).astype(np.float32)
+        labels = rs.randint(0, 2, (6, 1)).astype(np.float32)
+        out = L.hinge_loss(T(logits), T(labels)).numpy()
+        ref = np.maximum(0.0, 1.0 - (2 * labels - 1) * logits)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_rank_loss_formula_and_grad(self):
+        rs = np.random.RandomState(3)
+        left = rs.randn(5, 1).astype(np.float32)
+        right = rs.randn(5, 1).astype(np.float32)
+        label = rs.randint(0, 2, (5, 1)).astype(np.float32)
+        lt = T(left, stop_gradient=False)
+        out = L.rank_loss(T(label), lt, T(right))
+        d = left - right
+        ref = np.log1p(np.exp(-np.abs(d))) + np.maximum(d, 0) - label * d
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+        out.backward(paddle.ones_like(out))
+        ref_g = num_grad(
+            lambda lv: float(np.sum(np.log1p(np.exp(lv - right))
+                                    - label * (lv - right))), left)
+        np.testing.assert_allclose(lt.grad.numpy(), ref_g, rtol=2e-2,
+                                   atol=2e-3)
+
+    def test_bpr_loss(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(4, 5).astype(np.float32)
+        y = rs.randint(0, 5, (4, 1)).astype(np.int64)
+        out = L.bpr_loss(T(x), T(y)).numpy()
+        ref = np.zeros((4, 1), np.float32)
+        for n in range(4):
+            yn = int(y[n, 0])
+            s = 0.0
+            for j in range(5):
+                if j != yn:
+                    d = x[n, yn] - x[n, j]
+                    s += np.log1p(np.exp(-d))
+            ref[n, 0] = s / 4.0
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+class TestShapeOps:
+    def test_fsp_matrix(self):
+        rs = np.random.RandomState(5)
+        x = rs.randn(2, 3, 4, 5).astype(np.float32)
+        y = rs.randn(2, 6, 4, 5).astype(np.float32)
+        out = L.fsp_matrix(T(x), T(y)).numpy()
+        ref = np.einsum("bihw,bjhw->bij", x, y) / 20.0
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_pad_constant_like(self):
+        x = np.zeros((4, 5), np.float32)
+        y = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = L.pad_constant_like(T(x), T(y), pad_value=7.0).numpy()
+        assert out.shape == (4, 5)
+        np.testing.assert_allclose(out[:2, :3], y)
+        assert (out[2:, :] == 7.0).all() and (out[:, 3:] == 7.0).all()
+
+    def test_shuffle_batch_permutes_and_preserves_rows(self):
+        paddle.seed(0)
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        out, order = L.shuffle_batch(T(x))
+        o, p = out.numpy(), order.numpy()
+        np.testing.assert_allclose(np.sort(p), np.arange(10))
+        np.testing.assert_allclose(o, x[p])
+
+    def test_conv_shift(self):
+        rs = np.random.RandomState(6)
+        x = rs.randn(2, 7).astype(np.float32)
+        y = rs.randn(2, 3).astype(np.float32)
+        out = L.conv_shift(T(x), T(y)).numpy()
+        ref = np.zeros_like(x)
+        for b in range(2):
+            for i in range(7):
+                for j in range(3):
+                    ref[b, i] += x[b, (i + j - 1) % 7] * y[b, j]
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_row_conv_and_grad(self):
+        rs = np.random.RandomState(7)
+        x = rs.randn(2, 5, 3).astype(np.float32)
+        f = rs.randn(2, 3).astype(np.float32)
+        xt, ft = T(x, stop_gradient=False), T(f, stop_gradient=False)
+        out = L.row_conv(xt, filter=ft)
+        ref = np.zeros_like(x)
+        for i in range(2):
+            for t in range(5):
+                for k in range(2):
+                    if t + k < 5:
+                        ref[i, t] += x[i, t + k] * f[k]
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+        paddle.sum(out * out).backward()
+        assert ft.grad is not None and np.isfinite(ft.grad.numpy()).all()
+
+    def test_correlation_center_is_mean_dot(self):
+        rs = np.random.RandomState(8)
+        x1 = rs.randn(1, 4, 6, 6).astype(np.float32)
+        x2 = rs.randn(1, 4, 6, 6).astype(np.float32)
+        out = L.correlation(T(x1), T(x2), max_displacement=2,
+                            pad_size=2).numpy()
+        assert out.shape == (1, 25, 6, 6)
+        center = out[0, 12]  # (dy, dx) == (0, 0)
+        ref = np.mean(x1[0] * x2[0], axis=0)
+        np.testing.assert_allclose(center, ref, rtol=1e-4)
+
+
+class TestSegmentPool:
+    def test_all_pooltypes(self):
+        import paddle_tpu.incubate as inc
+        x = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+        ids = np.array([0, 0, 1, 1])
+        np.testing.assert_allclose(
+            inc.segment_sum(T(x), T(ids)).numpy(), [[4, 6], [12, 14]])
+        np.testing.assert_allclose(
+            inc.segment_mean(T(x), T(ids)).numpy(), [[2, 3], [6, 7]])
+        np.testing.assert_allclose(
+            inc.segment_max(T(x), T(ids)).numpy(), [[3, 4], [7, 8]])
+        np.testing.assert_allclose(
+            inc.segment_min(T(x), T(ids)).numpy(), [[1, 2], [5, 6]])
+
+    def test_softmax_mask_fuse(self):
+        import paddle_tpu.incubate as inc
+        rs = np.random.RandomState(9)
+        x = rs.randn(2, 2, 4, 4).astype(np.float32)
+        mask = np.where(rs.rand(2, 1, 4, 4) > 0.5, 0.0, -1e30
+                        ).astype(np.float32)
+        out = inc.softmax_mask_fuse(T(x), T(mask)).numpy()
+        e = np.exp(x + mask - (x + mask).max(-1, keepdims=True))
+        np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-4, atol=1e-6)
+        tri = inc.softmax_mask_fuse_upper_triangle(T(x)).numpy()
+        assert np.allclose(np.triu(tri[0, 0], k=1), 0.0, atol=1e-8)
+        np.testing.assert_allclose(tri.sum(-1), np.ones((2, 2, 4)),
+                                   rtol=1e-5)
+
+
+class TestMetricsAndMisc:
+    def test_positive_negative_pair(self):
+        score = np.array([0.9, 0.2, 0.8, 0.4], np.float32)
+        label = np.array([1.0, 0.0, 0.0, 1.0], np.float32)
+        qid = np.array([0, 0, 1, 1], np.int64)
+        pos, neg, neu = L.positive_negative_pair(T(score), T(label), T(qid))
+        # q0: (i=0 over j=1): 0.9 > 0.2 -> positive
+        # q1: (i=3 over j=2): 0.4 < 0.8 -> negative
+        assert float(pos.numpy()[0]) == 1.0
+        assert float(neg.numpy()[0]) == 1.0
+        assert float(neu.numpy()[0]) == 0.0
+
+    def test_filter_by_instag(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        tags = np.array([[1, -1], [2, 3], [4, -1], [3, 4]], np.int64)
+        out, idx, w = L.filter_by_instag(T(x), T(tags), T(np.array([3])))
+        np.testing.assert_allclose(idx.numpy(), [1, 3])
+        np.testing.assert_allclose(out.numpy(), x[[1, 3]])
+        np.testing.assert_allclose(w.numpy(), [1.0, 1.0])
+
+    def test_beam_search_step_probabilities(self):
+        """is_accumulated=False: scores are per-step probabilities,
+        total = pre_score + log(p) (reference math/beam_search.cc)."""
+        # B=1, W=2, V=4; end_id=3
+        pre_ids = np.array([[1, 3]], np.int64)      # beam 1 finished
+        pre_scores = np.array([[-0.5, -0.1]], np.float32)
+        probs = np.array([[[0.1, 0.6, 0.2, 0.1],
+                           [0.25, 0.25, 0.25, 0.25]]], np.float32)
+        token, total, parent = L.beam_search(
+            T(pre_ids), T(pre_scores), None, T(probs), beam_size=2,
+            end_id=3, is_accumulated=False)
+        # finished beam 1 extends only with end_id at unchanged score -0.1
+        # (the top hypothesis); live beam 0 contributes its best token 1
+        assert token.numpy()[0, 0] == 3 and parent.numpy()[0, 0] == 1
+        np.testing.assert_allclose(total.numpy()[0, 0], -0.1, rtol=1e-5)
+        assert token.numpy()[0, 1] == 1 and parent.numpy()[0, 1] == 0
+        np.testing.assert_allclose(total.numpy()[0, 1],
+                                   -0.5 + np.log(0.6), rtol=1e-5)
+
+    def test_beam_search_step_accumulated(self):
+        """is_accumulated=True (default): scores ARE the totals — used
+        directly, no pre_score double-count."""
+        pre_ids = np.array([[1, 2]], np.int64)      # both live
+        pre_scores = np.array([[-0.5, -0.4]], np.float32)
+        totals = np.array([[[-9., -1., -9., -9.],
+                            [-9., -9., -2., -9.]]], np.float32)
+        token, total, parent = L.beam_search(
+            T(pre_ids), T(pre_scores), None, T(totals), beam_size=2,
+            end_id=3)
+        assert token.numpy()[0, 0] == 1 and parent.numpy()[0, 0] == 0
+        np.testing.assert_allclose(total.numpy()[0, 0], -1.0, rtol=1e-6)
+        assert token.numpy()[0, 1] == 2 and parent.numpy()[0, 1] == 1
+        np.testing.assert_allclose(total.numpy()[0, 1], -2.0, rtol=1e-6)
+
+    def test_space_to_depth_reference_channel_order(self):
+        from paddle_tpu.ops.misc_ops import space_to_depth
+        rs = np.random.RandomState(20)
+        x = rs.randn(1, 2, 4, 4).astype(np.float32)
+        out = space_to_depth(T(x), blocksize=2).numpy()
+        assert out.shape == (1, 8, 2, 2)
+        # channel index = (fy*r + fx)*C + c — reference block-major order
+        for fy in range(2):
+            for fx in range(2):
+                for c in range(2):
+                    k = (fy * 2 + fx) * 2 + c
+                    np.testing.assert_allclose(
+                        out[0, k], x[0, c, fy::2, fx::2])
+
+    def test_fill_diagonal_wrap_and_bounds(self):
+        from paddle_tpu.ops.misc_ops import fill_diagonal
+        # tall wrap: diagonal restarts every W+1 rows
+        x = np.zeros((7, 3), np.float32)
+        out = fill_diagonal(T(x), value=1.0, wrap=True).numpy()
+        want = np.zeros((7, 3), np.float32)
+        for start in (0, 4):
+            for k in range(3):
+                if start + k < 7:
+                    want[start + k, k] = 1.0
+        np.testing.assert_allclose(out, want)
+        # non-wrap, far negative offset: nothing inside the W x W region
+        out2 = fill_diagonal(T(np.zeros((10, 3), np.float32)),
+                             value=1.0, offset=-5).numpy()
+        assert out2.sum() == 0.0
+
+    def test_py_func_eager_and_jit(self):
+        import jax
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = L.py_func(lambda a: a * 2 + 1, T(x), out_shape=(2, 3))
+        np.testing.assert_allclose(out.numpy(), x * 2 + 1)
+
+        def traced(arr):
+            t = paddle.Tensor(arr, _internal=True)
+            return L.py_func(lambda a: a * 2 + 1, t,
+                             out_shape=(2, 3))._data
+
+        outj = jax.jit(traced)(x)
+        np.testing.assert_allclose(np.asarray(outj), x * 2 + 1)
+
+
+class TestFluidOptimizers:
+    def _train(self, opt_cls, **kw):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([1.0, -2.0, 0.5], np.float32))
+        w.stop_gradient = False
+        opt = opt_cls(learning_rate=0.1, parameters=[w], **kw)
+        for _ in range(3):
+            loss = paddle.sum(w * w)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return w.numpy()
+
+    def test_decayed_adagrad_matches_reference_rule(self):
+        out = self._train(fluid.optimizer.DecayedAdagrad, decay=0.95,
+                          epsilon=1e-6)
+        w = np.array([1.0, -2.0, 0.5], np.float32)
+        m = np.zeros_like(w)
+        for _ in range(3):
+            g = 2 * w
+            m = 0.95 * m + 0.05 * g * g
+            w = w - 0.1 * g / (np.sqrt(m) + 1e-6)
+        np.testing.assert_allclose(out, w, rtol=1e-5)
+
+    def test_proximal_gd_shrinks_to_zero(self):
+        out = self._train(fluid.optimizer.ProximalGD, l1=0.5, l2=0.1)
+        w = np.array([1.0, -2.0, 0.5], np.float32)
+        for _ in range(3):
+            g = 2 * w
+            prox = w - 0.1 * g
+            w = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * 0.5, 0.0) \
+                / (1.0 + 0.1 * 0.1)
+        np.testing.assert_allclose(out, w, rtol=1e-5)
+
+    def test_proximal_adagrad_matches_reference_rule(self):
+        out = self._train(fluid.optimizer.ProximalAdagrad, l1=0.01,
+                          l2=0.01, epsilon=1e-6)
+        w = np.array([1.0, -2.0, 0.5], np.float32)
+        m = np.zeros_like(w)
+        for _ in range(3):
+            g = 2 * w
+            m = m + g * g
+            alr = 0.1 / (np.sqrt(m) + 1e-6)
+            prox = w - alr * g
+            w = np.sign(prox) * np.maximum(np.abs(prox) - alr * 0.01, 0.0) \
+                / (1.0 + alr * 0.01)
+        np.testing.assert_allclose(out, w, rtol=1e-5)
+
+
+class TestSecondBatch:
+    def test_pixel_unshuffle_roundtrip(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(10)
+        x = rs.randn(2, 3, 4, 6).astype(np.float32)
+        down = F.pixel_unshuffle(T(x), 2)
+        assert tuple(down.shape) == (2, 12, 2, 3)
+        up = F.pixel_shuffle(down, 2)
+        np.testing.assert_allclose(up.numpy(), x, rtol=1e-6)
+
+    def test_data_norm(self):
+        rs = np.random.RandomState(11)
+        x = rs.randn(4, 3).astype(np.float32)
+        bs = np.full((3,), 10.0, np.float32)
+        bsum = rs.randn(3).astype(np.float32) * 10
+        bsq = np.abs(rs.randn(3)).astype(np.float32) * 10 + 5
+        out = L.data_norm(T(x), T(bs), T(bsum), T(bsq)).numpy()
+        mean = bsum / bs
+        scale = np.sqrt(bs / (bsq + 1e-4))
+        np.testing.assert_allclose(out, (x - mean) * scale, rtol=1e-4)
+
+    def test_linear_chain_crf_matches_bruteforce(self):
+        from itertools import product
+        rs = np.random.RandomState(12)
+        B, T_, N = 2, 3, 3
+        em = rs.randn(B, T_, N).astype(np.float32)
+        tr = rs.randn(N + 2, N).astype(np.float32)
+        lab = rs.randint(0, N, (B, T_)).astype(np.int64)
+        length = np.array([3, 2], np.int64)
+        nll = L.linear_chain_crf(T(em), T(tr), T(lab), T(length)).numpy()
+
+        def path_score(b, path):
+            s = tr[0, path[0]] + em[b, 0, path[0]]
+            for t in range(1, len(path)):
+                s += tr[2 + path[t - 1], path[t]] + em[b, t, path[t]]
+            return s + tr[1, path[-1]]
+
+        for b in range(B):
+            ln = int(length[b])
+            logZ = np.log(sum(
+                np.exp(path_score(b, p))
+                for p in product(range(N), repeat=ln)))
+            gold = path_score(b, lab[b, :ln].tolist())
+            np.testing.assert_allclose(nll[b, 0], logZ - gold, rtol=1e-4)
+
+    def test_linear_chain_crf_grad_flows(self):
+        rs = np.random.RandomState(13)
+        em = T(rs.randn(2, 3, 4).astype(np.float32), stop_gradient=False)
+        tr = T(rs.randn(6, 4).astype(np.float32), stop_gradient=False)
+        lab = T(rs.randint(0, 4, (2, 3)).astype(np.int64))
+        ln = T(np.array([3, 3], np.int64))
+        paddle.sum(L.linear_chain_crf(em, tr, lab, ln)).backward()
+        assert em.grad is not None and np.isfinite(em.grad.numpy()).all()
+        assert tr.grad is not None and np.isfinite(tr.grad.numpy()).all()
+
+    def test_gather_tree(self):
+        import paddle_tpu.nn.functional as F
+        # T=3, B=1, W=2
+        ids = np.array([[[2, 5]], [[3, 6]], [[4, 7]]], np.int64)
+        parents = np.array([[[0, 1]], [[0, 0]], [[1, 0]]], np.int64)
+        out = F.gather_tree(T(ids), T(parents)).numpy()
+        # final beam 0 at t=2: token 4, parent 1 -> t=1 beam1 token 6,
+        # parent 0 -> t=0 beam0 token 2
+        np.testing.assert_allclose(out[:, 0, 0], [2, 6, 4])
+        # final beam 1 at t=2: token 7, parent 0 -> t=1 beam0 token 3,
+        # parent 0 -> t=0 beam0 token 2
+        np.testing.assert_allclose(out[:, 0, 1], [2, 3, 7])
+
+    def test_fill_diagonal(self):
+        from paddle_tpu.ops.misc_ops import fill_diagonal
+        x = np.zeros((3, 4), np.float32)
+        out = fill_diagonal(T(x), value=5.0).numpy()
+        assert (np.diagonal(out) == 5.0).all()
+        assert out.sum() == 15.0
+
+    def test_hash_bucket(self):
+        from paddle_tpu.ops.misc_ops import hash_bucket
+        ids = np.array([1, 2, 3, 1], np.int64)
+        out = hash_bucket(T(ids), num_hash=2, mod_by=1000).numpy()
+        assert out.shape == (4, 2)
+        assert (out >= 0).all() and (out < 1000).all()
+        np.testing.assert_allclose(out[0], out[3])  # deterministic
+        assert (out[0] != out[1]).any()
+
+    def test_pow2_decay_with_linear_warmup(self):
+        from paddle_tpu.optimizer.lr import Pow2DecayWithLinearWarmup
+        sch = Pow2DecayWithLinearWarmup(warmup_steps=4, total_steps=8,
+                                        base_lr=1.0, end_lr=0.1)
+        lrs = []
+        for _ in range(9):
+            lrs.append(sch.get_lr())
+            sch.step()
+        np.testing.assert_allclose(lrs[0], 0.0)
+        np.testing.assert_allclose(lrs[2], 0.5)
+        np.testing.assert_allclose(lrs[4], 1.0)     # warmup done
+        np.testing.assert_allclose(lrs[8], 0.1, rtol=1e-6)  # end_lr
+        assert all(lrs[i] >= lrs[i + 1] for i in range(4, 8))
