@@ -509,8 +509,8 @@ def filter_by_instag(x, ins_tags, filter_tags, *, out_val_if_empty=0):
 
 
 @primitive("beam_search_step_op", nondiff=True)
-def beam_search_step(pre_ids, pre_scores, scores, *, beam_size, end_id,
-                     is_accumulated=True):
+def beam_search_step(pre_ids, pre_scores, scores, *, beam_size=None,
+                     end_id=0, is_accumulated=True):
     """reference: operators/beam_search_op.cc, batched dense layout
     instead of LoD: pre_ids [B, W], pre_scores [B, W], scores [B, W, V]
     -> (selected token ids [B, W], total scores [B, W], parent beam

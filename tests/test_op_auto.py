@@ -414,6 +414,27 @@ SPECS.update({
                    fixed_ratios=(1.0,), variances=(0.1, 0.1, 0.2, 0.2))),
     "ctc_align_op": dict(
         in_=[I64(4, (2, 6)), CONST(np.full((2, 1), 6, np.int64))]),
+    # r5 honest-audit batch
+    "beam_search_step_op": dict(
+        in_=[I64(4, (1, 2)), U(-1.0, 0.0, (1, 2)), U(-2.0, 0.0, (1, 2, 4))],
+        attrs={"end_id": 3}),
+    "bpr_loss_op": dict(in_=[U(-1, 1, (4, 5)), I64(5, (4, 1))], grad=[0]),
+    "correlation_op": dict(
+        in_=[U(-1, 1, (1, 2, 6, 6)), U(-1, 1, (1, 2, 6, 6))],
+        attrs={"max_displacement": 2, "pad_size": 2}),
+    "fsp_op": dict(in_=[U(-1, 1, (2, 3, 4, 5)), U(-1, 1, (2, 6, 4, 5))]),
+    "gather_tree_op": dict(
+        in_=[I64(5, (3, 1, 2)),
+             CONST(np.array([[[0, 1]], [[1, 0]], [[0, 0]]], np.int64))]),
+    "linear_chain_crf_op": dict(
+        in_=[U(-1, 1, (2, 3, 4)), U(-1, 1, (6, 4)), I64(4, (2, 3)),
+             CONST(np.array([3, 2], np.int64))],
+        grad=[0, 1]),
+    "pixel_unshuffle_op": dict(in_=[U(-1, 1, (1, 4, 4, 6))],
+                               attrs={"downscale_factor": 2}),
+    "row_conv_op": dict(in_=[U(-1, 1, (2, 5, 3)), U(-1, 1, (2, 3))]),
+    "space_to_depth_op": dict(in_=[U(-1, 1, (1, 2, 4, 4))],
+                              attrs={"blocksize": 2}),
 })
 
 
