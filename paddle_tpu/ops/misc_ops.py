@@ -509,8 +509,8 @@ def filter_by_instag(x, ins_tags, filter_tags, *, out_val_if_empty=0):
 
 
 @primitive("beam_search_step_op", nondiff=True)
-def beam_search_step(pre_ids, pre_scores, scores, *, beam_size=None,
-                     end_id=0, is_accumulated=True):
+def beam_search_step(pre_ids, pre_scores, scores, *, beam_size, end_id,
+                     is_accumulated=True):
     """reference: operators/beam_search_op.cc, batched dense layout
     instead of LoD: pre_ids [B, W], pre_scores [B, W], scores [B, W, V]
     -> (selected token ids [B, W], total scores [B, W], parent beam
@@ -522,6 +522,11 @@ def beam_search_step(pre_ids, pre_scores, scores, *, beam_size=None,
     log(score). Finished beams (pre_id == end_id) only extend with
     end_id at their unchanged pre_score."""
     B, W, V = scores.shape
+    if beam_size not in (None, W):
+        raise ValueError(
+            f"beam_search_step: beam_size={beam_size} does not match the "
+            f"beam dim of scores {scores.shape} — the dense layout takes "
+            "W from the shapes")
     if is_accumulated:
         base = scores.astype(jnp.float32)
     else:

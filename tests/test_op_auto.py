@@ -417,7 +417,7 @@ SPECS.update({
     # r5 honest-audit batch
     "beam_search_step_op": dict(
         in_=[I64(4, (1, 2)), U(-1.0, 0.0, (1, 2)), U(-2.0, 0.0, (1, 2, 4))],
-        attrs={"end_id": 3}),
+        attrs={"beam_size": 2, "end_id": 3}),
     "bpr_loss_op": dict(in_=[U(-1, 1, (4, 5)), I64(5, (4, 1))], grad=[0]),
     "correlation_op": dict(
         in_=[U(-1, 1, (1, 2, 6, 6)), U(-1, 1, (1, 2, 6, 6))],

@@ -46,17 +46,13 @@ WHITE_LIST = {
                       "position-sensitivity test in test_detection_ops"),
     "yolov3_loss_op": ("dedicated — gt/anchor assignment contract; "
                        "training + invariant tests in test_detection_ops"),
-    # rng
-    "alpha_dropout_op": "rng",
-    "shuffle_batch_op": "rng",
-    "segment_pool_op": ("dynamic — output rows = max(segment_ids)+1; "
-                        "all four pooltypes pinned in "
-                        "test_op_longtail_r5b.TestSegmentPool"),
-    "filter_by_instag_op": ("dynamic — kept-row count is data-dependent; "
-                            "covered in test_op_longtail_r5b"),
     "py_func_op": ("dedicated — host-callback with a function attr the "
                    "generic harness cannot synthesize; eager + jit paths "
                    "in test_op_longtail_r5b"),
+    # rng
+    "alpha_dropout_op": "rng",
+    "shuffle_batch_op": "rng (permutation key input); order/rows pinned "
+                        "in test_op_longtail_r5b",
     "bernoulli_op": "rng",
     "dropout_op": "rng",
     "exponential_op": "rng",
@@ -72,6 +68,11 @@ WHITE_LIST = {
     "fused_bias_dropout_residual": "rng; dedicated coverage in test_pallas_fused + transformer tests",
     "rnn": "rng (dropout key) + list weights; parity in test_rnn_transformer",
     # dynamic shapes
+    "segment_pool_op": ("dynamic — output rows = max(segment_ids)+1; "
+                        "all four pooltypes pinned in "
+                        "test_op_longtail_r5b.TestSegmentPool"),
+    "filter_by_instag_op": ("dynamic — kept-row count is data-dependent; "
+                            "covered in test_op_longtail_r5b"),
     "masked_select": "dynamic",
     "bincount_op": "dynamic (output length = max value); covered in test_tensor",
     "nonzero": "dynamic",
