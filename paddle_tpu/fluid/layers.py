@@ -541,6 +541,17 @@ def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
 # here instead of LoD; see ops/misc_ops.py beam_search_step docstring).
 
 
+def _seeded_key(seed):
+    """PRNGKey from an explicit seed, else the framework RNG stream —
+    shared by the seed-taking fluid layers (shuffle_batch, nce)."""
+    import jax as _jax
+    from ..framework.random import RNG
+    from ..framework.tensor import Tensor as _T
+    key = (_jax.random.PRNGKey(int(seed)) if seed is not None
+           else RNG.next_key())
+    return key if isinstance(key, Tensor) else _T(key, _internal=True)
+
+
 def squared_l2_norm(x):
     from ..ops.misc_ops import squared_l2_norm as _op
     return _op(x)
@@ -574,15 +585,8 @@ def pad_constant_like(x, y, pad_value=0.0, name=None):
 def shuffle_batch(x, seed=None):
     """Random batch-dim permutation; returns (shuffled, order). Seeded
     from the framework RNG (paddle.seed) unless `seed` is given."""
-    import jax as _jax
-    from ..framework.random import RNG
     from ..ops.misc_ops import shuffle_batch as _op
-    from ..framework.tensor import Tensor as _T
-    key = (_jax.random.PRNGKey(int(seed)) if seed is not None
-           else RNG.next_key())
-    if not isinstance(key, Tensor):
-        key = _T(key, _internal=True)
-    return _op(x, key)
+    return _op(x, _seeded_key(seed))
 
 
 def conv_shift(x, y, name=None):
@@ -652,3 +656,24 @@ def data_norm(input, batch_size, batch_sum, batch_square_sum,  # noqa: A002
 def linear_chain_crf(input, transition, label, length, name=None):  # noqa: A002
     from ..ops.misc_ops import linear_chain_crf as _op
     return _op(input, transition, label, length)
+
+
+def nce(input, label, num_total_classes, weight, bias=None,  # noqa: A002
+        num_neg_samples=5, name=None, sampler="uniform",
+        custom_dist=None, seed=None):
+    """Dense-weight form of the reference fluid.layers.nce (the
+    param-creating form belongs to the static param machinery; the
+    caller owns weight/bias). Only the uniform sampler is realized —
+    custom_dist raises."""
+    import numpy as np2
+    from ..framework.tensor import Tensor as _T
+    from ..ops.misc_ops import nce as _op
+    if sampler != "uniform" or custom_dist is not None:
+        raise NotImplementedError(
+            "nce: only the uniform sampler is implemented")
+    if bias is None:
+        bias = _T(np2.zeros((int(num_total_classes),), np2.float32),
+                  _internal=True)
+    return _op(input, weight, bias, label, _seeded_key(seed),
+               num_neg_samples=int(num_neg_samples),
+               num_total_classes=int(num_total_classes))

@@ -693,3 +693,39 @@ def space_to_depth(x, *, blocksize):
     out = x.reshape(n, c, h // r, r, w // r, r)
     out = out.transpose(0, 3, 5, 1, 2, 4)     # n, fy, fx, c, h2, w2
     return out.reshape(n, r * r * c, h // r, w // r)
+
+
+@primitive("nce_op")
+def nce(x, weight, bias, label, key, *, num_neg_samples=5,
+        num_total_classes=None):
+    """reference: operators/nce_op.cc/.h — noise-contrastive estimation
+    for large-vocab classifiers with the uniform noise sampler. The NCE
+    posterior is P(D=1 | w) = e^s / (e^s + b) with the noise mass
+    b = k·Pn(w) = k/V (nce_op.h:222-223 — NOT plain logistic loss: for
+    V=10k, k=5 the correction shifts every score by log(k/V) ≈ -7.6):
+
+        loss = -log P(D=1|pos) - Σ_neg log P(D=0|neg)
+             = softplus(log b - s_pos) + Σ softplus(s_neg - log b)
+
+    x [B, D], weight [V, D], bias [V], label [B, 1] or [B]; returns
+    per-row loss [B, 1]. Negative ids come from the key (deterministic
+    under jit); gradients flow through the scores only."""
+    B, D = x.shape
+    V = weight.shape[0] if num_total_classes is None else num_total_classes
+    if V > weight.shape[0]:
+        raise ValueError(
+            f"nce: num_total_classes={V} exceeds the weight table's "
+            f"{weight.shape[0]} rows — sampled negatives would silently "
+            "clamp to the last row")
+    lab = label.reshape(-1).astype(jnp.int32)
+    k = int(num_neg_samples)
+    log_b = float(np.log(k / V))
+    neg = jax.random.randint(key, (B, k), 0, V)            # [B, k]
+    xf = x.astype(jnp.float32)
+    wf = weight.astype(jnp.float32)
+    bf = bias.astype(jnp.float32)
+    s_pos = jnp.einsum("bd,bd->b", xf, wf[lab]) + bf[lab]  # [B]
+    s_neg = jnp.einsum("bd,bkd->bk", xf, wf[neg]) + bf[neg]
+    loss = jnp.logaddexp(0.0, log_b - s_pos) \
+        + jnp.sum(jnp.logaddexp(0.0, s_neg - log_b), axis=1)
+    return loss.reshape(B, 1)

@@ -428,3 +428,35 @@ class TestSecondBatch:
         np.testing.assert_allclose(lrs[4], 1.0)     # warmup done
         np.testing.assert_allclose(lrs[8], 0.1, rtol=1e-6)  # end_lr
         assert all(lrs[i] >= lrs[i + 1] for i in range(4, 8))
+
+
+class TestNce:
+    def test_nce_formula(self):
+        from paddle_tpu.fluid import layers as L2
+        paddle.seed(0)
+        rs = np.random.RandomState(21)
+        x = rs.randn(3, 4).astype(np.float32)
+        w = rs.randn(10, 4).astype(np.float32)
+        b = rs.randn(10).astype(np.float32)
+        lab = rs.randint(0, 10, (3, 1)).astype(np.int64)
+        out = L2.nce(T(x), T(lab), 10, T(w), T(b), num_neg_samples=4,
+                     seed=7)
+        assert out.shape == [3, 1] or tuple(out.shape) == (3, 1)
+        v = out.numpy()
+        assert np.isfinite(v).all() and (v > 0).all()
+        # positive-class term is a lower bound of the loss
+        s_pos = (x * w[lab[:, 0]]).sum(1) + b[lab[:, 0]]
+        lower = np.log1p(np.exp(-s_pos))
+        assert (v[:, 0] >= lower - 1e-5).all()
+
+    def test_nce_grads_flow(self):
+        from paddle_tpu.fluid import layers as L2
+        paddle.seed(1)
+        rs = np.random.RandomState(22)
+        x = T(rs.randn(3, 4).astype(np.float32), stop_gradient=False)
+        w = T(rs.randn(8, 4).astype(np.float32), stop_gradient=False)
+        lab = T(rs.randint(0, 8, (3, 1)).astype(np.int64))
+        paddle.sum(L2.nce(x, lab, 8, w, num_neg_samples=3)).backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+        assert w.grad is not None and \
+            float(paddle.sum(paddle.abs(w.grad)).numpy()) > 0

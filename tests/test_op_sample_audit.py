@@ -1,0 +1,42 @@
+"""Lock in the multi-seed op-coverage audit (tools/op_sample_check.py).
+
+The r5 lesson: a hardcoded sample seed let a 100% claim stand while
+other seeds read ~58%. This test re-runs the audit on seeds the tool
+was NOT tuned on and requires >=95% coverage, with any misses confined
+to the known niche contrib-CUDA residue. Skipped where the reference
+checkout is not mounted."""
+import ast
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REF = "/root/reference/paddle/fluid/operators"
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "op_sample_check.py")
+
+# the only acceptable misses: niche contrib CUDA kernels, documented in
+# COVERAGE.md as the audit's residue
+_KNOWN_NICHE = {"prroi_pool", "bilateral_slice", "tree_conv"}
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF),
+                    reason="reference checkout not mounted")
+@pytest.mark.parametrize("seed", [13, 2718])
+def test_op_sample_coverage_holds_on_fresh_seeds(seed):
+    out = subprocess.run(
+        [sys.executable, _TOOL, str(seed)], capture_output=True,
+        text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    hits_line = next(l for l in out.stdout.splitlines()
+                     if l.startswith("hits:"))
+    misses_line = next(l for l in out.stdout.splitlines()
+                       if l.startswith("misses:"))
+    num, den = hits_line.split()[1].split("=")[0].split("/")
+    assert int(num) / int(den) >= 0.95, out.stdout
+    missed = ast.literal_eval(misses_line.split(":", 1)[1].strip())
+    assert set(missed) <= _KNOWN_NICHE, (
+        "audit found misses outside the documented niche residue: "
+        f"{sorted(set(missed) - _KNOWN_NICHE)}")

@@ -19,7 +19,7 @@ NA_PAT = re.compile(
     # hardware/backend-specific: Ascend/Kunlun id-gen + triggers, NPU/XPU
     # kernels, external inference engines (TensorRT/Lite/DLNNE/CINN bridge
     # ops — our analogue IS the XLA path), profiler markers
-    r"^(gen_(bkcl|hccl|nccl)_id|ascend_trigger|.*_xpu|"
+    r"^(gen_(bkcl|hccl|nccl)_id|nccl.*|ascend_trigger|.*_xpu|"
     r"(tensorrt|lite|dlnne|cinn_launch)_engine|marker|"
     # comm bootstrap + stream ordering: subsumed by jax.distributed init
     # and XLA's scheduler (SURVEY §2.4 — no ring-id plumbing exists here)
@@ -37,6 +37,7 @@ NA_PAT = re.compile(
     # machinery subsumed by whole-program XLA (one module, XLA buffer
     # assignment — COVERAGE.md L3)
     r"coalesce_tensor|share_buffer|copy_cross_scope|memcpy.*|nop|"
+    r"queue_generator|"
     r"get_float_status|dgc_clip_by_norm|dpsgd|"
     # inference-pass-generated fusion ops (the export passes fold these
     # patterns; runtime fusion is XLA's)
@@ -113,6 +114,7 @@ RENAME = {
     "c_embedding": "VocabParallelEmbedding",
     "c_softmax_with_cross_entropy": "ParallelCrossEntropy",
     # renamed / modern-API equivalents
+    "range": "arange", "unique_with_counts": "unique",
     "where_index": "nonzero", "crop_tensor": "crop", "minus": "subtract",
     "fill_zeros_like": "zeros_like", "fill_any_like": "full_like",
     "fill_any": "full", "grid_sampler": "grid_sample",
@@ -131,6 +133,8 @@ RENAME = {
     # RNN-cell era: the cell/classes cover the fused units (rnn_op is the
     # counted multi-layer path; lstmp = LSTM-with-projection variant;
     # cudnn_lstm = the GPU fused multi-layer LSTM, same API)
+    "depthwise_conv2d": "conv2d",
+    "depthwise_conv2d_transpose": "conv2d_transpose",
     "gru_unit": "GRUCell", "lstm_unit": "LSTMCell", "lstm": "LSTM",
     "lstmp": "LSTM", "gru": "GRU", "cudnn_lstm": "LSTM",
     # second honest-audit pass
