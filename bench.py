@@ -155,72 +155,116 @@ def _latest_tpu_capture():
         return None, None
 
 
+def _gpt2_from_capture(cap):
+    """The capture's headline-eligible GPT-2 row, or None."""
+    if not cap:
+        return None
+    return next((r for r in cap.get("results", [])
+                 if isinstance(r, dict)
+                 and str(r.get("config", "")).startswith("gpt2")
+                 and "long" not in str(r.get("config", ""))
+                 and "throughput" in r), None)
+
+
+def _load_retry():
+    """paddle_tpu.resilience.retry loaded by FILE PATH: the bench parent
+    must never import the paddle_tpu package (that imports jax, and a
+    wedged tunnel would hang the watchdog itself). retry.py is pure stdlib
+    by contract."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "paddle_tpu", "resilience", "retry.py")
+    spec = importlib.util.spec_from_file_location("_pt_retry_standalone",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def main():
     """Watchdog wrapper: a wedged TPU tunnel makes the first jax device use
     hang forever inside make_c_api_client — no in-process handling can
     recover (round-1 bench emitted no output at all this way). So the bench
-    body runs in a timed CHILD process. The tunnel wedge is TRANSIENT
-    (round-3 lesson: one attempt + CPU fallback forfeited the round's TPU
-    evidence), so the TPU attempt is retried with backoff across ~35 min —
-    cheap device probe first, full bench only once a probe succeeds —
-    before pinning to CPU. If the live TPU attempts all fail but an
-    in-round capture exists, that capture's GPT-2 number becomes the
-    headline (it IS a real TPU measurement of this code). Always ends with
-    one parseable JSON line."""
+    body runs in a timed CHILD process, and the whole live-TPU campaign is
+    bounded by a RetryPolicy deadline (PADDLE_TPU_BENCH_DEADLINE_S, default
+    600s — BENCH_r05 went rc=124 because the old ~35-min linear loop could
+    outlive the caller's budget).
+
+    Order of preference for the headline:
+      1. a live TPU bench run that completes within the deadline;
+      2. a fresh banked in-round capture (BENCH_TPU_<ts>.json — it IS a
+         real TPU measurement of this code), promoted BEFORE burning any
+         time on a CPU fallback;
+      3. a CPU smoke run (shapes only; throughput not meaningful).
+    Always ends with one parseable JSON line."""
     if os.environ.get("_PT_BENCH_CHILD") == "1":
         _child_main()
         return
 
-    tpu_tries = int(os.environ.get("PADDLE_TPU_BENCH_TPU_TRIES", "8"))
-    retry_sleep = float(os.environ.get("PADDLE_TPU_BENCH_RETRY_SLEEP", "60"))
-    last_err = "no output"
-    for i in range(tpu_tries):
-        if i:  # linear backoff: 60,90,120,... (~35 min total with probes)
-            time.sleep(retry_sleep + 30.0 * (i - 1))
-        if not _probe_tpu(float(
-                os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "150"))):
-            last_err = f"tpu probe timed out (attempt {i + 1}/{tpu_tries})"
-            print(f"# bench: {last_err}, retrying", flush=True)
-            continue
-        line, err = _run_bench_child(force_cpu=False)
-        res = json.loads(line) if line is not None else None
-        if res is not None and "error" not in res:
-            name, cap = _latest_tpu_capture()
-            if cap is not None:
-                res["last_tpu_capture"] = {"file": name, **cap}
-            print(json.dumps(res))
-            return
-        # a fast TPU-side failure or hang: keep the error, try again
-        last_err = err or res["error"]
-        print(f"# bench: tpu attempt {i + 1} failed: {last_err}", flush=True)
+    # (1) bank first: locate in-round TPU evidence before any live probing
+    cap_name, cap = _latest_tpu_capture()
+    banked_gpt2 = _gpt2_from_capture(cap)
+    if banked_gpt2 is not None:
+        print("# bench: banked capture %s qualifies for headline"
+              % cap_name, flush=True)
+
+    # (2) live TPU attempts under a hard wall-clock deadline
+    deadline_s = float(os.environ.get("PADDLE_TPU_BENCH_DEADLINE_S", "600"))
+    probe_timeout = float(
+        os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "150"))
+    last_err = "live TPU probing disabled (PADDLE_TPU_BENCH_DEADLINE_S<=0)"
+    if deadline_s > 0:
+        policy = _load_retry().RetryPolicy(
+            max_tries=int(os.environ.get("PADDLE_TPU_BENCH_TPU_TRIES", "8")),
+            base_delay=float(
+                os.environ.get("PADDLE_TPU_BENCH_RETRY_SLEEP", "60")),
+            multiplier=1.5, max_delay=240.0, deadline_s=deadline_s)
+        for i in policy.attempts():
+            if not _probe_tpu(max(5.0, min(probe_timeout,
+                                           policy.remaining()))):
+                last_err = "tpu probe timed out (attempt %d)" % (i + 1)
+                print("# bench: %s, %.0fs budget left"
+                      % (last_err, max(0.0, policy.remaining())), flush=True)
+                continue
+            line, err = _run_bench_child(
+                force_cpu=False,
+                timeout_s=max(60.0, min(900.0, policy.remaining())))
+            res = json.loads(line) if line is not None else None
+            if res is not None and "error" not in res:
+                if cap is not None:
+                    res["last_tpu_capture"] = {"file": cap_name, **cap}
+                print(json.dumps(res))
+                return
+            # a fast TPU-side failure or hang: keep the error, try again
+            last_err = err or res["error"]
+            print(f"# bench: tpu attempt {i + 1} failed: {last_err}",
+                  flush=True)
+
+    # (3) banked capture as headline — no CPU fallback burn when real TPU
+    # evidence already exists
+    if banked_gpt2 is not None:
+        print(json.dumps({
+            "metric": _METRIC, "value": banked_gpt2["throughput"],
+            "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+            "platform": "tpu (in-round capture %s)" % cap["timestamp"],
+            "mfu": banked_gpt2.get("mfu"),
+            "step_ms": banked_gpt2.get("step_ms"),
+            "batch": banked_gpt2.get("batch"),
+            "seq_len": banked_gpt2.get("seq_len"),
+            "attn_paths": banked_gpt2.get("attn_paths"),
+            "live_error": last_err,
+            "last_tpu_capture": {"file": cap_name, **cap},
+        }))
+        return
+
+    # (4) CPU smoke fallback (no TPU evidence at all this round)
     line, err = _run_bench_child(force_cpu=True)
     out = (json.loads(line) if line is not None else {
         "metric": _METRIC, "value": 0.0, "unit": "tokens/sec/chip",
         "vs_baseline": 0.0, "error": f"{last_err}; cpu fallback: {err}"})
-    name, cap = _latest_tpu_capture()
-    if cap is not None:
-        # promote the banked TPU measurement to the headline; keep the CPU
-        # smoke run's numbers (and any fallback error) subordinate so the
-        # one output line is not self-contradictory
-        gpt2 = next((r for r in cap.get("results", [])
-                     if isinstance(r, dict)
-                     and str(r.get("config", "")).startswith("gpt2")
-                     and "long" not in str(r.get("config", ""))
-                     and "throughput" in r), None)
-        out["last_tpu_capture"] = {"file": name, **cap}
-        if gpt2 is not None:
-            out["cpu_smoke"] = {k: out.get(k) for k in (
-                "value", "mfu", "step_ms", "batch", "seq_len", "attn_paths")}
-            for sub in ("error", "extra"):  # CPU-measured fields must not
-                if sub in out:              # sit beside platform="tpu ..."
-                    out["cpu_smoke"][sub] = out.pop(sub)
-            out.update({
-                "value": gpt2["throughput"], "mfu": gpt2.get("mfu"),
-                "step_ms": gpt2.get("step_ms"), "batch": gpt2.get("batch"),
-                "seq_len": gpt2.get("seq_len"),
-                "attn_paths": gpt2.get("attn_paths"),
-                "platform": "tpu (in-round capture %s)" % cap["timestamp"],
-            })
+    if cap is not None:  # capture exists but had no gpt2 row: still attach
+        out["last_tpu_capture"] = {"file": cap_name, **cap}
     print(json.dumps(out))
 
 

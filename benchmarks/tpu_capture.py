@@ -28,9 +28,36 @@ import time
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+_chaos_mod = None
+
+
+def _chaos():
+    """paddle_tpu.resilience.chaos loaded by FILE PATH (cached so injected
+    fault counters persist across calls). The probe runs in jax-free parent
+    processes, so the package import path is off-limits; chaos.py is pure
+    stdlib by contract."""
+    global _chaos_mod
+    if _chaos_mod is None:
+        import importlib.util
+        path = os.path.join(_ROOT, "paddle_tpu", "resilience", "chaos.py")
+        spec = importlib.util.spec_from_file_location(
+            "_pt_chaos_standalone", path)
+        _chaos_mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_chaos_mod)
+    return _chaos_mod
+
 
 def probe_tpu(timeout_s: float = 150.0) -> bool:
-    """True iff a TPU device initialises inside `timeout_s` in a child."""
+    """True iff a TPU device initialises inside `timeout_s` in a child.
+
+    Fault injection: PADDLE_TPU_CHAOS="probe_timeout:N" makes the first N
+    probes report a dead tunnel WITHOUT spawning the child — the harness
+    that makes bench.py's retry/fallback chain testable in seconds."""
+    try:
+        if _chaos().probe_should_timeout():
+            return False
+    except Exception:
+        pass  # a broken injection harness must never break the real probe
     try:
         out = subprocess.run(
             [sys.executable, "-c",

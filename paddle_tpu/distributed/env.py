@@ -89,18 +89,34 @@ def init_parallel_env():
     the DCN analogue of the reference's c_gen_nccl_id + c_comm_init program.
     Single-host: nothing to bootstrap; the world group is simply every
     local device. Idempotent like the reference.
+
+    The coordinator handshake is retried with bounded backoff under a hard
+    deadline (the reference's gen_comm_id connect loop retried forever;
+    see resilience/retry.py). Knobs: PADDLE_TPU_BOOTSTRAP_TRIES (default 4),
+    PADDLE_TPU_BOOTSTRAP_DEADLINE_S (default 300).
     """
     global _initialized
     if _initialized:
         return _env()
     import jax
     if _multi_host_env_present():
+        from ..resilience import RetryPolicy
         addr = (os.environ.get("PADDLE_COORDINATOR_ADDRESS")
                 or os.environ.get("JAX_COORDINATOR_ADDRESS"))
-        jax.distributed.initialize(
+        policy = RetryPolicy(
+            max_tries=int(os.environ.get("PADDLE_TPU_BOOTSTRAP_TRIES", "4")),
+            base_delay=2.0, max_delay=30.0,
+            deadline_s=float(os.environ.get(
+                "PADDLE_TPU_BOOTSTRAP_DEADLINE_S", "300")))
+        policy.call(
+            jax.distributed.initialize,
             coordinator_address=addr,
             num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            retry_on=(RuntimeError, OSError),
+            on_error=lambda i, e: print(
+                "init_parallel_env: coordinator handshake with %s failed "
+                "(try %d): %s" % (addr, i + 1, e)))
     _initialized = True
     from . import collective
     collective._ensure_world_group()

@@ -45,6 +45,12 @@ def _parse_args(argv=None):
     p.add_argument("--run_mode", default="collective")
     p.add_argument("--devices", "--gpus", "--xpus", dest="devices",
                    default=None)
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_LAUNCH_MAX_RESTARTS",
+                                              "0")),
+                   help="total failed-worker respawns before the launch "
+                        "gives up (reference: the elastic manager's "
+                        "restart budget); 0 = fail fast")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -56,11 +62,11 @@ def launch_collective(args) -> int:
     master = args.master or f"127.0.0.1:{_free_port()}"
     endpoints = ",".join(
         f"127.0.0.1:{_free_port()}" for _ in range(world))
-    procs = []
     log_dir = args.log_dir
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
-    for local_rank in range(nprocs):
+
+    def spawn(local_rank, respawn=False):
         rank = args.node_rank * nprocs + local_rank
         env = dict(os.environ)
         env.update({
@@ -86,26 +92,50 @@ def launch_collective(args) -> int:
                 env.get("XLA_FLAGS", ""), 1)
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
-        out = (open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
-               if log_dir else None)
-        procs.append((subprocess.Popen(cmd, env=env, stdout=out,
-                                       stderr=subprocess.STDOUT
-                                       if out else None), out))
+        out = (open(os.path.join(log_dir, f"workerlog.{rank}"),
+                    "a" if respawn else "w") if log_dir else None)
+        return (subprocess.Popen(cmd, env=env, stdout=out,
+                                 stderr=subprocess.STDOUT if out else None),
+                out)
 
-    # watch loop (reference: fleet/launch.py:276-347)
+    procs = [spawn(lr) for lr in range(nprocs)]
+
+    # watch loop (reference: fleet/launch.py:276-347) with a bounded
+    # restart budget (reference: elastic manager) — a crashed worker is
+    # respawned with backoff until --max_restarts is exhausted
+    max_restarts = max(0, args.max_restarts)
+    restarts = 0
+    backoff = None
+    if max_restarts:
+        from ..resilience import RetryPolicy
+        backoff = RetryPolicy(max_tries=max_restarts + 1, base_delay=1.0,
+                              max_delay=30.0)
     rc = 0
     try:
         alive = True
         while alive:
             alive = False
-            for p, _ in procs:
+            for idx, (p, out) in enumerate(procs):
                 code = p.poll()
                 if code is None:
                     alive = True
                 elif code != 0:
-                    rc = code
-                    raise RuntimeError(
-                        f"worker pid {p.pid} exited with code {code}")
+                    if restarts < max_restarts:
+                        restarts += 1
+                        delay = backoff.backoff(restarts)
+                        print("launch: worker pid %d (local rank %d) exited "
+                              "with code %d — restart %d/%d in %.1fs"
+                              % (p.pid, idx, code, restarts, max_restarts,
+                                 delay), file=sys.stderr)
+                        time.sleep(delay)
+                        if out:
+                            out.close()
+                        procs[idx] = spawn(idx, respawn=True)
+                        alive = True
+                    else:
+                        rc = code
+                        raise RuntimeError(
+                            f"worker pid {p.pid} exited with code {code}")
             time.sleep(0.5)
     except (RuntimeError, KeyboardInterrupt) as e:
         for p, _ in procs:

@@ -90,6 +90,22 @@ define_flag("use_flash_attention", True,
 define_flag("use_fused_optimizer", True,
             "route Adam/AdamW updates to the Pallas fused kernel on TPU "
             "(single HBM pass, in-place via buffer aliasing)")
+define_flag("skip_nonfinite_steps", False,
+            "compiled/eager train steps whose loss or grads are non-finite "
+            "keep the old params + optimizer state (the update is skipped) "
+            "instead of poisoning the weights. The skip is selected INSIDE "
+            "the compiled step (no host round-trip); pair with "
+            "resilience.AnomalyGuard to bound skip streaks (reference: "
+            "update_loss_scaling_op's found_inf => zeroed update)")
+define_flag("step_watchdog_s", 0.0,
+            "when > 0, wrap each compiled-step dispatch in a "
+            "resilience.StepWatchdog that dumps all-thread stacks after "
+            "this many seconds instead of hanging silently (wedged TPU "
+            "tunnel inside PJRT). 0 disables")
+define_flag("step_watchdog_action", "warn",
+            "watchdog behavior on fire: 'warn' (dump diagnostics, keep "
+            "waiting) or 'abort' (dump then os._exit(124) so a supervisor "
+            "— launcher/elastic manager — restarts the process)")
 define_flag("use_fused_dropout_ln", False,
             "route fused bias+dropout+residual+layernorm to the Pallas "
             "kernel when shapes/backend allow. Default off: measured 0.47x "

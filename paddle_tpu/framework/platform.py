@@ -27,7 +27,8 @@ def with_host_device_count(flags: str, n_devices: int) -> str:
     return (flags + " " + want).strip()
 
 
-def pin_host_platform(n_devices: int = 8, verify: bool = True):
+def pin_host_platform(n_devices: int = 8, verify: bool = True,
+                      deadline_s: float = None):
     """Force jax onto the host (CPU) platform with `n_devices` virtual
     devices. Returns the imported jax module. Raises RuntimeError if the
     platform config can no longer be changed (backend already initialized —
@@ -35,7 +36,13 @@ def pin_host_platform(n_devices: int = 8, verify: bool = True):
 
     `verify=False` skips the devices() probe — REQUIRED when the caller
     will run jax.distributed.initialize next (a multi-process rank), which
-    must happen before anything initializes the XLA backend."""
+    must happen before anything initializes the XLA backend.
+
+    `deadline_s` (or env PADDLE_TPU_PIN_DEADLINE_S) bounds the devices()
+    probe: if a mispin somehow still reaches a wedged TPU tunnel, the probe
+    raises resilience.DeadlineExceeded after that many seconds instead of
+    hanging the process forever. Default (unset/0) keeps the probe on the
+    calling thread — required for code that must own the backend init."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = with_host_device_count(
         os.environ.get("XLA_FLAGS", ""), n_devices)
@@ -49,7 +56,14 @@ def pin_host_platform(n_devices: int = 8, verify: bool = True):
     # backend already initialized on another platform, devices() returns it
     # immediately (no tunnel touch) and we must fail loudly rather than let
     # the caller run a "CPU" workload over the TPU tunnel.
-    devs = jax.devices()
+    if deadline_s is None:
+        deadline_s = float(os.environ.get("PADDLE_TPU_PIN_DEADLINE_S", "0"))
+    if deadline_s and deadline_s > 0:
+        from ..resilience.retry import with_deadline
+        devs = with_deadline(jax.devices, deadline_s,
+                             context="pin_host_platform devices() probe")
+    else:
+        devs = jax.devices()
     if any(d.platform != "cpu" for d in devs) or len(devs) < n_devices:
         raise RuntimeError(
             f"pin_host_platform: wanted {n_devices} cpu devices but the "

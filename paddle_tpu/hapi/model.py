@@ -13,9 +13,11 @@ import numpy as np
 from ..framework import io as fio
 from ..framework import state
 from ..framework.autograd import reset_tape
+from ..framework.flags import flag
 from ..framework.tensor import Tensor
 from ..io import DataLoader
 from ..metric import Metric
+from ..resilience import AnomalyGuard, PreemptionGuard, chaos
 from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
 
 __all__ = ["Model"]
@@ -42,6 +44,8 @@ class Model:
         self.stop_training = False
         self._train_step_fn = None
         self._use_jit = True
+        self.preempted = False
+        self.last_step_skipped = False
 
     # -- prepare -----------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, jit=True,
@@ -81,11 +85,27 @@ class Model:
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         loss.backward()
+        self.last_step_skipped = False
         if update:
-            self._optimizer.step()
+            if flag("skip_nonfinite_steps") and not self._step_is_finite(loss):
+                # same contract as the compiled-step guard (jit/engine.py):
+                # a non-finite loss/grad keeps the old params
+                self.last_step_skipped = True
+            else:
+                self._optimizer.step()
             self._optimizer.clear_grad()
         metrics = self._run_metrics(outputs, labels)
         return self._pack(loss, metrics)
+
+    def _step_is_finite(self, loss) -> bool:
+        import jax.numpy as jnp
+        if not bool(jnp.all(jnp.isfinite(loss._data))):
+            return False
+        for p in self.network.parameters():
+            g = getattr(p, "grad", None)
+            if g is not None and not bool(jnp.all(jnp.isfinite(g._data))):
+                return False
+        return True
 
     def _jit_train_batch(self, inputs, labels, update=True):
         """Whole-train-step XLA compilation via the jit engine."""
@@ -94,6 +114,8 @@ class Model:
             self._train_step_fn = make_train_step(
                 self.network, self._loss, self._optimizer)
         loss, outputs = self._train_step_fn(inputs, labels)
+        self.last_step_skipped = getattr(
+            self._train_step_fn, "last_step_skipped", False)
         metrics = self._run_metrics(outputs, labels)
         return self._pack(loss, metrics)
 
@@ -135,7 +157,14 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None,
+            auto_checkpoint_dir=None, exit_on_preempt=True):
+        """Train. With `auto_checkpoint_dir` set, fit is PREEMPTION-SAFE:
+        SIGTERM/SIGINT is deferred to the next batch boundary, an atomic
+        checkpoint (params + optimizer + position + RNG) is written there,
+        and the process exits cleanly (rc=0) — a relaunched fit with the
+        same dir resumes where it left off with loss-trajectory continuity.
+        `exit_on_preempt=False` returns instead (self.preempted is True)."""
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = self._to_loader(eval_data, batch_size, False, False,
@@ -154,33 +183,91 @@ class Model:
             steps = None
         cbk.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
 
+        resume = None
+        ckpt_path = None
+        guard = None
+        if auto_checkpoint_dir:
+            os.makedirs(auto_checkpoint_dir, exist_ok=True)
+            ckpt_path = os.path.join(auto_checkpoint_dir, "preempt_ckpt")
+            if os.path.exists(os.path.join(ckpt_path, "meta.json")):
+                from ..incubate.checkpoint import load_checkpoint
+                resume = load_checkpoint(ckpt_path, self.network,
+                                         self._optimizer)
+                rng = resume.get("rng_state")
+                if rng is not None:
+                    from ..framework.random import set_rng_state
+                    set_rng_state(np.asarray(rng, dtype=np.uint32))
+                self._train_step_fn = None  # recompile on restored arrays
+            guard = PreemptionGuard().install()
+        anomaly = (AnomalyGuard() if flag("skip_nonfinite_steps") else None)
+
+        it_count = int(resume["it_count"]) if resume else 0
+        resume_epoch = int(resume["epoch"]) if resume else -1
+        resume_step = int(resume["step"]) if resume else -1
+
         self.stop_training = False
+        self.preempted = False
         cbk.on_train_begin()
-        it_count = 0
-        for epoch in range(epochs):
-            cbk.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(train_loader):
-                cbk.on_train_batch_begin(step)
-                inputs, labels = self._split_batch(batch)
-                logs = self.train_batch(inputs, labels)
-                cbk.on_train_batch_end(step, logs)
-                it_count += 1
-                if num_iters is not None and it_count >= num_iters:
+        try:
+            for epoch in range(max(0, resume_epoch), epochs):
+                cbk.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(train_loader):
+                    if epoch == resume_epoch and step <= resume_step:
+                        continue  # consumed before the preemption checkpoint
+                    chaos.step_hook(it_count)
+                    cbk.on_train_batch_begin(step)
+                    inputs, labels = self._split_batch(batch)
+                    logs = self.train_batch(inputs, labels)
+                    cbk.on_train_batch_end(step, logs)
+                    it_count += 1
+                    if anomaly is not None:
+                        anomaly.observe(logs["loss"],
+                                        skipped=self.last_step_skipped)
+                    if guard is not None and guard.triggered:
+                        self._save_preempt(ckpt_path, epoch, step, it_count)
+                        self.preempted = True
+                        self.stop_training = True
+                        break
+                    if num_iters is not None and it_count >= num_iters:
+                        break
+                if self.preempted:
                     break
-            # epoch metrics
-            for m in self._metrics:
-                name = m.name()
-                logs[name if isinstance(name, str) else name[0]] = m.accumulate()
-            cbk.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self._run_eval(eval_loader, cbk)
-            if self.stop_training or (num_iters is not None and it_count >= num_iters):
-                break
+                # epoch metrics
+                for m in self._metrics:
+                    name = m.name()
+                    logs[name if isinstance(name, str) else name[0]] = m.accumulate()
+                cbk.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self._run_eval(eval_loader, cbk)
+                if self.stop_training or (num_iters is not None and it_count >= num_iters):
+                    break
+        finally:
+            if guard is not None:
+                guard.uninstall()
         cbk.on_train_end()
         reset_tape()
+        if self.preempted:
+            if verbose:
+                print("fit preempted (signal %s): checkpoint saved to %s"
+                      % (guard.signum, ckpt_path))
+            if exit_on_preempt:
+                import sys
+                sys.exit(0)
+        elif ckpt_path and os.path.exists(ckpt_path):
+            import shutil
+            shutil.rmtree(ckpt_path, ignore_errors=True)
+
+    def _save_preempt(self, path, epoch, step, it_count):
+        """Atomic preemption checkpoint: state + exact loop position."""
+        from ..framework.random import get_rng_state
+        from ..incubate.checkpoint import save_checkpoint
+        meta = {"epoch": int(epoch), "step": int(step),
+                "it_count": int(it_count),
+                "rng_state": np.asarray(get_rng_state()).tolist()}
+        return save_checkpoint(path, self.network, self._optimizer, meta)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):

@@ -125,6 +125,8 @@ class TrainEpochRange:
             if last is not None:
                 self._epoch = last
         self._pending = None
+        self._guard = None
+        self.preempted = False
 
     def _ckpt_path(self, epoch: int) -> str:
         return os.path.join(self.dir, f"epoch_{epoch}")
@@ -150,11 +152,26 @@ class TrainEpochRange:
         return self._restored_meta
 
     def get(self):
-        """Epoch iterator starting AFTER the restored epoch."""
-        for e in range(self._epoch + 1, self.max_epoch_num):
-            self._pending = e
-            yield e
-            self._pending = None
+        """Epoch iterator starting AFTER the restored epoch. Preemption-safe:
+        SIGTERM/SIGINT during an epoch is deferred (resilience.PreemptionGuard)
+        and the range stops cleanly at the next epoch boundary — after the
+        caller's `save()` — so the relaunched job resumes one epoch later."""
+        from ..resilience.preemption import PreemptionGuard, active_guard
+        guard = active_guard()
+        if guard is None:
+            guard = self._guard = PreemptionGuard().install()
+        try:
+            for e in range(self._epoch + 1, self.max_epoch_num):
+                self._pending = e
+                yield e
+                self._pending = None
+                if guard.triggered:
+                    self.preempted = True
+                    break
+        finally:
+            if self._guard is not None:
+                self._guard.uninstall()
+                self._guard = None
 
     def save(self, layer=None, optimizer=None, meta=None):
         e = self._pending

@@ -152,7 +152,16 @@ class MoELayer(Layer):
         ce = paddle.mean(mask1, axis=0)                        # [E]
         aux = paddle.sum(me * ce) * float(E)
         self._l_aux_live = aux               # tape/trace-linked value
-        self._l_aux_buf._data = aux._data    # engine buffer round-trip
+        import jax
+        from ..framework import state
+        if state.in_trace() or not isinstance(aux._data, jax.core.Tracer):
+            # engine buffer round-trip. Under an ENGINE trace (trace_guard)
+            # the tracer is collected as a buffer output and replaced with
+            # a concrete array after the step; under a USER-owned jax.jit
+            # the tracer would simply leak into the persistable buffer and
+            # poison every later eager read — keep the previous concrete
+            # value there instead (l_aux still flows via _l_aux_live).
+            self._l_aux_buf._data = aux._data
 
         if self.top_k == 2:
             probs2 = probs * (1.0 - mask1)

@@ -26,8 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import state
+from ..framework.flags import flag
 from ..framework.random import RNG
 from ..framework.tensor import Tensor
+from ..resilience import chaos
+from ..resilience.watchdog import StepWatchdog
 
 
 def _param_spec(p, mesh, zero3=False):
@@ -129,6 +132,13 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
     acc_names = optimizer._accumulator_names
     mutable = params + frozen + buffers  # tensors whose _data we swap
 
+    # resilience knobs, frozen at trace time (static in the executable):
+    # guard_nonfinite selects old params/accs/buffers when the step's loss
+    # or grads are non-finite; nan_step is the chaos harness's injected
+    # NaN (tier-1 exercises the guard on the CPU mesh this way)
+    guard_nonfinite = bool(flag("skip_nonfinite_steps"))
+    nan_step = chaos.nan_at_step()
+
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         _pspecs = [_param_spec(p, mesh, zero3=stage >= 3) for p in params]
@@ -161,7 +171,13 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
                 loss = loss_fn(*outs, *labels)
             new_bufs = [b._data for b in buffers]
             out_arrs = [o._data for o in outs]
-            return loss._data, (out_arrs, new_bufs, RNG.key)
+            loss_arr = loss._data
+            if nan_step is not None:
+                # multiplying (not where-replacing) poisons the GRADS too,
+                # matching how a real divergence propagates backward
+                loss_arr = loss_arr * jnp.where(
+                    t == nan_step, jnp.float32(jnp.nan), jnp.float32(1.0))
+            return loss_arr, (out_arrs, new_bufs, RNG.key)
 
         try:
             (loss, aux), grads = jax.value_and_grad(
@@ -205,7 +221,21 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
                 out = rule(sargs, arr, g, plr, t, *acc)
                 new_params.append(out[0])
                 new_accs.append(list(out[1:]))
-        return loss, out_arrs, new_bufs, new_key, new_params, new_accs
+        ok = jnp.isfinite(loss)
+        if guard_nonfinite:
+            # one non-finite loss or grad => this step keeps the OLD
+            # params/opt-state/buffers (reference: update_loss_scaling_op
+            # zeroes the update on found_inf). Selected inside the
+            # executable — no host round-trip, works sharded.
+            for g in gs:
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+            new_params = [jnp.where(ok, n, o)
+                          for n, o in zip(new_params, param_arrs)]
+            new_accs = [[jnp.where(ok, n, o) for n, o in zip(na, oa)]
+                        for na, oa in zip(new_accs, acc_arrs)]
+            new_bufs = [jnp.where(ok, n, o)
+                        for n, o in zip(new_bufs, buf_arrs)]
+        return loss, out_arrs, new_bufs, new_key, new_params, new_accs, ok
 
     # donate params (0), buffers (2), opt state (3): all are replaced by
     # outputs, so XLA reuses their HBM in-place instead of holding both
@@ -249,9 +279,30 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
         key = RNG.key
         in_arrs = [x._data for x in inputs]
         lab_arrs = [x._data for x in labels]
-        loss, out_arrs, new_bufs, new_key, new_params, new_accs = jitted(
-            param_arrs, frozen_arrs, buf_arrs, acc_arrs, key, t, lr,
-            in_arrs, lab_arrs)
+        wd_s = float(flag("step_watchdog_s") or 0.0)
+        args = (param_arrs, frozen_arrs, buf_arrs, acc_arrs, key, t, lr,
+                in_arrs, lab_arrs)
+        if wd_s > 0:
+            # a wedged backend hangs INSIDE dispatch/blocking with no
+            # python-level recourse; the watchdog makes it observable
+            # (all-thread stack dump) and, with action=abort, recoverable
+            # by a supervisor. block_until_ready pulls the hang into the
+            # watchdog's scope (dispatch alone returns futures).
+            with StepWatchdog(wd_s,
+                              context="compiled train step %d"
+                                      % optimizer._step_count,
+                              action=str(flag("step_watchdog_action"))):
+                chaos.hang_before_dispatch(optimizer._step_count)
+                out = jitted(*args)
+                jax.block_until_ready(out[0])
+        else:
+            chaos.hang_before_dispatch(optimizer._step_count)
+            out = jitted(*args)
+        loss, out_arrs, new_bufs, new_key, new_params, new_accs, ok = out
+        if guard_nonfinite:
+            call.last_step_skipped = not bool(ok)
+            if call.last_step_skipped:
+                call.skipped_steps += 1
         for p, a in zip(params, new_params):
             p._data = a
         for b, a in zip(buffers, new_bufs):
@@ -269,6 +320,8 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
                 [Tensor(o, _internal=True) for o in out_arrs])
 
     call._params = params
+    call.last_step_skipped = False
+    call.skipped_steps = 0
     return call
 
 

@@ -563,12 +563,16 @@ def data_norm(x, batch_size, batch_sum, batch_square_sum, *,
               epsilon=1e-4):
     """reference: operators/data_norm_op.cc (CTR feature normalization):
     per-feature mean = batch_sum / batch_size and
-    scale = sqrt(batch_size / batch_square_sum); y = (x - mean) * scale.
-    The stat accumulators are inputs (the reference updates them
-    asynchronously through the PS; here the caller owns them)."""
+    scale = sqrt(batch_size / batch_square_sum) (data_norm_op.cc:303-304 —
+    epsilon is an attr of the op but does NOT enter the scale denominator;
+    batch_square_sum is initialized positive by convention);
+    y = (x - mean) * scale. The stat accumulators are inputs (the
+    reference updates them asynchronously through the PS; here the caller
+    owns them)."""
+    del epsilon  # accepted for attr parity; unused (see docstring)
     bs = batch_size.astype(jnp.float32)
     mean = batch_sum.astype(jnp.float32) / bs
-    scale = jnp.sqrt(bs / (batch_square_sum.astype(jnp.float32) + epsilon))
+    scale = jnp.sqrt(bs / batch_square_sum.astype(jnp.float32))
     return ((x.astype(jnp.float32) - mean) * scale).astype(x.dtype)
 
 
@@ -683,16 +687,52 @@ def fill_diagonal(x, *, value=0.0, offset=0, wrap=False):
 
 @primitive("space_to_depth_op")
 def space_to_depth(x, *, blocksize):
-    """reference: operators/space_to_depth_op.cc — NCHW block-major
-    packing: output channel index = (fy*r + fx)*C + c (the reference's
-    ordering, which DIFFERS from pixel_unshuffle's (c, fy, fx) — models
-    ported between the two would load conv weights against permuted
-    channels)."""
+    """reference: operators/space_to_depth_op.cc — the DARKNET reorg
+    layer (YOLO), NOT pixel_unshuffle and NOT plain block-major packing.
+    The reference kernel maps every input element (k, j, i) of [C, H, W]
+    through c2 = k % (C/bs^2), offset = k // (C/bs^2) into a
+    [C/bs^2, H*bs, W*bs] buffer at (c2, j*bs + offset//bs,
+    i*bs + offset%bs), then reinterprets that buffer flat as the
+    [C*bs^2, H/bs, W/bs] output — models ported against any other
+    channel order would load conv weights permuted. Requires
+    C % bs^2 == 0 (the reference enforces the same)."""
     r = int(blocksize)
     n, c, h, w = x.shape
-    out = x.reshape(n, c, h // r, r, w // r, r)
-    out = out.transpose(0, 3, 5, 1, 2, 4)     # n, fy, fx, c, h2, w2
-    return out.reshape(n, r * r * c, h // r, w // r)
+    if r <= 0:
+        raise ValueError(f"space_to_depth: blocksize must be >= 1, got {r}")
+    if c % (r * r):
+        raise ValueError(
+            f"space_to_depth: channels ({c}) must be divisible by "
+            f"blocksize^2 ({r * r}) — the reorg buffer is [C/bs^2, "
+            "H*bs, W*bs] (reference: space_to_depth_op.cc InferShape)")
+    if h % r or w % r:
+        raise ValueError(
+            f"space_to_depth: spatial dims ({h}x{w}) must be divisible "
+            f"by blocksize ({r})")
+    c2 = c // (r * r)
+    # input k = (oy*r + ox)*c2 + m  ->  buffer (m, j*r + oy, i*r + ox)
+    buf = x.reshape(n, r, r, c2, h, w)        # n, oy, ox, m, j, i
+    buf = buf.transpose(0, 3, 4, 1, 5, 2)     # n, m, j, oy, i, ox
+    buf = buf.reshape(n, c2, h * r, w * r)
+    return buf.reshape(n, c * r * r, h // r, w // r)
+
+
+def _as_prng_key(key):
+    """Normalize nce's key input to something jax.random accepts: typed
+    PRNG keys and raw uint32 [2] keys pass through; anything else is
+    folded (stop_gradient -> int32 sum) into a fresh PRNGKey. Works under
+    trace: PRNGKey over a traced seed lowers to lax ops."""
+    try:
+        if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+            return key
+    except (AttributeError, TypeError):
+        pass
+    arr = jax.lax.stop_gradient(key)
+    if arr.dtype == jnp.uint32 and arr.shape == (2,):
+        return arr
+    seed = (jnp.sum(arr.astype(jnp.int32)) if arr.size
+            else jnp.int32(0))
+    return jax.random.PRNGKey(seed)
 
 
 @primitive("nce_op")
@@ -709,7 +749,11 @@ def nce(x, weight, bias, label, key, *, num_neg_samples=5,
 
     x [B, D], weight [V, D], bias [V], label [B, 1] or [B]; returns
     per-row loss [B, 1]. Negative ids come from the key (deterministic
-    under jit); gradients flow through the scores only."""
+    under jit); gradients flow through the scores only. The key input
+    may be a typed jax PRNG key, a raw uint32 [2] key, or ANY integer/
+    float tensor (a seed source) — the latter is folded into a PRNGKey
+    via stop_gradient so autodiff sweeps never differentiate the
+    sampler."""
     B, D = x.shape
     V = weight.shape[0] if num_total_classes is None else num_total_classes
     if V > weight.shape[0]:
@@ -720,6 +764,7 @@ def nce(x, weight, bias, label, key, *, num_neg_samples=5,
     lab = label.reshape(-1).astype(jnp.int32)
     k = int(num_neg_samples)
     log_b = float(np.log(k / V))
+    key = _as_prng_key(key)
     neg = jax.random.randint(key, (B, k), 0, V)            # [B, k]
     xf = x.astype(jnp.float32)
     wf = weight.astype(jnp.float32)

@@ -202,9 +202,15 @@ def _pallas_call(*args, **kwargs):
     f32/bf16/i32/u32 operands, so tracing them in 32-bit mode is
     semantics-preserving."""
     inner = pl.pallas_call(*args, **kwargs)
+    # jax.enable_x64 was removed from the top-level namespace in newer jax
+    # releases; the experimental home works across the versions we span
+    try:
+        _enable_x64 = jax.enable_x64
+    except AttributeError:
+        from jax.experimental import enable_x64 as _enable_x64
 
     def call(*operands):
-        with jax.enable_x64(False):
+        with _enable_x64(False):
             return inner(*operands)
 
     return call

@@ -1,0 +1,29 @@
+"""Fault-tolerant training runtime.
+
+The reference stack treats failure as a first-class concern (auto-checkpoint
+epoch ranges, gen_comm_id bootstrap retries, elastic fleet restart); this
+package is the TPU-native consolidation of those mechanisms:
+
+  retry       RetryPolicy / with_deadline — bounded backoff + hard deadline
+  preemption  PreemptionGuard — SIGTERM/SIGINT -> checkpoint -> clean exit
+  watchdog    StepWatchdog — hung-dispatch diagnostics instead of silence
+  anomaly     AnomalyGuard — bounded NaN/Inf step skipping, scaler-coupled
+  chaos       deterministic fault injection (PADDLE_TPU_CHAOS) so every one
+              of these paths is exercised by tier-1 tests on the CPU mesh
+
+See docs/RESILIENCE.md for the operator-facing knobs.
+"""
+from __future__ import annotations
+
+from .anomaly import AnomalyGuard, NonFiniteLossError  # noqa: F401
+from .preemption import PreemptionGuard, active_guard  # noqa: F401
+from .retry import (DeadlineExceeded, RetryExhausted, RetryPolicy,  # noqa: F401
+                    with_deadline)
+from .watchdog import StepWatchdog  # noqa: F401
+from . import chaos  # noqa: F401
+
+__all__ = [
+    "AnomalyGuard", "NonFiniteLossError", "PreemptionGuard", "active_guard",
+    "DeadlineExceeded", "RetryExhausted", "RetryPolicy", "with_deadline",
+    "StepWatchdog", "chaos",
+]

@@ -1,0 +1,127 @@
+"""Deterministic fault injection (env-flag controlled).
+
+Every resilience path in this repo is testable on the CPU mesh because the
+faults it defends against can be INJECTED deterministically:
+
+    PADDLE_TPU_CHAOS="probe_timeout:3;sigterm_at_step:7;nan_at_step:3"
+
+Spec grammar: `;`-separated `name[:int[:float]]` entries —
+
+    probe_timeout:N       first N TPU-probe calls report a timed-out probe
+                          (bench.py / benchmarks/tpu_capture.py)
+    sigterm_at_step:K     deliver a real SIGTERM to this process at global
+                          train step K (hapi Model.fit batch loop)
+    nan_at_step:K         the compiled train step produces a NaN loss (and
+                          NaN grads) at optimizer step K (jit/engine.py;
+                          1-based like optimizer._step_count)
+    hang_at_step:K:SECS   host-side sleep of SECS inside the compiled-step
+                          dispatch of optimizer step K (exercises the step
+                          watchdog; 1-based)
+
+Injection sites poll this module; with the env var unset every hook is a
+cheap no-op. Counters are in-process (each injected fault fires its exact
+configured schedule within one process lifetime).
+
+Reference analogue: the fault-injection envs in the reference's elastic
+tests (test_fleet_elastic_manager.py fakes etcd faults) — here promoted to
+a first-class, grep-able harness.
+
+MUST stay pure-stdlib: bench.py's parent process loads this file standalone
+(importlib by path) precisely so probing chaos never imports jax or the
+paddle_tpu package.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Dict, Optional, Tuple
+
+ENV_VAR = "PADDLE_TPU_CHAOS"
+
+_spec_cache: Optional[Tuple[str, Dict[str, Tuple[float, ...]]]] = None
+_counts: Dict[str, int] = {}
+
+
+def _parse(spec: str) -> Dict[str, Tuple[float, ...]]:
+    out: Dict[str, Tuple[float, ...]] = {}
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        try:
+            out[parts[0]] = tuple(float(p) for p in parts[1:])
+        except ValueError:
+            raise ValueError("bad %s entry %r (want name[:num[:num]])"
+                             % (ENV_VAR, entry))
+    return out
+
+
+def _active() -> Dict[str, Tuple[float, ...]]:
+    """Parsed spec for the CURRENT env value (re-read on change so tests
+    can flip the env or call configure() mid-process)."""
+    global _spec_cache
+    raw = os.environ.get(ENV_VAR, "")
+    if _spec_cache is None or _spec_cache[0] != raw:
+        _spec_cache = (raw, _parse(raw))
+        _counts.clear()
+    return _spec_cache[1]
+
+
+def configure(spec: str) -> None:
+    """Programmatic injection (tests): equivalent to setting the env var."""
+    if spec:
+        os.environ[ENV_VAR] = spec
+    else:
+        os.environ.pop(ENV_VAR, None)
+    _active()
+
+
+def reset() -> None:
+    configure("")
+
+
+def enabled() -> bool:
+    return bool(_active())
+
+
+def get(name: str) -> Optional[Tuple[float, ...]]:
+    return _active().get(name)
+
+
+def probe_should_timeout() -> bool:
+    """Consume one injected probe failure (probe_timeout:N)."""
+    args = get("probe_timeout")
+    if not args:
+        return False
+    n = _counts.get("probe_timeout", 0)
+    if n >= int(args[0]):
+        return False
+    _counts["probe_timeout"] = n + 1
+    return True
+
+
+def nan_at_step() -> Optional[int]:
+    """Optimizer-step index at which the train step must produce NaN, or
+    None. Read once at trace time by the jit engine (static)."""
+    args = get("nan_at_step")
+    return int(args[0]) if args else None
+
+
+def step_hook(step: int) -> None:
+    """Per-train-step host hook: fires the sigterm injection. Call with
+    the GLOBAL step index (0-based batch counter in Model.fit)."""
+    args = get("sigterm_at_step")
+    if args and int(args[0]) == step and not _counts.get("sigterm"):
+        _counts["sigterm"] = 1
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def hang_before_dispatch(step: int) -> None:
+    """Engine hook: host-side sleep inside the compiled-step dispatch of
+    optimizer step `step` (1-based), under the step watchdog's scope."""
+    args = get("hang_at_step")
+    if args and int(args[0]) == step and not _counts.get("hang_%d" % step):
+        _counts["hang_%d" % step] = 1
+        time.sleep(args[1] if len(args) > 1 else 5.0)

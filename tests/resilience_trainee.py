@@ -1,0 +1,68 @@
+"""Deterministic child training script for the preemption-resume tests.
+
+Invoked as a SUBPROCESS by tests/test_resilience.py:
+
+    python tests/resilience_trainee.py <ckpt_dir> <loss_log.jsonl>
+
+Trains a fixed Linear regression with Model.fit(auto_checkpoint_dir=...),
+appending one JSON line {"step": n, "loss": x} per train batch to the log.
+Everything is seeded and shuffle=False, so two process trees that cover the
+same global steps must produce the SAME loss sequence — the property the
+resume test asserts. A PADDLE_TPU_CHAOS="sigterm_at_step:K" env makes run
+one die (cleanly, rc=0, checkpoint banked) partway through; the relaunch
+continues from the checkpoint.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.framework.platform import pin_host_platform
+
+pin_host_platform(int(os.environ.get("TRAINEE_DEVICES", "1")))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.hapi.callbacks import Callback  # noqa: E402
+
+
+class LossRecorder(Callback):
+    def __init__(self, path):
+        self.path = path
+        self.seen = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"step": step,
+                                "loss": float(logs["loss"])}) + "\n")
+        self.seen += 1
+
+
+def main():
+    ckpt_dir, log_path = sys.argv[1], sys.argv[2]
+    epochs = int(os.environ.get("TRAINEE_EPOCHS", "2"))
+    batch = int(os.environ.get("TRAINEE_BATCH", "4"))
+
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(opt, paddle.nn.MSELoss(), jit=True)
+
+    rs = np.random.RandomState(7)
+    X = rs.randn(32, 4).astype(np.float32)
+    W = rs.randn(4, 2).astype(np.float32)
+    Y = (X @ W + 0.1).astype(np.float32)
+    ds = [(X[i], Y[i]) for i in range(32)]
+
+    model.fit(ds, batch_size=batch, epochs=epochs, shuffle=False, verbose=0,
+              callbacks=[LossRecorder(log_path)],
+              auto_checkpoint_dir=ckpt_dir, exit_on_preempt=True)
+    print("TRAINEE_DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

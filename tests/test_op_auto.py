@@ -433,8 +433,16 @@ SPECS.update({
     "pixel_unshuffle_op": dict(in_=[U(-1, 1, (1, 4, 4, 6))],
                                attrs={"downscale_factor": 2}),
     "row_conv_op": dict(in_=[U(-1, 1, (2, 5, 3)), U(-1, 1, (2, 3))]),
-    "space_to_depth_op": dict(in_=[U(-1, 1, (1, 2, 4, 4))],
+    # darknet reorg: C must be divisible by blocksize^2
+    "space_to_depth_op": dict(in_=[U(-1, 1, (1, 4, 4, 4))],
                               attrs={"blocksize": 2}),
+    # sampler key is an int seed tensor (normalized inside the op); label
+    # and key are integer inputs so the grad sweep differentiates only
+    # x/weight/bias — the score path, matching the reference grad kernel
+    "nce_op": dict(in_=[U(-1, 1, (4, 3)), U(-1, 1, (8, 3)),
+                        U(-0.5, 0.5, (8,)), I64(8, (4, 1)),
+                        I64(1 << 30, (2,))],
+                   attrs={"num_neg_samples": 5, "num_total_classes": 8}),
 })
 
 

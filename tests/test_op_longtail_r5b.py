@@ -238,18 +238,34 @@ class TestMetricsAndMisc:
         np.testing.assert_allclose(total.numpy()[0, 1], -2.0, rtol=1e-6)
 
     def test_space_to_depth_reference_channel_order(self):
+        """Pins the DARKNET reorg element mapping of the reference kernel
+        (space_to_depth_op.cc): input (k, j, i) lands in a
+        [C/bs^2, H*bs, W*bs] buffer at (k % c2, j*bs + (k//c2)//bs,
+        i*bs + (k//c2)%bs), read out flat as [C*bs^2, H/bs, W/bs]."""
         from paddle_tpu.ops.misc_ops import space_to_depth
         rs = np.random.RandomState(20)
-        x = rs.randn(1, 2, 4, 4).astype(np.float32)
+        x = rs.randn(1, 4, 4, 4).astype(np.float32)
         out = space_to_depth(T(x), blocksize=2).numpy()
-        assert out.shape == (1, 8, 2, 2)
-        # channel index = (fy*r + fx)*C + c — reference block-major order
-        for fy in range(2):
-            for fx in range(2):
-                for c in range(2):
-                    k = (fy * 2 + fx) * 2 + c
-                    np.testing.assert_allclose(
-                        out[0, k], x[0, c, fy::2, fx::2])
+        assert out.shape == (1, 16, 2, 2)
+
+        def reorg_ref(x, bs):
+            n, c, h, w = x.shape
+            c2 = c // (bs * bs)
+            buf = np.zeros((n, c2, h * bs, w * bs), x.dtype)
+            for b in range(n):
+                for k in range(c):
+                    m, off = k % c2, k // c2
+                    for j in range(h):
+                        for i in range(w):
+                            buf[b, m, j * bs + off // bs,
+                                i * bs + off % bs] = x[b, k, j, i]
+            return buf.reshape(n, c * bs * bs, h // bs, w // bs)
+
+        np.testing.assert_allclose(out, reorg_ref(x, 2))
+        # C not divisible by bs^2 must refuse, not silently permute
+        with pytest.raises(ValueError):
+            space_to_depth(T(np.zeros((1, 2, 4, 4), np.float32)),
+                           blocksize=2)
 
     def test_fill_diagonal_wrap_and_bounds(self):
         from paddle_tpu.ops.misc_ops import fill_diagonal
@@ -349,7 +365,9 @@ class TestSecondBatch:
         bsq = np.abs(rs.randn(3)).astype(np.float32) * 10 + 5
         out = L.data_norm(T(x), T(bs), T(bsum), T(bsq)).numpy()
         mean = bsum / bs
-        scale = np.sqrt(bs / (bsq + 1e-4))
+        # reference data_norm_op.cc:303-304: scale = sqrt(bs / bsq) — the
+        # epsilon attr does NOT enter the denominator
+        scale = np.sqrt(bs / bsq)
         np.testing.assert_allclose(out, (x - mean) * scale, rtol=1e-4)
 
     def test_linear_chain_crf_matches_bruteforce(self):

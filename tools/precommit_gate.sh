@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Tier-1 gate: the EXACT suite the driver scores (ROADMAP.md "Tier-1
+# verify"), runnable locally before a commit. Exit code is pytest's;
+# DOTS_PASSED prints the pass-dot count for comparison against the
+# previous round's baseline.
+#
+#   tools/precommit_gate.sh            # full tier-1
+#   tools/precommit_gate.sh tests/test_resilience.py   # subset, same env
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+TARGET="${@:-tests/}"
+LOG="${PRECOMMIT_GATE_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest $TARGET -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit $rc
