@@ -79,6 +79,8 @@ def _child_main():
             "platform": platform if on_tpu else platform + " (smoke shapes)",
             "mfu": res["mfu"],
             "step_ms": res["step_ms"],
+            "step_ms_wall": res.get("step_ms_wall"),
+            "compile_s": res.get("compile_s"),
             "batch": res["batch"],
             "seq_len": res["seq_len"],
             "attn_paths": res.get("attn_paths"),
@@ -250,6 +252,8 @@ def main():
             "platform": "tpu (in-round capture %s)" % cap["timestamp"],
             "mfu": banked_gpt2.get("mfu"),
             "step_ms": banked_gpt2.get("step_ms"),
+            "step_ms_wall": banked_gpt2.get("step_ms_wall"),
+            "compile_s": banked_gpt2.get("compile_s"),
             "batch": banked_gpt2.get("batch"),
             "seq_len": banked_gpt2.get("seq_len"),
             "attn_paths": banked_gpt2.get("attn_paths"),

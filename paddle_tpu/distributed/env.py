@@ -108,15 +108,24 @@ def init_parallel_env():
             base_delay=2.0, max_delay=30.0,
             deadline_s=float(os.environ.get(
                 "PADDLE_TPU_BOOTSTRAP_DEADLINE_S", "300")))
+        import logging
+        log = logging.getLogger("paddle_tpu.distributed")
+
+        def _on_error(i, e):
+            log.warning("init_parallel_env: coordinator handshake with %s "
+                        "failed (try %d): %s", addr, i + 1, e)
+            from ..observability import journal
+            journal.emit("bootstrap_retry", coordinator=str(addr),
+                         attempt=i + 1, error=repr(e))
+
         policy.call(
             jax.distributed.initialize,
             coordinator_address=addr,
             num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
             process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
             retry_on=(RuntimeError, OSError),
-            on_error=lambda i, e: print(
-                "init_parallel_env: coordinator handshake with %s failed "
-                "(try %d): %s" % (addr, i + 1, e)))
+            site="bootstrap",
+            on_error=_on_error)
     _initialized = True
     from . import collective
     collective._ensure_world_group()

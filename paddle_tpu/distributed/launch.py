@@ -15,12 +15,18 @@ device set.
 from __future__ import annotations
 
 import argparse
+import logging
 import os
 import signal
 import socket
 import subprocess
 import sys
 import time
+
+from ..observability import journal as run_journal
+from ..observability import metrics
+
+logger = logging.getLogger("paddle_tpu.launch")
 
 
 def _free_port() -> int:
@@ -63,8 +69,17 @@ def launch_collective(args) -> int:
     endpoints = ",".join(
         f"127.0.0.1:{_free_port()}" for _ in range(world))
     log_dir = args.log_dir
+    journal_obj = prev_journal = None
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+        # the launcher's own journal sits next to the per-rank worker ones
+        # (workers write journal-rank<N>.jsonl into their telemetry_dir)
+        journal_obj = run_journal.RunJournal(
+            log_dir, filename="journal-launch.jsonl",
+            rank=args.node_rank)
+        prev_journal = run_journal.set_journal(journal_obj)
+        journal_obj.emit("launch_start", nnodes=args.nnodes,
+                         nproc_per_node=nprocs, world=world, master=master)
 
     def spawn(local_rank, respawn=False):
         rank = args.node_rank * nprocs + local_rank
@@ -94,9 +109,13 @@ def launch_collective(args) -> int:
                *args.training_script_args]
         out = (open(os.path.join(log_dir, f"workerlog.{rank}"),
                     "a" if respawn else "w") if log_dir else None)
-        return (subprocess.Popen(cmd, env=env, stdout=out,
-                                 stderr=subprocess.STDOUT if out else None),
-                out)
+        proc = subprocess.Popen(cmd, env=env, stdout=out,
+                                stderr=subprocess.STDOUT if out else None)
+        logger.info("spawned worker rank %d pid %d%s", rank, proc.pid,
+                    " (respawn)" if respawn else "")
+        run_journal.emit("worker_spawn", rank=rank, pid=proc.pid,
+                         respawn=bool(respawn))
+        return (proc, out)
 
     procs = [spawn(lr) for lr in range(nprocs)]
 
@@ -120,13 +139,22 @@ def launch_collective(args) -> int:
                 if code is None:
                     alive = True
                 elif code != 0:
+                    run_journal.emit("worker_exit", local_rank=idx,
+                                     pid=p.pid, code=code)
                     if restarts < max_restarts:
                         restarts += 1
                         delay = backoff.backoff(restarts)
-                        print("launch: worker pid %d (local rank %d) exited "
-                              "with code %d — restart %d/%d in %.1fs"
-                              % (p.pid, idx, code, restarts, max_restarts,
-                                 delay), file=sys.stderr)
+                        logger.warning(
+                            "worker pid %d (local rank %d) exited with code "
+                            "%d — restart %d/%d in %.1fs", p.pid, idx, code,
+                            restarts, max_restarts, delay)
+                        metrics.counter("pt_worker_restarts_total",
+                                        "Failed workers respawned by the "
+                                        "launcher").inc()
+                        run_journal.emit("worker_restart", local_rank=idx,
+                                         restart=restarts,
+                                         max_restarts=max_restarts,
+                                         delay_s=round(delay, 3))
                         time.sleep(delay)
                         if out:
                             out.close()
@@ -148,16 +176,26 @@ def launch_collective(args) -> int:
             except subprocess.TimeoutExpired:
                 p.kill()
         if isinstance(e, RuntimeError):
-            print(f"launch: {e}", file=sys.stderr)
+            logger.error("launch failed: %s", e)
             rc = rc or 1
     finally:
         for _, out in procs:
             if out:
                 out.close()
+        if journal_obj is not None:
+            journal_obj.emit("launch_end", rc=rc, restarts=restarts)
+            run_journal.set_journal(prev_journal)
+            journal_obj.close()
     return rc
 
 
 def main(argv=None) -> int:
+    # human-readable console output, verbosity via PADDLE_TPU_LOG_LEVEL
+    # (the journal, not the console, is the machine-readable record)
+    logging.basicConfig(
+        level=os.environ.get("PADDLE_TPU_LOG_LEVEL", "INFO").upper(),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stderr)
     args = _parse_args(argv)
     return launch_collective(args)
 

@@ -10,7 +10,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "CallbackList", "VisualDL"]
+           "EarlyStopping", "CallbackList", "VisualDL", "TelemetryCallback"]
 
 
 class Callback:
@@ -254,3 +254,91 @@ class VisualDL(Callback):
         if self._f is not None:
             self._f.close()
             self._f = None
+
+
+def _device_mem_bytes():
+    """Best-effort device memory in use. TPU/GPU backends expose
+    memory_stats(); the CPU backend returns None there, so fall back to
+    summing live jax array footprints (an under-count — live python
+    handles only — but monotone with real usage)."""
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        stats_fn = getattr(dev, "memory_stats", None)
+        if stats_fn is not None:
+            stats = stats_fn()
+            if stats and "bytes_in_use" in stats:
+                return int(stats["bytes_in_use"])
+        return int(sum(int(getattr(a, "nbytes", 0) or 0)
+                       for a in jax.live_arrays()))
+    except Exception:
+        return -1
+
+
+class TelemetryCallback(Callback):
+    """Samples loss / throughput / device memory into the metrics registry
+    and emits per-step `step` events into the active run journal.
+
+    Installed automatically by `Model.fit(telemetry_dir=...)`; usable
+    standalone like any other callback. Memory is sampled every `mem_freq`
+    steps (live_arrays iteration is not free on big models)."""
+
+    def __init__(self, mem_freq=50):
+        super().__init__()
+        self.mem_freq = int(mem_freq)
+        from ..observability import metrics as _m
+        self._g_loss = _m.gauge("pt_loss", "Last sampled training loss")
+        self._g_sps = _m.gauge("pt_steps_per_sec",
+                               "Steps/sec over the last train batch")
+        self._g_ips = _m.gauge("pt_throughput_items_per_sec",
+                               "Samples/sec over the last train batch")
+        self._g_mem = _m.gauge("pt_device_mem_bytes",
+                               "Device memory in use (best effort)")
+        self._epoch = 0
+        self._global_step = 0
+        self._t_last = None
+
+    def on_train_begin(self, logs=None):
+        self._t_last = time.perf_counter()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t_last = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..observability import journal
+        now = time.perf_counter()
+        dt = now - self._t_last if self._t_last is not None else None
+        self._t_last = now
+        self._global_step += 1
+        loss = (logs or {}).get("loss")
+        ev = {"step": self._global_step, "epoch": self._epoch}
+        if loss is not None:
+            try:
+                loss = float(np.asarray(loss).ravel()[0])
+                self._g_loss.set(loss)
+                ev["loss"] = round(loss, 6)
+            except (TypeError, ValueError):
+                pass
+        if dt and dt > 0:
+            self._g_sps.set(1.0 / dt)
+            ev["step_s"] = round(dt, 6)
+            bs = self.params.get("batch_size")
+            if bs:
+                self._g_ips.set(bs / dt)
+        if self._global_step % self.mem_freq == 1 or self.mem_freq == 1:
+            mem = _device_mem_bytes()
+            if mem >= 0:
+                self._g_mem.set(mem)
+                ev["mem_bytes"] = mem
+        journal.emit("step", **ev)
+
+    def on_epoch_end(self, epoch, logs=None):
+        from ..observability import journal
+        journal.emit("epoch_end", epoch=epoch)
+
+    def on_eval_end(self, logs=None):
+        from ..observability import journal
+        loss = (logs or {}).get("loss")
+        journal.emit("eval_end", step=self._global_step,
+                     loss=None if loss is None else float(loss))

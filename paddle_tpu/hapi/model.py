@@ -5,7 +5,9 @@ also traces to XLA; `prepare(..., jit=True)` (default) compiles the whole
 train step — the TPU replacement for the reference's static-graph adapter."""
 from __future__ import annotations
 
+import logging
 import os
+import time
 import warnings
 
 import numpy as np
@@ -17,10 +19,15 @@ from ..framework.flags import flag
 from ..framework.tensor import Tensor
 from ..io import DataLoader
 from ..metric import Metric
+from ..observability import journal as run_journal
+from ..observability import tracing
 from ..resilience import AnomalyGuard, PreemptionGuard, chaos
-from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
+from .callbacks import (Callback, CallbackList, ProgBarLogger,
+                        ModelCheckpoint, TelemetryCallback)
 
 __all__ = ["Model"]
+
+logger = logging.getLogger("paddle_tpu.hapi")
 
 
 class _InputSpec:
@@ -148,7 +155,15 @@ class Model:
         return results
 
     def _pack(self, loss, metrics):
-        loss_v = float(loss.numpy()) if isinstance(loss, Tensor) else loss
+        if isinstance(loss, Tensor):
+            # the float() is the step's host<-device sync point — the time
+            # the python thread spends blocked on the device here is the
+            # per-step dispatch stall telemetry wants
+            t0 = time.perf_counter()
+            loss_v = float(loss.numpy())
+            tracing.record_sync(time.perf_counter() - t0)
+        else:
+            loss_v = loss
         logs = {"loss": loss_v}
         logs.update(metrics)
         return logs
@@ -158,13 +173,22 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None,
-            auto_checkpoint_dir=None, exit_on_preempt=True):
+            auto_checkpoint_dir=None, exit_on_preempt=True,
+            telemetry_dir=None):
         """Train. With `auto_checkpoint_dir` set, fit is PREEMPTION-SAFE:
         SIGTERM/SIGINT is deferred to the next batch boundary, an atomic
         checkpoint (params + optimizer + position + RNG) is written there,
         and the process exits cleanly (rc=0) — a relaunched fit with the
         same dir resumes where it left off with loss-trajectory continuity.
-        `exit_on_preempt=False` returns instead (self.preempted is True)."""
+        `exit_on_preempt=False` returns instead (self.preempted is True).
+
+        With `telemetry_dir` set, the run writes its observability
+        artifacts there: a per-rank JSONL run journal
+        (journal-rank<N>.jsonl — step/checkpoint/preemption/retry events,
+        see docs/OBSERVABILITY.md) that resilience and the jit engine emit
+        into for the duration of the fit, plus a final `metrics.json`
+        registry snapshot; a TelemetryCallback sampling loss/throughput/
+        device memory is installed automatically."""
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = self._to_loader(eval_data, batch_size, False, False,
@@ -175,13 +199,29 @@ class Model:
             cbks.append(ModelCheckpoint(save_freq, save_dir))
         if callbacks:
             cbks += list(callbacks)
+
+        journal_obj = prev_journal = None
+        if telemetry_dir:
+            try:
+                from ..distributed.env import get_rank
+                rank = int(get_rank())
+            except Exception:
+                rank = None
+            journal_obj = run_journal.RunJournal(telemetry_dir, rank=rank)
+            prev_journal = run_journal.set_journal(journal_obj)
+            journal_obj.emit("run_start", epochs=epochs,
+                             batch_size=batch_size, jit=self._use_jit)
+            if not any(isinstance(c, TelemetryCallback) for c in cbks):
+                cbks.append(TelemetryCallback())
+
         cbk = CallbackList(cbks)
         cbk.set_model(self)
         try:
             steps = len(train_loader)
         except TypeError:
             steps = None
-        cbk.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
+        cbk.set_params({"epochs": epochs, "steps": steps,
+                        "batch_size": batch_size, "verbose": verbose})
 
         resume = None
         ckpt_path = None
@@ -209,56 +249,75 @@ class Model:
         self.preempted = False
         cbk.on_train_begin()
         try:
-            for epoch in range(max(0, resume_epoch), epochs):
-                cbk.on_epoch_begin(epoch)
-                for m in self._metrics:
-                    m.reset()
-                logs = {}
-                for step, batch in enumerate(train_loader):
-                    if epoch == resume_epoch and step <= resume_step:
-                        continue  # consumed before the preemption checkpoint
-                    chaos.step_hook(it_count)
-                    cbk.on_train_batch_begin(step)
-                    inputs, labels = self._split_batch(batch)
-                    logs = self.train_batch(inputs, labels)
-                    cbk.on_train_batch_end(step, logs)
-                    it_count += 1
-                    if anomaly is not None:
-                        anomaly.observe(logs["loss"],
-                                        skipped=self.last_step_skipped)
-                    if guard is not None and guard.triggered:
-                        self._save_preempt(ckpt_path, epoch, step, it_count)
-                        self.preempted = True
-                        self.stop_training = True
+            try:
+                for epoch in range(max(0, resume_epoch), epochs):
+                    cbk.on_epoch_begin(epoch)
+                    for m in self._metrics:
+                        m.reset()
+                    logs = {}
+                    for step, batch in enumerate(train_loader):
+                        if epoch == resume_epoch and step <= resume_step:
+                            continue  # consumed before the preemption ckpt
+                        chaos.step_hook(it_count)
+                        cbk.on_train_batch_begin(step)
+                        inputs, labels = self._split_batch(batch)
+                        logs = self.train_batch(inputs, labels)
+                        cbk.on_train_batch_end(step, logs)
+                        it_count += 1
+                        if anomaly is not None:
+                            anomaly.observe(logs["loss"],
+                                            skipped=self.last_step_skipped)
+                        if guard is not None and guard.triggered:
+                            self._save_preempt(ckpt_path, epoch, step,
+                                               it_count)
+                            self.preempted = True
+                            self.stop_training = True
+                            break
+                        if num_iters is not None and it_count >= num_iters:
+                            break
+                    if self.preempted:
                         break
-                    if num_iters is not None and it_count >= num_iters:
+                    # epoch metrics
+                    for m in self._metrics:
+                        name = m.name()
+                        logs[name if isinstance(name, str)
+                             else name[0]] = m.accumulate()
+                    cbk.on_epoch_end(epoch, logs)
+                    if eval_loader is not None and \
+                            (epoch + 1) % eval_freq == 0:
+                        self._run_eval(eval_loader, cbk)
+                    if self.stop_training or (num_iters is not None
+                                              and it_count >= num_iters):
                         break
-                if self.preempted:
-                    break
-                # epoch metrics
-                for m in self._metrics:
-                    name = m.name()
-                    logs[name if isinstance(name, str) else name[0]] = m.accumulate()
-                cbk.on_epoch_end(epoch, logs)
-                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                    self._run_eval(eval_loader, cbk)
-                if self.stop_training or (num_iters is not None and it_count >= num_iters):
-                    break
+            finally:
+                if guard is not None:
+                    guard.uninstall()
+            cbk.on_train_end()
+            reset_tape()
+            if self.preempted:
+                logger.info("fit preempted (signal %s): checkpoint saved "
+                            "to %s", guard.signum, ckpt_path)
+                if verbose:
+                    print("fit preempted (signal %s): checkpoint saved to %s"
+                          % (guard.signum, ckpt_path))
+                if exit_on_preempt:
+                    import sys
+                    sys.exit(0)
+            elif ckpt_path and os.path.exists(ckpt_path):
+                import shutil
+                shutil.rmtree(ckpt_path, ignore_errors=True)
         finally:
-            if guard is not None:
-                guard.uninstall()
-        cbk.on_train_end()
-        reset_tape()
-        if self.preempted:
-            if verbose:
-                print("fit preempted (signal %s): checkpoint saved to %s"
-                      % (guard.signum, ckpt_path))
-            if exit_on_preempt:
-                import sys
-                sys.exit(0)
-        elif ckpt_path and os.path.exists(ckpt_path):
-            import shutil
-            shutil.rmtree(ckpt_path, ignore_errors=True)
+            if journal_obj is not None:
+                journal_obj.emit("run_end", it_count=it_count,
+                                 preempted=self.preempted)
+                try:
+                    from ..observability.metrics import REGISTRY
+                    REGISTRY.write_json(
+                        os.path.join(telemetry_dir, "metrics.json"))
+                except OSError as e:
+                    logger.warning("metrics snapshot failed: %s", e)
+                run_journal.set_journal(prev_journal)
+                journal_obj.close()
 
     def _save_preempt(self, path, epoch, step, it_count):
         """Atomic preemption checkpoint: state + exact loop position."""
@@ -267,7 +326,11 @@ class Model:
         meta = {"epoch": int(epoch), "step": int(step),
                 "it_count": int(it_count),
                 "rng_state": np.asarray(get_rng_state()).tolist()}
-        return save_checkpoint(path, self.network, self._optimizer, meta)
+        out = save_checkpoint(path, self.network, self._optimizer, meta)
+        run_journal.emit("checkpoint", kind="preempt", path=str(path),
+                         epoch=int(epoch), step=int(step),
+                         it_count=int(it_count))
+        return out
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):

@@ -29,8 +29,17 @@ from ..framework import state
 from ..framework.flags import flag
 from ..framework.random import RNG
 from ..framework.tensor import Tensor
+from ..observability import tracing
 from ..resilience import chaos
 from ..resilience.watchdog import StepWatchdog
+
+
+def _aval_sig(*arr_lists):
+    """Executable-cache signature of a dispatch: the (shape, dtype) avals
+    of the data arrays. Params/buffers keep their shapes for the lifetime
+    of a step fn, so data avals are exactly what drives jit retraces."""
+    return tuple((tuple(a.shape), str(a.dtype))
+                 for arrs in arr_lists for a in arrs)
 
 
 def _param_spec(p, mesh, zero3=False):
@@ -244,6 +253,7 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
     # get_rng_state() hands out the very same array, which donation would
     # delete under a checkpointed-reproducibility pattern.
     jitted = jax.jit(step_fn, donate_argnums=(0, 2, 3))
+    telemetry = tracing.StepTelemetry("jit_train")
 
     if mesh is not None:
         _param_sh = [NamedSharding(mesh, s) for s in _pspecs]
@@ -282,22 +292,26 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
         wd_s = float(flag("step_watchdog_s") or 0.0)
         args = (param_arrs, frozen_arrs, buf_arrs, acc_arrs, key, t, lr,
                 in_arrs, lab_arrs)
-        if wd_s > 0:
-            # a wedged backend hangs INSIDE dispatch/blocking with no
-            # python-level recourse; the watchdog makes it observable
-            # (all-thread stack dump) and, with action=abort, recoverable
-            # by a supervisor. block_until_ready pulls the hang into the
-            # watchdog's scope (dispatch alone returns futures).
-            with StepWatchdog(wd_s,
-                              context="compiled train step %d"
-                                      % optimizer._step_count,
-                              action=str(flag("step_watchdog_action"))):
+        with telemetry.step(_aval_sig(in_arrs, lab_arrs)):
+            if wd_s > 0:
+                # a wedged backend hangs INSIDE dispatch/blocking with no
+                # python-level recourse; the watchdog makes it observable
+                # (all-thread stack dump) and, with action=abort,
+                # recoverable by a supervisor. block_until_ready pulls the
+                # hang into the watchdog's scope (dispatch alone returns
+                # futures).
+                with StepWatchdog(wd_s,
+                                  context="compiled train step %d"
+                                          % optimizer._step_count,
+                                  action=str(flag("step_watchdog_action"))):
+                    chaos.hang_before_dispatch(optimizer._step_count)
+                    out = jitted(*args)
+                    jax.block_until_ready(out[0])
+            else:
                 chaos.hang_before_dispatch(optimizer._step_count)
                 out = jitted(*args)
-                jax.block_until_ready(out[0])
-        else:
-            chaos.hang_before_dispatch(optimizer._step_count)
-            out = jitted(*args)
+        if tracing.enabled():
+            tracing.TRAIN_STEPS.inc()
         loss, out_arrs, new_bufs, new_key, new_params, new_accs, ok = out
         if guard_nonfinite:
             call.last_step_skipped = not bool(ok)
@@ -320,6 +334,7 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
                 [Tensor(o, _internal=True) for o in out_arrs])
 
     call._params = params
+    call.telemetry = telemetry
     call.last_step_skipped = False
     call.skipped_steps = 0
     return call
@@ -417,6 +432,7 @@ def make_eval_step(network, loss_fn=None, mesh=None):
             RNG.key = saved_key
 
     jitted = jax.jit(fwd)
+    telemetry = tracing.StepTelemetry("jit_eval")
 
     def call(inputs, labels=()):
         if mesh is not None:
@@ -430,15 +446,18 @@ def make_eval_step(network, loss_fn=None, mesh=None):
                 t._data = _place(
                     t._data, NamedSharding(mesh,
                                            _batch_spec(mesh, t._data.ndim)))
-        out_arrs, loss, new_key = jitted(
-            [p._data for p in params + frozen],
-            [b._data for b in buffers], RNG.key,
-            [x._data for x in inputs], [x._data for x in labels])
+        in_arrs = [x._data for x in inputs]
+        lab_arrs = [x._data for x in labels]
+        with telemetry.step(_aval_sig(in_arrs, lab_arrs)):
+            out_arrs, loss, new_key = jitted(
+                [p._data for p in params + frozen],
+                [b._data for b in buffers], RNG.key, in_arrs, lab_arrs)
         RNG.key = new_key
         outs = [Tensor(o, _internal=True) for o in out_arrs]
         return (Tensor(loss, _internal=True) if loss is not None else None,
                 outs)
 
+    call.telemetry = telemetry
     return call
 
 
@@ -454,6 +473,7 @@ class TracedLayer:
         self._fn = fn
         self._layer = layer
         self._cache = {}
+        self.telemetry = tracing.StepTelemetry("to_static")
 
     def _get_layer(self, args):
         if self._layer is not None:
@@ -509,9 +529,10 @@ class TracedLayer:
 
             self._cache[key] = jax.jit(traced, static_argnums=())
         jitted = self._cache[key]
-        out_arrs, new_bufs, new_key, single = jitted(
-            [p._data for p in params], [b._data for b in buffers],
-            RNG.key, [t._data for t in tensors])
+        with self.telemetry.step(key):
+            out_arrs, new_bufs, new_key, single = jitted(
+                [p._data for p in params], [b._data for b in buffers],
+                RNG.key, [t._data for t in tensors])
         for b, a in zip(buffers, new_bufs):
             b._data = a
         RNG.key = new_key

@@ -1,0 +1,31 @@
+"""Unified telemetry layer: metrics registry, run journal, step tracing.
+
+Three pure-stdlib modules (importable without jax — the same contract as
+resilience/retry.py, so the launcher and the bench parent process can
+use them):
+
+  * `metrics`  — thread-safe Counter/Gauge/Histogram registry with
+                 Prometheus-text and JSON/JSONL exporters (`REGISTRY`);
+  * `journal`  — append-only JSONL run journal, one file per rank, with
+                 a process-wide `emit()` that resilience guards and the
+                 launcher write into;
+  * `tracing`  — `StepTelemetry` retrace/compile/step-latency accounting
+                 used by the jit engine and the static executor, gated by
+                 `PADDLE_TPU_TELEMETRY` / `tracing.enable()`.
+
+See docs/OBSERVABILITY.md for the metric name table and journal event
+schema.
+"""
+from . import journal, metrics, tracing
+from .journal import RunJournal, emit, get_journal, read_journal, set_journal
+from .metrics import (REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
+                      exponential_buckets)
+from .tracing import StepTelemetry, enable, enabled, record_sync
+
+__all__ = [
+    "metrics", "journal", "tracing",
+    "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "exponential_buckets",
+    "RunJournal", "set_journal", "get_journal", "emit", "read_journal",
+    "StepTelemetry", "enabled", "enable", "record_sync",
+]

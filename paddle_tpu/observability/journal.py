@@ -1,0 +1,169 @@
+"""Structured run journal: append-only JSONL event log, one file per rank.
+
+Replaces the ad-hoc prints PR 1's resilience machinery scattered over
+stderr: every operational event of a training run — run start/end, step
+samples, checkpoints, preemptions, retries, watchdog firings, non-finite
+step skips, worker restarts — is one JSON line with a shared envelope
+
+    {"ts": ..., "run_id": ..., "rank": ..., "host": ..., "pid": ...,
+     "event": "<type>", ...event fields}
+
+so a fleet of per-worker journals can be merged and queried with nothing
+fancier than grep + jq. The reference analogue is the elastic manager's
+scattered logger calls (fleet/elastic/manager.py) — here normalized into
+one schema (docs/OBSERVABILITY.md).
+
+Module-level `emit()` routes through the process-wide active journal
+(installed by `Model.fit(telemetry_dir=...)`, the launcher, or tests via
+`set_journal`) and is a cheap no-op when none is installed — deep callers
+(resilience guards) emit unconditionally without plumbing a handle.
+
+Pure stdlib by contract (same rule as resilience/retry.py): the launcher
+and bench parent processes import this without touching jax.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["RunJournal", "set_journal", "get_journal", "emit",
+           "read_journal"]
+
+logger = logging.getLogger("paddle_tpu.journal")
+
+
+def _default_rank() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+class RunJournal:
+    """Append-only JSONL event log with size-based rotation.
+
+        j = RunJournal("/tmp/run", run_id="r1", rank=0)
+        j.emit("step", step=12, loss=0.3)
+
+    The file is `<dir>/journal-rank<rank>.jsonl`; when it exceeds
+    `rotate_bytes` it is renamed to `<file>.1` (one generation kept) and a
+    fresh file is started — bounded disk for long runs. Writes are
+    line-buffered + flushed so a SIGKILL loses at most the current line,
+    and the lock is re-entrant so a signal handler (PreemptionGuard) can
+    emit while the interrupted frame holds it."""
+
+    def __init__(self, directory: str, run_id: Optional[str] = None,
+                 rank: Optional[int] = None,
+                 rotate_bytes: int = 64 * 1024 * 1024,
+                 filename: Optional[str] = None):
+        self.directory = directory
+        self.rank = _default_rank() if rank is None else int(rank)
+        self.run_id = run_id or time.strftime("%Y%m%dT%H%M%S") + \
+            "-p%d" % os.getpid()
+        self.rotate_bytes = int(rotate_bytes)
+        self.host = socket.gethostname()
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(
+            directory, filename or "journal-rank%d.jsonl" % self.rank)
+        self._lock = threading.RLock()
+        self._f = open(self.path, "a")
+        self._size = self._f.tell()
+        self.events_written = 0
+
+    def emit(self, event: str, **fields) -> bool:
+        """Append one event line. Never raises (a failing journal must not
+        take down the run it observes); returns write success."""
+        rec = {"ts": round(time.time(), 6), "run_id": self.run_id,
+               "rank": self.rank, "host": self.host, "pid": os.getpid(),
+               "event": event}
+        rec.update(fields)
+        try:
+            line = json.dumps(rec, default=str) + "\n"
+        except (TypeError, ValueError) as e:
+            logger.warning("journal: unserializable %r event dropped: %s",
+                           event, e)
+            return False
+        with self._lock:
+            try:
+                if self._f.closed:
+                    return False
+                if self._size + len(line) > self.rotate_bytes and \
+                        self._size > 0:
+                    self._rotate()
+                self._f.write(line)
+                self._f.flush()
+                self._size += len(line)
+                self.events_written += 1
+                return True
+            except OSError as e:
+                logger.warning("journal write failed: %s", e)
+                return False
+
+    def _rotate(self):
+        self._f.close()
+        os.replace(self.path, self.path + ".1")
+        self._f = open(self.path, "a")
+        self._size = 0
+
+    def close(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+_active: Optional[RunJournal] = None
+_active_lock = threading.Lock()
+
+
+def set_journal(journal: Optional[RunJournal]) -> Optional[RunJournal]:
+    """Install `journal` as the process-wide event sink; returns the
+    previous one (callers restore it when their scope ends)."""
+    global _active
+    with _active_lock:
+        prev = _active
+        _active = journal
+    return prev
+
+
+def get_journal() -> Optional[RunJournal]:
+    return _active
+
+
+def emit(event: str, **fields) -> bool:
+    """Emit into the active journal (no-op without one). Also mirrors to
+    the `paddle_tpu.journal` logger at DEBUG so `logging` verbosity alone
+    can surface the stream without a journal file."""
+    j = _active
+    if logger.isEnabledFor(logging.DEBUG):
+        logger.debug("%s %s", event, fields)
+    if j is None:
+        return False
+    return j.emit(event, **fields)
+
+
+def read_journal(path: str) -> List[dict]:
+    """Parse a journal file; corrupt/truncated lines are skipped (a crash
+    mid-write must not make the whole journal unreadable)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

@@ -1,0 +1,171 @@
+"""Step/compile telemetry: retrace accounting + latency recording.
+
+The reference's perf evidence comes from RecordEvent spans
+(platform/profiler.h) stitched into chrome traces; on TPU the questions
+that matter are different — *how many times did XLA recompile, how long
+did compiles take, and what is the steady-state step time once the
+executable cache is warm?* `StepTelemetry` answers them for one dispatch
+engine (jit train/eval step, to_static TracedLayer, static Executor):
+
+  * every executable-cache MISS (first trace included — a retrace is any
+    signature the engine has not compiled yet) increments
+    `pt_jit_retraces_total{engine=...}` and banks its wall time into
+    `pt_jit_compile_seconds_total{engine=...}`;
+  * cache HITS record in-call wall time into
+    `pt_step_latency_seconds{engine=...}` and — the number that survives
+    async dispatch, where a call returns before the device finishes —
+    entry-to-entry gaps into `pt_step_interval_seconds{engine=...}`,
+    whose mean IS the steady-state step time of a saturated loop.
+
+Each span also opens a `utils.profiler.RecordEvent` (lazily imported so
+this module stays pure stdlib) so the same boundaries show up in chrome
+traces when the profiler is on.
+
+Telemetry defaults ON and is cheap (a set lookup + two clock reads per
+step); `PADDLE_TPU_TELEMETRY=0` or `enable(False)` turns the spans into
+no-ops — the overhead contract (≤5% steady-state, asserted in
+tests/test_observability.py) is measured against that switch.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from . import journal, metrics
+
+__all__ = ["enabled", "enable", "StepTelemetry", "record_sync",
+           "SYNC_SECONDS", "TRAIN_STEPS"]
+
+_enabled = os.environ.get("PADDLE_TPU_TELEMETRY", "1") != "0"
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True):
+    """Flip telemetry globally (tests and the overhead benchmark)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+RETRACES = metrics.counter(
+    "pt_jit_retraces_total",
+    "Executable-cache misses (first compile included) per engine",
+    labelnames=("engine",))
+COMPILE_SECONDS = metrics.counter(
+    "pt_jit_compile_seconds_total",
+    "Wall time spent tracing+compiling per engine", labelnames=("engine",))
+STEP_LATENCY = metrics.histogram(
+    "pt_step_latency_seconds",
+    "In-call wall time of cache-hit dispatches (async: excludes device "
+    "time still in flight)", labelnames=("engine",))
+STEP_INTERVAL = metrics.histogram(
+    "pt_step_interval_seconds",
+    "Entry-to-entry gap between consecutive cache-hit dispatches; mean "
+    "== steady-state step time of a saturated loop",
+    labelnames=("engine",))
+SYNC_SECONDS = metrics.counter(
+    "pt_device_sync_seconds_total",
+    "Wall time blocked on device sync (host reads of device values)")
+TRAIN_STEPS = metrics.counter(
+    "pt_train_steps_total", "Train steps dispatched")
+
+
+class _Span:
+    """One dispatch measurement; hand back via StepTelemetry.step()."""
+
+    __slots__ = ("tel", "miss", "t0", "_ev")
+
+    def __init__(self, tel: "StepTelemetry", miss: bool):
+        self.tel = tel
+        self.miss = miss
+        self._ev = None
+
+    def __enter__(self):
+        if self.tel is not None:
+            self._ev = _record_event(
+                ("compile:" if self.miss else "step:") + self.tel.engine)
+            if self._ev is not None:
+                self._ev.begin()
+            self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.tel is not None:
+            dt = time.perf_counter() - self.t0
+            if self._ev is not None:
+                self._ev.end()
+            if exc_type is None:
+                self.tel._finish(self, dt)
+        return False
+
+
+_NULL_SPAN = _Span(None, False)
+
+
+def _record_event(name: str):
+    # lazy: utils.profiler pulls in jax; only touch it when a profiler
+    # session could actually be live
+    try:
+        from ..utils import profiler
+        if profiler.profiler_enabled():
+            return profiler.RecordEvent(name)
+    except Exception:
+        pass
+    return None
+
+
+class StepTelemetry:
+    """Retrace + latency accounting for one dispatch engine.
+
+        tel = StepTelemetry("jit_train")
+        with tel.step(signature):      # signature: hashable aval key
+            ...trace/compile/dispatch...
+    """
+
+    def __init__(self, engine: str):
+        self.engine = engine
+        self._seen = set()
+        self._last_hit_entry: Optional[float] = None
+        self._retraces = RETRACES.labels(engine)
+        self._compile_s = COMPILE_SECONDS.labels(engine)
+        self._latency = STEP_LATENCY.labels(engine)
+        self._interval = STEP_INTERVAL.labels(engine)
+
+    def step(self, signature) -> _Span:
+        if not _enabled:
+            return _NULL_SPAN
+        miss = signature not in self._seen
+        if miss:
+            self._seen.add(signature)
+        else:
+            now = time.perf_counter()
+            if self._last_hit_entry is not None:
+                self._interval.observe(now - self._last_hit_entry)
+            self._last_hit_entry = now
+        return _Span(self, miss)
+
+    def _finish(self, span: _Span, dt: float):
+        if span.miss:
+            self._retraces.inc()
+            self._compile_s.inc(dt)
+            # a recompile breaks the steady-state run; restart the
+            # interval chain so compile stalls don't pollute step time
+            self._last_hit_entry = None
+            journal.emit("retrace", engine=self.engine,
+                         compile_s=round(dt, 6),
+                         total=int(self._retraces.value))
+        else:
+            self._latency.observe(dt)
+
+    @property
+    def retraces(self) -> int:
+        return int(self._retraces.value)
+
+
+def record_sync(seconds: float):
+    """Bank wall time a host thread spent blocked on device results."""
+    if _enabled:
+        SYNC_SECONDS.inc(seconds)

@@ -774,3 +774,52 @@ def nce(x, weight, bias, label, key, *, num_neg_samples=5,
     loss = jnp.logaddexp(0.0, log_b - s_pos) \
         + jnp.sum(jnp.logaddexp(0.0, s_neg - log_b), axis=1)
     return loss.reshape(B, 1)
+
+
+@primitive("prroi_pool_op")
+def prroi_pool(x, boxes, *, output_size, spatial_scale=1.0):
+    """Precise RoI pooling (reference: operators/prroi_pool_op.h, from
+    IoU-Net "Acquisition of Localization Confidence"): the EXACT integral
+    of the bilinearly-interpolated feature map over each bin, divided by
+    the bin area. Unlike roi_align there is no sampling-point grid, and
+    unlike roi_pool no coordinate quantization — the output is continuous
+    AND differentiable in the box coordinates, which is what lets IoU-Net
+    run gradient ascent on box location.
+
+    The 2-D integral of the bilinear surface separates per axis:
+
+        out[c,i,j] = sum_{h,w} v[c,h,w] * WY[i,h] * WX[j,w] / area(i,j)
+
+    where WY[i,h] = H(b_i - h) - H(a_i - h) integrates the hat function
+    max(0, 1-|t|) over bin i's [a_i, b_i], H being its antiderivative.
+
+    x: [1, C, H, W] (batch slice), boxes: [R, 4] (x1, y1, x2, y2) in
+    input coords, scaled by spatial_scale. Returns [R, C, ph, pw]."""
+    _, c, h, w = x.shape
+    ph, pw = output_size
+    img = x[0]
+
+    def hat_int(u):
+        # antiderivative of the hat: 0 | (u+1)^2/2 | 1/2+u-u^2/2 | 1
+        u = jnp.clip(u, -1.0, 1.0)
+        return jnp.where(u <= 0, 0.5 * (u + 1.0) ** 2,
+                         0.5 + u - 0.5 * u * u)
+
+    def axis_weights(lo, hi, n_bins, size):
+        # [n_bins, size]: integral of the hat at each grid line over bin k
+        bw_ = (hi - lo) / n_bins
+        starts = lo + bw_ * jnp.arange(n_bins, dtype=img.dtype)
+        rel = starts[:, None] - jnp.arange(size, dtype=img.dtype)[None, :]
+        return hat_int(rel + bw_) - hat_int(rel), bw_
+
+    def pool_one(box):
+        wy, bh = axis_weights(box[1] * spatial_scale,
+                              box[3] * spatial_scale, ph, h)
+        wx, bw_ = axis_weights(box[0] * spatial_scale,
+                               box[2] * spatial_scale, pw, w)
+        # degenerate (zero-extent) rois integrate to 0 over ~0 area;
+        # the epsilon keeps that 0/0 a plain 0 with a finite gradient
+        area = jnp.maximum(bh * bw_, 1e-6)
+        return jnp.einsum("chw,ih,jw->cij", img, wy, wx) / area
+
+    return jax.vmap(pool_one)(boxes)

@@ -11,7 +11,13 @@ package is the TPU-native consolidation of those mechanisms:
   chaos       deterministic fault injection (PADDLE_TPU_CHAOS) so every one
               of these paths is exercised by tier-1 tests on the CPU mesh
 
-See docs/RESILIENCE.md for the operator-facing knobs.
+Every guard reports into the observability layer when it is importable:
+preemptions, watchdog firings, non-finite skips and retry attempts land as
+counters in `observability.metrics.REGISTRY` and as events in the active
+run journal (`observability.journal`) — nothing here prints to stdout.
+
+See docs/RESILIENCE.md for the operator-facing knobs and
+docs/OBSERVABILITY.md for the emitted metrics/events.
 """
 from __future__ import annotations
 

@@ -56,6 +56,19 @@ class AnomalyGuard:
             return False
         self.consecutive += 1
         self.total_skipped += 1
+        try:
+            # the guard owns this counter (not the jit engine) so eager and
+            # compiled skips land in ONE series and are never double-counted
+            from ..observability import journal, metrics
+            metrics.counter("pt_nonfinite_steps_total",
+                            "Train steps skipped for non-finite "
+                            "loss/grads").inc()
+            journal.emit("nonfinite_skip",
+                         loss=None if loss is None else str(loss),
+                         consecutive=self.consecutive,
+                         total=self.total_skipped)
+        except Exception:
+            pass
         if self.scaler is not None and getattr(self.scaler, "_enable", False):
             # a skipped step IS a found_inf event for the loss scaler: let
             # its decr_every_n/incr_every_n state machine shrink the scale

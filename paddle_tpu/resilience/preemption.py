@@ -73,6 +73,16 @@ class PreemptionGuard:
         self.triggered = True
         self.signum = signum
         self.trigger_time = time.monotonic()
+        try:
+            # journal emission from a signal handler is safe: RunJournal
+            # locks with an RLock, so interrupting a frame that holds the
+            # journal lock cannot deadlock
+            from ..observability import journal, metrics
+            metrics.counter("pt_preemptions_total",
+                            "Preemption signals caught").inc()
+            journal.emit("preemption", signum=int(signum))
+        except Exception:
+            pass  # telemetry must not lose the preemption flag
         for fn in self._callbacks:
             try:
                 fn(signum)

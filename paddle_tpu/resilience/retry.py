@@ -20,6 +20,24 @@ import time
 from typing import Callable, Iterator, Optional, Tuple, Type
 
 
+def _observe_retry(site: str, attempt: int, error: BaseException):
+    """Best-effort telemetry. This module is also loaded STANDALONE (no
+    package parent — bench.py's spec_from_file_location), where the
+    relative import fails; telemetry is then silently unavailable."""
+    try:
+        from ..observability import journal, metrics
+    except Exception:
+        return
+    try:
+        metrics.counter("pt_retry_attempts_total",
+                        "Failed attempts retried, by call site",
+                        labelnames=("site",)).labels(site).inc()
+        journal.emit("retry", site=site, attempt=attempt,
+                     error=repr(error))
+    except Exception:
+        pass
+
+
 class DeadlineExceeded(TimeoutError):
     """A wall-clock deadline expired before the operation completed."""
 
@@ -118,15 +136,19 @@ class RetryPolicy:
     def call(self, fn: Callable, *args,
              retry_on: Tuple[Type[BaseException], ...] = (Exception,),
              on_error: Optional[Callable[[int, BaseException], None]] = None,
+             site: str = "",
              **kwargs):
         """Run `fn` under the policy; return its first successful result.
-        Raises RetryExhausted (chaining the last error) on exhaustion."""
+        Raises RetryExhausted (chaining the last error) on exhaustion.
+        `site` labels the retry in telemetry (defaults to fn's name)."""
         last: Optional[BaseException] = None
+        site = site or getattr(fn, "__name__", "call")
         for attempt in self.attempts():
             try:
                 return fn(*args, **kwargs)
             except retry_on as e:
                 last = e
+                _observe_retry(site, attempt, e)
                 if on_error is not None:
                     on_error(attempt, e)
         raise RetryExhausted(

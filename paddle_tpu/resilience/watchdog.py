@@ -66,12 +66,23 @@ class StepWatchdog:
     def _fire(self):
         self.fired = True
         try:
+            # stderr faulthandler dump stays — it is the artifact that
+            # matters when the process is about to be killed; the journal
+            # line makes the firing greppable across a fleet's runs
             self._dump(sys.stderr)
             if self.diag_path:
                 with open(self.diag_path, "a") as f:
                     self._dump(f)
         except Exception:
             pass  # diagnostics must never mask the original condition
+        try:
+            from ..observability import journal, metrics
+            metrics.counter("pt_watchdog_fires_total",
+                            "StepWatchdog timeouts").inc()
+            journal.emit("watchdog", context=self.context,
+                         timeout_s=self.timeout_s, action=self.action)
+        except Exception:
+            pass
         if self.on_fire is not None:
             try:
                 self.on_fire()

@@ -18,6 +18,7 @@ import numpy as np
 from ..framework import state
 from ..framework.place import Place
 from ..framework.tensor import Tensor
+from ..observability import tracing
 from .program import Program, Variable, default_main_program
 
 __all__ = ["Executor", "global_scope", "Scope"]
@@ -236,6 +237,7 @@ class Executor:
     def __init__(self, place: Optional[Place] = None):
         self.place = place
         self._cache: Dict[tuple, _CompiledProgram] = {}
+        self.telemetry = tracing.StepTelemetry("static")
 
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name="feed", fetch_var_name="fetch", scope=None,
@@ -271,11 +273,15 @@ class Executor:
                tuple(tuple(np.asarray(a).shape) + (str(np.asarray(a).dtype),)
                      for a in feed_arrays),
                tuple(fetch_names), train, opt_id, asp_on)
-        cp = self._cache.get(key)
-        if cp is None:
-            cp = _CompiledProgram(program, feed_names, fetch_names, train)
-            self._cache[key] = cp
-        results = cp.run(feed_arrays)
+        # telemetry signature == the executable-cache key: a miss here is
+        # exactly one program construction + first-call XLA compile
+        with self.telemetry.step(key):
+            cp = self._cache.get(key)
+            if cp is None:
+                cp = _CompiledProgram(program, feed_names, fetch_names,
+                                      train)
+                self._cache[key] = cp
+            results = cp.run(feed_arrays)
         if return_numpy:
             return [np.asarray(r) for r in results]
         return [Tensor(r, _internal=True) for r in results]

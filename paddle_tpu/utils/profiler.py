@@ -34,29 +34,51 @@ def _native():
     return _native_rec or None
 
 
+def profiler_enabled() -> bool:
+    """True between start_profiler and stop_profiler."""
+    return _enabled
+
+
 def start_profiler(state="All", tracer_option="Default",
                    jax_trace_dir=None):
-    """reference: fluid/profiler.py start_profiler."""
+    """reference: fluid/profiler.py start_profiler.
+
+    Idempotent: a second start while already profiling is a no-op (the
+    running session keeps its settings), and a jax trace that is already
+    live (e.g. started directly via jax.profiler) does not raise."""
     global _enabled, _jax_trace_dir
+    if _enabled:
+        return
     _enabled = True
     rec = _native()
     if rec:
         rec.enable(True)
     if jax_trace_dir or tracer_option == "All":
         import jax
-        _jax_trace_dir = jax_trace_dir or "/tmp/paddle_tpu_jax_trace"
-        jax.profiler.start_trace(_jax_trace_dir)
+        want = jax_trace_dir or "/tmp/paddle_tpu_jax_trace"
+        try:
+            jax.profiler.start_trace(want)
+            _jax_trace_dir = want
+        except RuntimeError:
+            # a trace is already in flight; leave it owned by its starter
+            _jax_trace_dir = None
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    """reference: fluid/profiler.py stop_profiler — writes chrome trace."""
+    """reference: fluid/profiler.py stop_profiler — writes chrome trace.
+
+    Safe when no profiler is running (stop-without-start) and when the
+    jax trace was already stopped out from under us."""
     global _enabled, _jax_trace_dir
     _enabled = False
     rec = _native()
     if _jax_trace_dir is not None:
-        import jax
-        jax.profiler.stop_trace()
         _jax_trace_dir = None
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError:
+            pass
     data = export_chrome_trace()
     if profile_path:
         with open(profile_path, "w") as f:

@@ -13,6 +13,7 @@ from ..framework.dispatch import primitive, raw
 from ..framework.tensor import Tensor
 
 __all__ = ["yolo_box", "yolo_loss", "roi_align", "roi_pool", "RoIPool",
+           "prroi_pool",
            "psroi_pool", "PSRoIPool", "read_file", "decode_jpeg",
            "nms", "deform_conv2d", "RoIAlign",
            "DeformConv2D", "prior_box", "box_coder", "multiclass_nms",
@@ -616,6 +617,33 @@ class RoIPool:
 
     def __call__(self, x, boxes, boxes_num):
         return roi_pool(x, boxes, boxes_num, self._cfg[0], self._cfg[1])
+
+
+def prroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Precise RoI pooling — exact bilinear integral per bin, continuous
+    and differentiable in the box coordinates (reference:
+    operators/prroi_pool_op.h; primitive in ops/misc_ops.py)."""
+    from ..ops.misc_ops import prroi_pool as _prroi
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    nums = [int(v) for v in np.asarray(raw(boxes_num)).reshape(-1)]
+    outs = []
+    start = 0
+    for b, n in enumerate(nums):
+        if n == 0:
+            continue
+        outs.append(_prroi(x[b:b + 1], boxes[start:start + n],
+                           output_size=tuple(output_size),
+                           spatial_scale=float(spatial_scale)))
+        start += n
+    from ..tensor import concat
+    if not outs:  # no proposals anywhere: empty [0, C, ph, pw]
+        import jax.numpy as _jnp
+        from ..framework.tensor import Tensor
+        return Tensor(_jnp.zeros((0, int(x.shape[1])) + tuple(output_size),
+                                 raw(x).dtype), _internal=True)
+    return concat(outs, axis=0) if len(outs) > 1 else outs[0]
 
 
 @primitive("psroi_pool_op")

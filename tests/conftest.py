@@ -8,3 +8,9 @@ paddle_tpu/framework/platform.py)."""
 from paddle_tpu.framework.platform import pin_host_platform
 
 pin_host_platform(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from the tier-1 gate "
+        "(-m 'not slow')")

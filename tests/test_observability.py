@@ -1,0 +1,487 @@
+"""Observability layer: metrics registry, run journal, step/compile
+telemetry, fit(telemetry_dir=...), profiler idempotence, overhead bound.
+
+Everything runs on the CPU mesh (JAX_PLATFORMS=cpu in the tier-1 gate).
+"""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.observability import journal as run_journal
+from paddle_tpu.observability import metrics, tracing
+from paddle_tpu.observability.metrics import (MetricsRegistry,
+                                              exponential_buckets)
+
+
+# ---------------------------------------------------------------- metrics
+class TestMetricsMath:
+    def test_counter(self):
+        r = MetricsRegistry()
+        c = r.counter("c_total", "help")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge(self):
+        r = MetricsRegistry()
+        g = r.gauge("g")
+        g.set(10)
+        g.dec(4)
+        assert g.value == 6.0
+
+    def test_exponential_buckets(self):
+        b = exponential_buckets(0.001, 2.0, 4)
+        assert b == (0.001, 0.002, 0.004, 0.008)
+        with pytest.raises(ValueError):
+            exponential_buckets(0, 2.0, 4)
+        with pytest.raises(ValueError):
+            exponential_buckets(1.0, 1.0, 4)
+
+    def test_histogram_bucket_edges_upper_inclusive(self):
+        r = MetricsRegistry()
+        h = r.histogram("h", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.0, 1.5, 4.0, 9.0):
+            h.observe(v)
+        cum = dict(h._default().cumulative())
+        # le=1.0 includes the observation AT the edge (Prometheus contract)
+        assert cum[1.0] == 2
+        assert cum[2.0] == 3
+        assert cum[4.0] == 4
+        assert cum[math.inf] == 5
+        assert h.count == 5
+        assert h.sum == pytest.approx(16.0)
+        assert h.mean == pytest.approx(3.2)
+
+    def test_histogram_unsorted_buckets_sorted(self):
+        r = MetricsRegistry()
+        h = r.histogram("h2", buckets=(4.0, 1.0, 2.0))
+        assert h.buckets == (1.0, 2.0, 4.0)
+
+    def test_label_series_and_cardinality_cap(self):
+        r = MetricsRegistry()
+        c = r.counter("lc_total", "", labelnames=("k",))
+        c.labels("a").inc()
+        c.labels(k="a").inc()          # same child via kwargs
+        c.labels("b").inc()
+        assert c.labels("a").value == 2.0
+        assert c.series_count == 2
+        with pytest.raises(ValueError):
+            c.inc()                    # labeled metric needs .labels()
+        with pytest.raises(ValueError):
+            c.labels("a", "b")         # wrong arity
+        small = metrics.Counter("s_total", labelnames=("k",), max_series=3)
+        for i in range(3):
+            small.labels(str(i)).inc()
+        with pytest.raises(ValueError, match="cardinality"):
+            small.labels("overflow")
+
+    def test_registry_type_and_label_consistency(self):
+        r = MetricsRegistry()
+        r.counter("x_total")
+        with pytest.raises(TypeError):
+            r.gauge("x_total")
+        r.counter("y_total", labelnames=("a",))
+        with pytest.raises(ValueError):
+            r.counter("y_total", labelnames=("b",))
+        # get-or-create returns the same object
+        assert r.counter("x_total") is r.counter("x_total")
+
+    def test_snapshot_is_strict_json(self):
+        r = MetricsRegistry()
+        r.histogram("h", buckets=(0.1,)).observe(5.0)
+        r.gauge("g").set(1.5)
+        snap = json.loads(json.dumps(r.snapshot()))  # round-trip
+        assert snap["h"]["series"][0]["buckets"][-1][0] == "+Inf"
+        lines = r.to_jsonl().strip().split("\n")
+        assert len(lines) == 2
+        for line in lines:
+            json.loads(line)
+
+    def test_prometheus_text_parses(self):
+        r = MetricsRegistry()
+        c = r.counter("req_total", 'a "help"', labelnames=("code",))
+        c.labels("200").inc(3)
+        h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        text = r.to_prometheus()
+        # minimal exposition-format parser: every sample line is
+        # name{labels} value, cumulative bucket counts monotone, _count
+        # equals the +Inf bucket
+        samples = {}
+        for line in text.strip().split("\n"):
+            if line.startswith("#"):
+                assert line.split()[1] in ("HELP", "TYPE") or True
+                continue
+            name_lbl, value = line.rsplit(" ", 1)
+            float(value)
+            samples[name_lbl] = float(value)
+        assert samples['req_total{code="200"}'] == 3.0
+        assert samples['lat_seconds_bucket{le="0.1"}'] == 1
+        assert samples['lat_seconds_bucket{le="1.0"}'] == 2
+        assert samples['lat_seconds_bucket{le="+Inf"}'] == 2
+        assert samples["lat_seconds_count"] == 2
+        assert samples["lat_seconds_sum"] == pytest.approx(0.55)
+
+    def test_prometheus_label_escaping(self):
+        r = MetricsRegistry()
+        r.counter("e_total", labelnames=("p",)).labels('a"b\\c\nd').inc()
+        text = r.to_prometheus()
+        assert r'a\"b\\c\nd' in text
+
+    def test_thread_safety(self):
+        import threading
+        r = MetricsRegistry()
+        c = r.counter("t_total")
+        h = r.histogram("t_h", buckets=(0.5,))
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+                h.observe(0.1)
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.value == 8000
+        assert h.count == 8000
+
+
+# ---------------------------------------------------------------- journal
+class TestJournal:
+    def test_write_and_parse(self, tmp_path):
+        j = run_journal.RunJournal(str(tmp_path), run_id="r", rank=2)
+        assert j.emit("step", step=1, loss=0.5)
+        assert j.emit("checkpoint", path="/x")
+        j.close()
+        evs = run_journal.read_journal(j.path)
+        assert [e["event"] for e in evs] == ["step", "checkpoint"]
+        for e in evs:
+            assert e["run_id"] == "r" and e["rank"] == 2
+            assert "ts" in e and "host" in e and "pid" in e
+        assert j.path.endswith("journal-rank2.jsonl")
+
+    def test_rank_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "7")
+        j = run_journal.RunJournal(str(tmp_path))
+        j.close()
+        assert j.rank == 7 and "rank7" in j.path
+
+    def test_rotation(self, tmp_path):
+        j = run_journal.RunJournal(str(tmp_path), rotate_bytes=400)
+        for i in range(30):
+            j.emit("step", step=i)
+        j.close()
+        assert os.path.exists(j.path + ".1")
+        # both generations parse; current file stayed under the cap + 1 line
+        old = run_journal.read_journal(j.path + ".1")
+        new = run_journal.read_journal(j.path)
+        assert old and new
+        steps = [e["step"] for e in old + new]
+        assert steps == sorted(steps)
+
+    def test_corrupt_line_skipped(self, tmp_path):
+        j = run_journal.RunJournal(str(tmp_path))
+        j.emit("good", n=1)
+        j.close()
+        with open(j.path, "a") as f:
+            f.write("{truncated\n")
+        with open(j.path, "a") as f:
+            f.write(json.dumps({"event": "good2"}) + "\n")
+        evs = run_journal.read_journal(j.path)
+        assert [e["event"] for e in evs] == ["good", "good2"]
+
+    def test_module_emit_no_journal_is_noop(self):
+        prev = run_journal.set_journal(None)
+        try:
+            assert run_journal.emit("anything", x=1) is False
+        finally:
+            run_journal.set_journal(prev)
+
+    def test_emit_after_close_safe(self, tmp_path):
+        j = run_journal.RunJournal(str(tmp_path))
+        j.close()
+        assert j.emit("late") is False
+
+    def test_unserializable_field_dropped_not_raised(self, tmp_path):
+        j = run_journal.RunJournal(str(tmp_path))
+        assert j.emit("odd", obj=object())  # default=str handles it
+        j.close()
+        assert run_journal.read_journal(j.path)[0]["event"] == "odd"
+
+
+# ---------------------------------------------------------------- tracing
+class TestStepTelemetry:
+    def test_retrace_on_shape_change(self):
+        tel = tracing.StepTelemetry("t_unit")
+        base = tracing.RETRACES.labels("t_unit").value
+        with tel.step((("f32", (2, 3)),)):
+            pass
+        with tel.step((("f32", (2, 3)),)):
+            pass
+        with tel.step((("f32", (2, 3)),)):
+            pass
+        assert tel.retraces - base == 1
+        with tel.step((("f32", (4, 3)),)):  # aval change => retrace
+            pass
+        assert tel.retraces - base == 2
+        assert tracing.STEP_LATENCY.labels("t_unit").count == 2
+        assert tracing.COMPILE_SECONDS.labels("t_unit").value > 0
+
+    def test_interval_histogram_steady_state_only(self):
+        tel = tracing.StepTelemetry("t_iv")
+        h = tracing.STEP_INTERVAL.labels("t_iv")
+        with tel.step("a"):
+            pass                      # miss
+        with tel.step("a"):
+            pass                      # first hit: starts the chain
+        assert h.count == 0
+        with tel.step("a"):
+            pass
+        with tel.step("a"):
+            pass
+        assert h.count == 2
+        with tel.step("b"):
+            pass                      # recompile breaks the chain
+        with tel.step("a"):
+            pass                      # new chain start after the miss
+        assert h.count == 2
+
+    def test_disabled_records_nothing(self):
+        tel = tracing.StepTelemetry("t_off")
+        was = tracing.enabled()
+        tracing.enable(False)
+        try:
+            with tel.step("sig"):
+                pass
+            with tel.step("sig"):
+                pass
+            assert tel.retraces == 0
+            assert tracing.STEP_LATENCY.labels("t_off").count == 0
+        finally:
+            tracing.enable(was)
+
+    def test_engine_retrace_counter_increments_on_shape_change(self):
+        from paddle_tpu.jit.engine import make_train_step
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        loss_fn = nn.MSELoss()
+        step = make_train_step(net, loss_fn, opt)
+        # .retraces reads the global jit_train counter (other tests in the
+        # suite bump it too), so assert on the delta
+        base = step.telemetry.retraces
+        x8 = paddle.to_tensor(np.ones((8, 4), np.float32))
+        y8 = paddle.to_tensor(np.zeros((8, 2), np.float32))
+        step([x8], [y8])
+        step([x8], [y8])
+        assert step.telemetry.retraces - base == 1
+        x4 = paddle.to_tensor(np.ones((4, 4), np.float32))
+        y4 = paddle.to_tensor(np.zeros((4, 2), np.float32))
+        step([x4], [y4])              # batch-shape change => retrace
+        assert step.telemetry.retraces - base == 2
+        step([x4], [y4])
+        assert step.telemetry.retraces - base == 2
+
+
+# ------------------------------------------------------------ fit + model
+class TestFitTelemetry:
+    def _fit(self, tmp_path, **kw):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        X = np.random.RandomState(0).rand(16, 8).astype("float32")
+        Y = np.zeros((16, 1), np.int64)
+        ds = [(X[i], Y[i]) for i in range(16)]
+        model.fit(ds, batch_size=8, epochs=1, verbose=0,
+                  telemetry_dir=str(tmp_path), **kw)
+        return model
+
+    def test_fit_writes_wellformed_journal_and_snapshot(self, tmp_path):
+        self._fit(tmp_path)
+        jpath = os.path.join(str(tmp_path), "journal-rank0.jsonl")
+        evs = run_journal.read_journal(jpath)
+        kinds = [e["event"] for e in evs]
+        assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+        steps = [e for e in evs if e["event"] == "step"]
+        assert len(steps) == 2
+        for s in steps:
+            assert "loss" in s and s["rank"] == 0
+        # every line carries the envelope
+        run_id = evs[0]["run_id"]
+        assert all(e["run_id"] == run_id for e in evs)
+        snap = json.load(open(os.path.join(str(tmp_path), "metrics.json")))
+        m = snap["metrics"]
+        assert m["pt_loss"]["series"][0]["value"] == pytest.approx(
+            steps[-1]["loss"], rel=1e-3)
+        assert m["pt_train_steps_total"]["series"][0]["value"] >= 2
+
+    def test_fit_restores_previous_journal(self, tmp_path):
+        sentinel = run_journal.RunJournal(str(tmp_path / "outer"))
+        prev = run_journal.set_journal(sentinel)
+        try:
+            self._fit(tmp_path / "inner")
+            assert run_journal.get_journal() is sentinel
+        finally:
+            run_journal.set_journal(prev)
+            sentinel.close()
+
+
+# ------------------------------------------------------ overhead contract
+class TestOverhead:
+    def test_telemetry_overhead_under_5pct(self):
+        """ISSUE acceptance: telemetry-on steady-state compiled-step
+        overhead <= 5% vs telemetry-off, on the CPU mesh."""
+        import time as _time
+        from paddle_tpu.jit.engine import make_train_step
+
+        def build():
+            paddle.seed(0)
+            net = nn.Linear(256, 256)
+            opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                       parameters=net.parameters())
+            return make_train_step(net, nn.MSELoss(), opt)
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(64, 256).astype(np.float32))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).rand(64, 256).astype(np.float32))
+
+        def min_step_s(step):
+            for _ in range(5):           # compile + warm
+                step([x], [y])
+            best = float("inf")
+            for _ in range(30):
+                t0 = _time.perf_counter()
+                step([x], [y])
+                best = min(best, _time.perf_counter() - t0)
+            return best
+
+        was = tracing.enabled()
+        try:
+            tracing.enable(False)
+            t_off = min_step_s(build())
+            tracing.enable(True)
+            t_on = min_step_s(build())
+        finally:
+            tracing.enable(was)
+        # min-of-30 suppresses scheduler noise; the epsilon floors the
+        # comparison for sub-ms CPU steps
+        assert t_on <= t_off * 1.05 + 5e-5, (t_on, t_off)
+
+
+# ---------------------------------------------------------- profiler hard
+class TestProfilerIdempotence:
+    def test_double_start_stop_without_start(self, tmp_path):
+        from paddle_tpu.utils import profiler
+        p = str(tmp_path / "prof.json")
+        profiler.stop_profiler(profile_path=p)       # never started: no-op
+        profiler.start_profiler(tracer_option="Default")
+        profiler.start_profiler(tracer_option="Default")  # double start
+        assert profiler.profiler_enabled()
+        profiler.stop_profiler(profile_path=p)
+        profiler.stop_profiler(profile_path=p)       # double stop
+        assert not profiler.profiler_enabled()
+
+    def test_jax_trace_already_stopped_does_not_raise(self, tmp_path):
+        import jax
+        from paddle_tpu.utils import profiler
+        profiler.start_profiler(tracer_option="All",
+                                jax_trace_dir=str(tmp_path / "tr"))
+        jax.profiler.stop_trace()                    # yank it out from under
+        profiler.stop_profiler(profile_path=str(tmp_path / "p.json"))
+        assert not profiler.profiler_enabled()
+
+    def test_chrome_trace_roundtrip(self, tmp_path):
+        from paddle_tpu.utils import profiler
+        profiler.reset_profiler()
+        profiler.start_profiler(tracer_option="Default")
+        with profiler.RecordEvent("alpha"):
+            pass
+        with profiler.RecordEvent("beta", category="step"):
+            pass
+        p = str(tmp_path / "chrome.json")
+        profiler.stop_profiler(profile_path=p)
+        data = json.load(open(p))
+        evs = data["traceEvents"]
+        names = {e["name"] for e in evs}
+        assert {"alpha", "beta"} <= names
+        for e in evs:
+            assert e["ph"] == "X" and e["dur"] >= 0 and "ts" in e
+        assert profiler.num_events() >= 2
+        profiler.reset_profiler()
+        assert profiler.num_events() == 0
+
+    def test_record_event_outside_session_noop(self):
+        from paddle_tpu.utils import profiler
+        profiler.reset_profiler()
+        with profiler.RecordEvent("ghost"):
+            pass                                     # profiler off
+        assert profiler.num_events() == 0
+
+
+# -------------------------------------------------------- resilience wire
+class TestResilienceJournalWiring:
+    def test_guards_emit_events_and_counters(self, tmp_path):
+        from paddle_tpu.resilience import (AnomalyGuard, PreemptionGuard,
+                                           RetryPolicy)
+        j = run_journal.RunJournal(str(tmp_path), run_id="w")
+        prev = run_journal.set_journal(j)
+        try:
+            base_nf = metrics.counter("pt_nonfinite_steps_total").value
+            base_pre = metrics.counter("pt_preemptions_total").value
+            AnomalyGuard(max_consecutive=5).observe(float("nan"))
+            PreemptionGuard().trigger()
+            pol = RetryPolicy(max_tries=2, base_delay=0.0, jitter=0.0)
+
+            def boom():
+                raise OSError("x")
+
+            with pytest.raises(Exception):
+                pol.call(boom, retry_on=(OSError,), site="wire_test")
+        finally:
+            run_journal.set_journal(prev)
+            j.close()
+        kinds = [e["event"] for e in run_journal.read_journal(j.path)]
+        assert "nonfinite_skip" in kinds
+        assert "preemption" in kinds
+        assert kinds.count("retry") == 2
+        assert metrics.counter("pt_nonfinite_steps_total").value == \
+            base_nf + 1
+        assert metrics.counter("pt_preemptions_total").value == base_pre + 1
+        assert metrics.counter(
+            "pt_retry_attempts_total",
+            labelnames=("site",)).labels("wire_test").value == 2
+
+    def test_retry_standalone_load_without_package(self):
+        """bench.py loads retry.py with no package parent; the telemetry
+        import inside must degrade silently."""
+        import importlib.util
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "_retry_standalone",
+            os.path.join(root, "paddle_tpu", "resilience", "retry.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        pol = mod.RetryPolicy(max_tries=2, base_delay=0.0, jitter=0.0)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise OSError("first")
+            return "ok"
+
+        assert pol.call(flaky, retry_on=(OSError,)) == "ok"

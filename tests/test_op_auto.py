@@ -69,6 +69,15 @@ def _ROIQUAD(rs):
                     np.float32)
 
 
+def _PRROI_BOXES(rs):
+    # bin edges (e.g. 1.3, 3.1, 4.9, 6.7 for 3 bins on [1.3, 6.7]) all sit
+    # >= 0.1 away from integers: prroi_pool is C1 except at integer grid
+    # lines, so the finite-difference box-coordinate grad check must not
+    # straddle a kink
+    return np.array([[1.3, 1.6, 6.7, 6.1],
+                     [0.4, 2.3, 5.5, 6.8]], np.float32)
+
+
 def SYM(n=3):
     def make(rs):
         a = rs.rand(n, n).astype(np.float32)
@@ -140,6 +149,10 @@ SPECS = {
         in_=[U(0.0, 1.0, (1, 2, 10, 10)), _ROIQUAD],
         attrs={"transformed_height": 3, "transformed_width": 3},
         grad=[0], tol=5e-2, bf16=False),
+    "prroi_pool_op": dict(
+        in_=[U(0.0, 1.0, (1, 2, 8, 8)), _PRROI_BOXES],
+        attrs={"output_size": (3, 3), "spatial_scale": 1.0},
+        grad=[0, 1], tol=2e-2),  # grad in BOTH features and box coords
     # matmul family
     "matmul_v2": dict(in_=[U(-1, 1, (3, 4)), U(-1, 1, (4, 5))]),
     "mul": dict(in_=[U(-1, 1, (3, 4)), U(-1, 1, (4, 5))]),

@@ -17,4 +17,44 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest $TARGET -q \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+
+# Observability smoke (docs/OBSERVABILITY.md): a 2-step fit with
+# telemetry on must produce a parseable journal + metrics snapshot and
+# exactly ONE retrace (the first compile; a second one in a fixed-shape
+# loop is a retrace bug).
+if [ "$rc" -eq 0 ]; then
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, tempfile
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.observability import read_journal
+
+d = tempfile.mkdtemp(prefix="pt_obs_smoke_")
+paddle.seed(0)
+net = nn.Linear(8, 4)
+model = paddle.Model(net)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters()),
+              nn.CrossEntropyLoss())
+X = np.random.RandomState(0).rand(16, 8).astype("float32")
+Y = np.zeros((16, 1), np.int64)
+model.fit([(X[i], Y[i]) for i in range(16)], batch_size=8, epochs=1,
+          verbose=0, telemetry_dir=d)
+
+evs = read_journal(os.path.join(d, "journal-rank0.jsonl"))  # valid JSONL
+assert evs[0]["event"] == "run_start" and evs[-1]["event"] == "run_end", evs
+snap = json.load(open(os.path.join(d, "metrics.json")))     # valid JSON
+series = snap["metrics"]["pt_jit_retraces_total"]["series"]
+retraces = {s["labels"]["engine"]: s["value"] for s in series}
+assert retraces.get("jit_train") == 1.0, retraces
+print("OBSERVABILITY_SMOKE=ok (2-step fit: retraces=1, journal %d events)"
+      % len(evs))
+EOF
+    smoke_rc=$?
+    if [ "$smoke_rc" -ne 0 ]; then
+        echo "OBSERVABILITY_SMOKE=FAILED (rc=$smoke_rc)"
+        rc=$smoke_rc
+    fi
+fi
 exit $rc
