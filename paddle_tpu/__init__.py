@@ -71,6 +71,7 @@ from . import text  # noqa: F401
 from . import incubate  # noqa: F401
 from . import resilience  # noqa: F401
 from . import observability  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401

@@ -1,0 +1,243 @@
+"""Durable on-disk checkpoint format: manifest + raw blobs + COMMIT marker.
+
+Replaces the seed's raw-pickle checkpoint payload (a single `ckpt.pkl`
+that `pickle.load` trusted blindly) with a pickle-free, verifiable layout:
+
+    <ckpt>/
+      blobs/<i>.bin    raw little-endian array bytes, one file per array
+      manifest.json    format version, user meta, JSON-able extras, and per
+                       array: blob file, dtype, shape, nbytes, sha256
+      COMMIT           sha256 of manifest.json — written LAST, after every
+                       blob and the manifest are fsync'd, so its presence
+                       IS the durability guarantee
+
+Write protocol (torn-write safe): blobs -> fsync each -> manifest ->
+fsync -> fsync dir -> COMMIT -> fsync -> fsync dir. A crash at any point
+before the COMMIT leaves a prefix that `is_complete` rejects and the
+engine sweeps; a crash after leaves a fully verifiable checkpoint.
+
+Verified read: a missing/short/bit-flipped blob, a manifest that does not
+hash to the COMMIT content, or an unparseable manifest raises
+`CheckpointCorruptError` (`.reason` says which invariant broke) — the
+engine quarantines the directory and walks back to the last-good
+checkpoint instead of crashing the resume.
+
+Fault hooks: `resilience.chaos` `torn_write:K` (K-th blob write in this
+process writes half its bytes then SIGKILLs — deterministic mid-save
+crash) and `bitflip_ckpt:K` (one bit of the K-th blob flipped after its
+checksum is recorded — deterministic detect-quarantine-fallback).
+
+numpy + stdlib only — importable from the launcher and from processes
+that must never touch jax (same contract as observability/metrics.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..resilience import chaos
+
+__all__ = [
+    "CheckpointCorruptError", "write_store", "read_store", "read_manifest",
+    "is_complete", "fsync_dir", "fsync_file",
+]
+
+FORMAT = "paddle-tpu-ckpt"
+VERSION = 1
+MANIFEST = "manifest.json"
+COMMIT = "COMMIT"
+BLOB_DIR = "blobs"
+
+
+class CheckpointCorruptError(Exception):
+    """A checkpoint directory failed integrity verification.
+
+    `reason` is one of: "missing" (no manifest), "incomplete" (no COMMIT
+    marker — a torn write that never committed), "manifest" (COMMIT/hash
+    mismatch or unparseable manifest), "blob_missing", "truncated",
+    "checksum" (bit rot / torn blob)."""
+
+    def __init__(self, path: str, reason: str, detail: str = ""):
+        self.path = path
+        self.reason = reason
+        self.detail = detail
+        super().__init__(
+            f"corrupt checkpoint at {path!r} ({reason})"
+            + (f": {detail}" if detail else ""))
+
+
+def fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """Durably record directory entries (new files / renames) themselves."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _sha256_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:  # ml_dtypes extension types (bfloat16, float8_*) register by name
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError):
+        raise CheckpointCorruptError("<manifest>", "manifest",
+                                     f"unknown dtype {name!r}")
+
+
+def _write_blob(path: str, data: bytes) -> None:
+    """One durable blob write, with the two chaos fault hooks."""
+    torn = chaos.torn_write_blob()
+    with open(path, "wb") as f:
+        if torn:
+            # a torn write: half the payload reaches the disk, then the
+            # process dies as if the machine lost power mid-save
+            f.write(data[: len(data) // 2])
+            f.flush()
+            os.fsync(f.fileno())
+            os.kill(os.getpid(), 9)  # SIGKILL — no handlers, no cleanup
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    if chaos.bitflip_blob() and len(data):
+        with open(path, "r+b") as f:
+            first = f.read(1)
+            f.seek(0)
+            f.write(bytes([first[0] ^ 0x01]))
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def write_store(path: str, arrays: Dict[str, np.ndarray],
+                meta: Optional[dict] = None,
+                extras: Optional[dict] = None) -> int:
+    """Write a complete checkpoint store into directory `path` (which must
+    not yet contain one — the engine writes into a tmp dir then commits by
+    rename). Returns total blob bytes written."""
+    os.makedirs(os.path.join(path, BLOB_DIR), exist_ok=True)
+    entries = {}
+    total = 0
+    for i, (name, arr) in enumerate(arrays.items()):
+        # NOT ascontiguousarray: it silently promotes 0-d arrays to (1,);
+        # tobytes() already yields C-order bytes for any layout
+        arr = np.asarray(arr)
+        data = arr.tobytes()
+        fname = os.path.join(BLOB_DIR, f"{i}.bin")
+        _write_blob(os.path.join(path, fname), data)
+        entries[name] = {
+            "file": fname,
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "nbytes": len(data),
+            "sha256": _sha256_bytes(data),
+        }
+        total += len(data)
+    manifest = {
+        "format": FORMAT, "version": VERSION,
+        "meta": dict(meta or {}), "extras": dict(extras or {}),
+        "arrays": entries,
+    }
+    mbytes = json.dumps(manifest, indent=1, sort_keys=True).encode()
+    mpath = os.path.join(path, MANIFEST)
+    with open(mpath, "wb") as f:
+        f.write(mbytes)
+        f.flush()
+        os.fsync(f.fileno())
+    fsync_dir(os.path.join(path, BLOB_DIR))
+    fsync_dir(path)
+    # the commit point: everything above is durably on disk before this
+    # marker exists, so COMMIT present == checkpoint verifiable
+    with open(os.path.join(path, COMMIT), "w") as f:
+        f.write(_sha256_bytes(mbytes) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    fsync_dir(path)
+    return total
+
+
+def is_complete(path: str) -> bool:
+    return (os.path.isfile(os.path.join(path, COMMIT))
+            and os.path.isfile(os.path.join(path, MANIFEST)))
+
+
+def read_manifest(path: str, verify: bool = True) -> dict:
+    mpath = os.path.join(path, MANIFEST)
+    if not os.path.isfile(mpath):
+        raise CheckpointCorruptError(path, "missing", "no manifest.json")
+    if not os.path.isfile(os.path.join(path, COMMIT)):
+        raise CheckpointCorruptError(path, "incomplete", "no COMMIT marker")
+    with open(mpath, "rb") as f:
+        mbytes = f.read()
+    if verify:
+        with open(os.path.join(path, COMMIT)) as f:
+            want = f.read().strip()
+        got = _sha256_bytes(mbytes)
+        if got != want:
+            raise CheckpointCorruptError(
+                path, "manifest", f"manifest sha {got[:12]} != COMMIT "
+                f"{want[:12]}")
+    try:
+        manifest = json.loads(mbytes)
+    except ValueError as e:
+        raise CheckpointCorruptError(path, "manifest", str(e))
+    if manifest.get("format") != FORMAT:
+        raise CheckpointCorruptError(
+            path, "manifest", f"unknown format {manifest.get('format')!r}")
+    return manifest
+
+
+def read_store(path: str, verify: bool = True
+               ) -> Tuple[Dict[str, np.ndarray], dict, dict]:
+    """Verified load: returns (arrays, meta, extras) or raises
+    CheckpointCorruptError on ANY integrity violation."""
+    manifest = read_manifest(path, verify=verify)
+    arrays: Dict[str, np.ndarray] = {}
+    for name, ent in manifest.get("arrays", {}).items():
+        bpath = os.path.join(path, ent["file"])
+        if not os.path.isfile(bpath):
+            raise CheckpointCorruptError(path, "blob_missing",
+                                         f"{name}: {ent['file']}")
+        size = os.path.getsize(bpath)
+        if size != int(ent["nbytes"]):
+            raise CheckpointCorruptError(
+                path, "truncated",
+                f"{name}: {size} bytes on disk, manifest says "
+                f"{ent['nbytes']}")
+        if verify and _sha256_file(bpath) != ent["sha256"]:
+            raise CheckpointCorruptError(path, "checksum", name)
+        dtype = _resolve_dtype(ent["dtype"])
+        with open(bpath, "rb") as f:
+            data = f.read()
+        arr = np.frombuffer(data, dtype=dtype).reshape(ent["shape"]).copy()
+        arrays[name] = arr
+    return arrays, manifest.get("meta", {}), manifest.get("extras", {})

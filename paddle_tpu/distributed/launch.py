@@ -57,6 +57,13 @@ def _parse_args(argv=None):
                    help="total failed-worker respawns before the launch "
                         "gives up (reference: the elastic manager's "
                         "restart budget); 0 = fail fast")
+    p.add_argument("--checkpoint_dir",
+                   default=os.environ.get("PADDLE_TPU_CHECKPOINT_DIR"),
+                   help="exported to workers as PADDLE_TPU_CHECKPOINT_DIR "
+                        "(TrainEpochRange root); the launcher sweeps stale "
+                        "commit droppings there before every (re)spawn so "
+                        "a crashed worker's torn save never confuses the "
+                        "resume scan (docs/CHECKPOINT.md)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -81,9 +88,28 @@ def launch_collective(args) -> int:
         journal_obj.emit("launch_start", nnodes=args.nnodes,
                          nproc_per_node=nprocs, world=world, master=master)
 
+    def sweep_checkpoints():
+        if not args.checkpoint_dir:
+            return
+        try:
+            from ..checkpoint.engine import sweep_stale
+            for sub in [args.checkpoint_dir] + [
+                    os.path.join(args.checkpoint_dir, n)
+                    for n in sorted(os.listdir(args.checkpoint_dir))
+                    if os.path.isdir(os.path.join(args.checkpoint_dir, n))]:
+                removed = sweep_stale(sub)
+                if removed:
+                    logger.info("swept stale checkpoint dirs in %s: %s",
+                                sub, removed)
+        except OSError as e:
+            logger.warning("checkpoint sweep failed: %s", e)
+
     def spawn(local_rank, respawn=False):
         rank = args.node_rank * nprocs + local_rank
+        sweep_checkpoints()
         env = dict(os.environ)
+        if args.checkpoint_dir:
+            env["PADDLE_TPU_CHECKPOINT_DIR"] = args.checkpoint_dir
         env.update({
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(world),

@@ -1,14 +1,59 @@
 """paddle.save / paddle.load (reference:
 /root/reference/python/paddle/framework/io.py:553,769 — pickled state dicts).
-Tensors serialize as numpy arrays; nested dicts/lists round-trip."""
+Tensors serialize as numpy arrays; nested dicts/lists round-trip.
+
+Hardened beyond the reference: `save` is atomic and durable (tmp file +
+fsync + rename, so a crash mid-save never leaves a torn file at `path`)
+and `load` unpickles through an ALLOWLISTED Unpickler — only numpy array
+reconstruction, ml_dtypes scalar types and a few plain builtins resolve;
+anything else (`os.system`, arbitrary classes) raises UnpicklingError
+instead of executing. Checkpoint dirs use the stronger pickle-free store
+(paddle_tpu/checkpoint/, docs/CHECKPOINT.md); this path remains for flat
+`.pdparams`/`.pdopt` state files.
+"""
 from __future__ import annotations
 
 import os
 import pickle
 
-import numpy as np
-
 from .tensor import Tensor
+
+#: (module, name) pairs load() will resolve; everything else is refused.
+_SAFE_GLOBALS = {
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("collections", "OrderedDict"),
+    ("builtins", "complex"),
+    ("builtins", "bytearray"),
+    ("builtins", "set"),
+    ("builtins", "frozenset"),
+    ("builtins", "slice"),
+    ("builtins", "range"),
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _SAFE_GLOBALS:
+            return super().find_class(module, name)
+        if module == "ml_dtypes" and not name.startswith("_"):
+            # ml_dtypes only exposes scalar dtype types (bfloat16, float8_*)
+            obj = super().find_class(module, name)
+            if isinstance(obj, type):
+                return obj
+        raise pickle.UnpicklingError(
+            f"refusing to unpickle global {module}.{name} (paddle.load "
+            "only restores plain data; see docs/CHECKPOINT.md)")
+
+
+def restricted_pickle_load(file):
+    """Unpickle from a binary file object through the allowlist (also the
+    read path for legacy pre-engine checkpoint payloads)."""
+    return _RestrictedUnpickler(file).load()
 
 
 def _to_saveable(obj):
@@ -41,11 +86,30 @@ def save(obj, path, protocol=4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    # atomic + durable: a crash leaves either the old file or the new one
+    # at `path`, never a truncated pickle
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_to_saveable(obj), f, protocol=protocol)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if d:
+        fd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
 
 
 def load(path, **configs):
     with open(path, "rb") as f:
-        obj = pickle.load(f)
+        obj = restricted_pickle_load(f)
     return _from_saveable(obj, return_numpy=configs.get("return_numpy", False))

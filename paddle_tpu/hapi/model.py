@@ -227,17 +227,26 @@ class Model:
         ckpt_path = None
         guard = None
         if auto_checkpoint_dir:
+            from ..checkpoint import CheckpointCorruptError, sweep_stale
+            from ..incubate.checkpoint import load_checkpoint
             os.makedirs(auto_checkpoint_dir, exist_ok=True)
+            sweep_stale(auto_checkpoint_dir)
             ckpt_path = os.path.join(auto_checkpoint_dir, "preempt_ckpt")
-            if os.path.exists(os.path.join(ckpt_path, "meta.json")):
-                from ..incubate.checkpoint import load_checkpoint
-                resume = load_checkpoint(ckpt_path, self.network,
-                                         self._optimizer)
-                rng = resume.get("rng_state")
-                if rng is not None:
-                    from ..framework.random import set_rng_state
-                    set_rng_state(np.asarray(rng, dtype=np.uint32))
-                self._train_step_fn = None  # recompile on restored arrays
+            if os.path.exists(ckpt_path):
+                try:
+                    resume = load_checkpoint(ckpt_path, self.network,
+                                             self._optimizer)
+                except CheckpointCorruptError:
+                    # quarantined by the engine (journal event +
+                    # pt_ckpt_corrupt_total); train from scratch rather
+                    # than crash the relaunch
+                    resume = None
+                if resume is not None:
+                    rng = resume.get("rng_state")
+                    if rng is not None:
+                        from ..framework.random import set_rng_state
+                        set_rng_state(np.asarray(rng, dtype=np.uint32))
+                    self._train_step_fn = None  # recompile on restored arrays
             guard = PreemptionGuard().install()
         anomaly = (AnomalyGuard() if flag("skip_nonfinite_steps") else None)
 
@@ -321,8 +330,14 @@ class Model:
 
     def _save_preempt(self, path, epoch, step, it_count):
         """Atomic preemption checkpoint: state + exact loop position."""
+        from ..checkpoint import wait_pending
         from ..framework.random import get_rng_state
         from ..incubate.checkpoint import save_checkpoint
+        try:
+            wait_pending()  # any async save must commit before the final one
+        except Exception as e:
+            logger.warning("pending async checkpoint failed before "
+                           "preemption save: %s", e)
         meta = {"epoch": int(epoch), "step": int(step),
                 "it_count": int(it_count),
                 "rng_state": np.asarray(get_rng_state()).tolist()}

@@ -1,99 +1,56 @@
-"""Auto-checkpoint: periodic atomic snapshots + train-loop resume.
+"""Auto-checkpoint: periodic durable snapshots + train-loop resume.
 
 TPU-native equivalent of the reference's auto-checkpoint subsystem
 (reference: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py —
 TrainEpochRange over an FS abstraction fleet/utils/fs.py, epoch-range
 bookkeeping, HDFS upload) and the fleet sharded-save tests
-(dist_sharding_save.py, hybrid_parallel_pp_save_load.py). Checkpoints
-are written atomically (tmp + rename); sharded params are saved as the
-full logical array (single-controller gathers) with the layer's
-sharding_spec stored alongside so reload re-places them sharded."""
+(dist_sharding_save.py, hybrid_parallel_pp_save_load.py).
+
+Thin wrapper over the durable checkpoint engine
+(paddle_tpu/checkpoint/, docs/CHECKPOINT.md): saves are pickle-free
+verified stores committed atomically (manifest + sha256'd blobs + COMMIT
+marker + fsync), loads verify integrity and QUARANTINE + walk back to the
+last-good epoch instead of crashing the resume, `save(async_=True)`
+overlaps the disk write with the next epoch, and retention GC
+(keep-last-N / keep-every-K) replaces the old hard-coded keep-2.
+"""
 from __future__ import annotations
 
-import json
 import os
-import pickle
-import shutil
-import tempfile
-import time
+import re
 from typing import Dict, Optional
 
-import numpy as np
+from ..checkpoint import engine as _engine
+from ..checkpoint.engine import (CheckpointCorruptError,  # noqa: F401
+                                 RetentionPolicy)
 
-__all__ = ["TrainEpochRange", "save_checkpoint", "load_checkpoint"]
+__all__ = ["TrainEpochRange", "save_checkpoint", "load_checkpoint",
+           "CheckpointCorruptError", "RetentionPolicy"]
 
-
-def _specs_of(layer):
-    out = {}
-    for name, p in layer.named_parameters():
-        spec = getattr(p, "sharding_spec", None)
-        if spec is not None:
-            out[name] = tuple(
-                el if not isinstance(el, tuple) else list(el)
-                for el in spec)
-    return out
+_EPOCH_RE = re.compile(r"^epoch_(\d+)$")
 
 
-def _apply_specs(layer, specs):
-    """Re-attach recorded PartitionSpecs so the jit engine re-places the
-    params sharded on the next compiled step (jit/engine.py _param_spec)."""
-    from jax.sharding import PartitionSpec
-    by_name = dict(layer.named_parameters())
-    for name, spec in specs.items():
-        p = by_name.get(name)
-        if p is not None:
-            p.sharding_spec = PartitionSpec(*[
-                tuple(el) if isinstance(el, list) else el for el in spec])
+def save_checkpoint(path: str, layer=None, optimizer=None, meta=None,
+                    **kw):
+    """Durable atomic checkpoint: params (+ buffers), optimizer
+    accumulators, user meta. Returns the final path (or a PendingSave
+    handle with `async_=True`); see checkpoint.engine.save_checkpoint."""
+    return _engine.save_checkpoint(path, layer, optimizer, meta, **kw)
 
 
-def save_checkpoint(path: str, layer=None, optimizer=None, meta=None):
-    """Atomic checkpoint: params (+ buffers), optimizer accumulators,
-    user meta. Returns the final path."""
-    tmp = tempfile.mkdtemp(dir=os.path.dirname(os.path.abspath(path))
-                           or ".")
-    try:
-        payload = {"meta": dict(meta or {}), "time": time.time()}
-        if layer is not None:
-            payload["state_dict"] = {
-                k: np.asarray(v._data)
-                for k, v in layer.state_dict().items()}
-            payload["sharding_specs"] = _specs_of(layer)
-        if optimizer is not None:
-            payload["opt_state"] = {
-                k: np.asarray(v._data) if hasattr(v, "_data") else v
-                for k, v in optimizer.state_dict().items()}
-        with open(os.path.join(tmp, "ckpt.pkl"), "wb") as f:
-            pickle.dump(payload, f, protocol=4)
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump({"meta": payload["meta"], "time": payload["time"]}, f)
-        # atomic swap: move any existing checkpoint ASIDE first so a crash
-        # between steps never leaves the path empty-handed
-        old = None
-        if os.path.exists(path):
-            old = path + ".old." + str(os.getpid())
-            os.rename(path, old)
-        os.rename(tmp, path)
-        if old:
-            shutil.rmtree(old, ignore_errors=True)
-        return path
-    except BaseException:
-        shutil.rmtree(tmp, ignore_errors=True)
-        raise
+def load_checkpoint(path: str, layer=None, optimizer=None, **kw) -> Dict:
+    """Verified restore; returns the stored meta dict. Re-places sharded
+    params by their recorded sharding_spec when a mesh is active. Raises
+    CheckpointCorruptError (after quarantining) on integrity failure."""
+    return _engine.load_checkpoint(path, layer, optimizer, **kw)
 
 
-def load_checkpoint(path: str, layer=None, optimizer=None) -> Dict:
-    """Restore; returns the stored meta dict. Re-places sharded params by
-    their recorded sharding_spec when a mesh is active."""
-    with open(os.path.join(path, "ckpt.pkl"), "rb") as f:
-        payload = pickle.load(f)
-    if layer is not None and "state_dict" in payload:
-        from ..framework.tensor import Tensor
-        layer.set_state_dict({k: Tensor(v, _internal=True)
-                              for k, v in payload["state_dict"].items()})
-        _apply_specs(layer, payload.get("sharding_specs", {}))
-    if optimizer is not None and "opt_state" in payload:
-        optimizer.set_state_dict(payload["opt_state"])
-    return payload.get("meta", {})
+def _epoch_num(name: str) -> Optional[int]:
+    """Strictly-`epoch_<int>` names only: `epoch_3.old.991`, `.corrupt`,
+    `.tmp.`/`.prev.` droppings and unrelated files all return None instead
+    of crashing the resume scan (the seed's int(n.split("_")[1]) did)."""
+    m = _EPOCH_RE.match(name)
+    return int(m.group(1)) if m else None
 
 
 class TrainEpochRange:
@@ -105,11 +62,15 @@ class TrainEpochRange:
         for epoch in tr.get():          # picks up where it left off
             train(...)
             tr.save(layer=net, optimizer=opt)
-    """
+
+    Corrupt epoch dirs are quarantined at restore() time and the range
+    falls back to the newest intact epoch. `keep_last`/`keep_every`
+    configure retention GC (default: keep the latest two)."""
 
     def __init__(self, max_epoch_num: int, name: str,
                  checkpoint_dir: Optional[str] = None,
-                 checkpoint_inter: int = 1, restored: bool = True):
+                 checkpoint_inter: int = 1, restored: bool = True,
+                 keep_last: int = 2, keep_every: Optional[int] = None):
         self.max_epoch_num = max_epoch_num
         self.name = name
         self.dir = os.path.join(
@@ -117,7 +78,10 @@ class TrainEpochRange:
                 "PADDLE_TPU_CHECKPOINT_DIR", "/tmp/paddle_tpu_ckpt"),
             name)
         os.makedirs(self.dir, exist_ok=True)
+        _engine.sweep_stale(self.dir)
         self.inter = max(1, checkpoint_inter)
+        self.retention = RetentionPolicy(keep_last=keep_last,
+                                         keep_every=keep_every)
         self._epoch = -1
         self._restored_meta: Dict = {}
         if restored:
@@ -131,24 +95,43 @@ class TrainEpochRange:
     def _ckpt_path(self, epoch: int) -> str:
         return os.path.join(self.dir, f"epoch_{epoch}")
 
-    def _last_epoch_on_disk(self) -> Optional[int]:
+    def _epochs_on_disk(self):
+        """Committed epoch numbers, ascending."""
         done = []
         for n in os.listdir(self.dir):
-            if n.startswith("epoch_") and os.path.exists(
-                    os.path.join(self.dir, n, "meta.json")):
-                done.append(int(n.split("_")[1]))
-        return max(done) if done else None
+            e = _epoch_num(n)
+            if e is None:
+                continue
+            p = os.path.join(self.dir, n)
+            # legacy pre-engine dirs (ckpt.pkl, no COMMIT) still count
+            if _engine.store.is_complete(p) or \
+                    os.path.isfile(os.path.join(p, "ckpt.pkl")):
+                done.append(e)
+        return sorted(done)
+
+    def _last_epoch_on_disk(self) -> Optional[int]:
+        done = self._epochs_on_disk()
+        return done[-1] if done else None
 
     @property
     def restored_epoch(self) -> int:
         return self._epoch
 
     def restore(self, layer=None, optimizer=None) -> Dict:
-        """Load the latest finished epoch's state (call before get())."""
+        """Load the newest intact epoch's state (call before get()).
+        Corrupt epochs are quarantined and skipped — `restored_epoch`
+        reflects the epoch actually restored."""
         if self._epoch < 0:
             return {}
-        self._restored_meta = load_checkpoint(
-            self._ckpt_path(self._epoch), layer, optimizer)
+        candidates = [self._ckpt_path(e)
+                      for e in reversed(self._epochs_on_disk())]
+        path, meta = _engine.load_latest(candidates, layer, optimizer)
+        if path is None:
+            self._epoch = -1
+            self._restored_meta = {}
+        else:
+            self._epoch = int(os.path.basename(path).split("_")[1])
+            self._restored_meta = meta
         return self._restored_meta
 
     def get(self):
@@ -169,20 +152,21 @@ class TrainEpochRange:
                     self.preempted = True
                     break
         finally:
+            _engine.wait_pending()  # async epoch save must commit
+            # an async save commits after save()'s retention pass ran, so
+            # re-apply once the slot is drained or the last epoch escapes GC
+            self.retention.apply(self.dir)
             if self._guard is not None:
                 self._guard.uninstall()
                 self._guard = None
 
-    def save(self, layer=None, optimizer=None, meta=None):
+    def save(self, layer=None, optimizer=None, meta=None,
+             async_: bool = False):
         e = self._pending
         if e is None:
             raise RuntimeError("TrainEpochRange.save() outside get() loop")
         if (e + 1) % self.inter == 0 or e == self.max_epoch_num - 1:
             save_checkpoint(self._ckpt_path(e), layer, optimizer,
-                            dict(meta or {}, epoch=e))
+                            dict(meta or {}, epoch=e), async_=async_)
             self._epoch = e
-            # keep only the latest two checkpoints
-            done = sorted(int(n.split("_")[1]) for n in os.listdir(self.dir)
-                          if n.startswith("epoch_"))
-            for old in done[:-2]:
-                shutil.rmtree(self._ckpt_path(old), ignore_errors=True)
+            self.retention.apply(self.dir)

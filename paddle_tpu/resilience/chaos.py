@@ -17,6 +17,14 @@ Spec grammar: `;`-separated `name[:int[:float]]` entries —
     hang_at_step:K:SECS   host-side sleep of SECS inside the compiled-step
                           dispatch of optimizer step K (exercises the step
                           watchdog; 1-based)
+    torn_write:K          the K-th checkpoint blob written by this process
+                          (checkpoint/store.py; 1-based) is torn: half its
+                          bytes reach disk, then the process is SIGKILLed —
+                          a deterministic power-loss mid-save
+    bitflip_ckpt:K        one bit of the K-th checkpoint blob is flipped
+                          AFTER its checksum is recorded in the manifest —
+                          deterministic bit rot the verified loader must
+                          detect, quarantine and fall back from
 
 Injection sites poll this module; with the env var unset every hook is a
 cheap no-op. Counters are in-process (each injected fault fires its exact
@@ -116,6 +124,29 @@ def step_hook(step: int) -> None:
     if args and int(args[0]) == step and not _counts.get("sigterm"):
         _counts["sigterm"] = 1
         os.kill(os.getpid(), signal.SIGTERM)
+
+
+def torn_write_blob() -> bool:
+    """True when the CURRENT checkpoint blob write must be torn
+    (torn_write:K, 1-based blob counter per process lifetime). The store
+    responds by persisting half the payload and SIGKILLing the process."""
+    args = get("torn_write")
+    if not args:
+        return False
+    n = _counts.get("torn_write", 0) + 1
+    _counts["torn_write"] = n
+    return n == int(args[0])
+
+
+def bitflip_blob() -> bool:
+    """True when the current checkpoint blob must have one bit flipped
+    after its checksum is recorded (bitflip_ckpt:K, 1-based)."""
+    args = get("bitflip_ckpt")
+    if not args:
+        return False
+    n = _counts.get("bitflip_ckpt", 0) + 1
+    _counts["bitflip_ckpt"] = n
+    return n == int(args[0])
 
 
 def hang_before_dispatch(step: int) -> None:

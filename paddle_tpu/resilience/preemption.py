@@ -19,6 +19,7 @@ restriction) — elsewhere the guard degrades to a manually-triggerable flag.
 from __future__ import annotations
 
 import signal
+import sys
 import threading
 import time
 from typing import Callable, List, Optional
@@ -83,6 +84,15 @@ class PreemptionGuard:
             journal.emit("preemption", signum=int(signum))
         except Exception:
             pass  # telemetry must not lose the preemption flag
+        try:
+            # grace-window flush: an async checkpoint save captured before
+            # the signal must still commit (only if the engine is already
+            # loaded — never import it from a signal handler)
+            eng = sys.modules.get("paddle_tpu.checkpoint.engine")
+            if eng is not None:
+                eng.flush_on_preemption()
+        except Exception:
+            pass  # a failed flush must not lose the preemption flag
         for fn in self._callbacks:
             try:
                 fn(signum)

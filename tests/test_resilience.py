@@ -250,7 +250,8 @@ def test_sigterm_kill_then_resume_keeps_loss_trajectory(tmp_path):
     part1 = _run_trainee(ck, b_log, chaos_spec="sigterm_at_step:5")
     assert part1.returncode == 0, part1.stderr[-800:]      # CLEAN exit
     assert "TRAINEE_DONE" not in part1.stdout              # but not done
-    assert os.path.exists(os.path.join(ck, "preempt_ckpt", "meta.json"))
+    from paddle_tpu.checkpoint import store as ckpt_store
+    assert ckpt_store.is_complete(os.path.join(ck, "preempt_ckpt"))
     assert len(_losses(b_log)) == 6                        # steps 0..5
 
     # run B part 2: relaunch, auto-resume
@@ -286,7 +287,8 @@ def test_fit_in_process_preempt_and_resume():
         finally:
             chaos.reset()
         assert m.preempted
-        assert os.path.exists(os.path.join(d, "preempt_ckpt", "meta.json"))
+        from paddle_tpu.checkpoint import store as ckpt_store
+        assert ckpt_store.is_complete(os.path.join(d, "preempt_ckpt"))
 
         m2 = paddle.Model(net)
         m2.prepare(opt, paddle.nn.MSELoss(), jit=True)

@@ -57,4 +57,48 @@ EOF
         rc=$smoke_rc
     fi
 fi
+
+# Checkpoint smoke (docs/CHECKPOINT.md): save two epochs, corrupt a blob
+# of the newest, and resume — the loader must quarantine the corrupt dir
+# and fall back to the last-good checkpoint without raising.
+if [ "$rc" -eq 0 ]; then
+    timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+import glob, os, tempfile
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.checkpoint import engine, store
+from paddle_tpu.observability import REGISTRY
+
+root = tempfile.mkdtemp(prefix="pt_ckpt_smoke_")
+paddle.seed(0)
+net = nn.Linear(4, 2)
+want = {k: np.asarray(v.numpy()) for k, v in net.state_dict().items()}
+for ep in (0, 1):
+    engine.save_checkpoint(os.path.join(root, f"epoch_{ep}"), net, None,
+                           meta={"epoch": ep})
+
+blob = sorted(glob.glob(os.path.join(root, "epoch_1", "blobs", "*.bin")))[0]
+with open(blob, "r+b") as f:       # bit rot in the newest checkpoint
+    b = f.read(1); f.seek(0); f.write(bytes([b[0] ^ 0x01]))
+
+before = REGISTRY.counter("pt_ckpt_corrupt_total", "").value
+used, meta = engine.load_latest(
+    [os.path.join(root, "epoch_1"), os.path.join(root, "epoch_0")],
+    net, None)
+assert used == os.path.join(root, "epoch_0"), used
+assert meta.get("epoch") == 0, meta
+assert os.path.isdir(os.path.join(root, "epoch_1") + ".corrupt")
+assert REGISTRY.counter("pt_ckpt_corrupt_total", "").value == before + 1
+for k, v in net.state_dict().items():
+    np.testing.assert_array_equal(np.asarray(v.numpy()), want[k])
+assert store.is_complete(os.path.join(root, "epoch_0"))
+print("CHECKPOINT_SMOKE=ok (corrupt epoch_1 quarantined, resumed epoch_0)")
+EOF
+    smoke_rc=$?
+    if [ "$smoke_rc" -ne 0 ]; then
+        echo "CHECKPOINT_SMOKE=FAILED (rc=$smoke_rc)"
+        rc=$smoke_rc
+    fi
+fi
 exit $rc
