@@ -93,14 +93,16 @@ def init_parallel_env():
     The coordinator handshake is retried with bounded backoff under a hard
     deadline (the reference's gen_comm_id connect loop retried forever;
     see resilience/retry.py). Knobs: PADDLE_TPU_BOOTSTRAP_TRIES (default 4),
-    PADDLE_TPU_BOOTSTRAP_DEADLINE_S (default 300).
+    PADDLE_TPU_BOOTSTRAP_DEADLINE_S (default 300). Each attempt's in-jax
+    connect timeout is clipped to the remaining deadline; exhaustion emits
+    a `bootstrap_timeout` journal event before re-raising RetryExhausted.
     """
     global _initialized
     if _initialized:
         return _env()
     import jax
     if _multi_host_env_present():
-        from ..resilience import RetryPolicy
+        from ..resilience import RetryExhausted, RetryPolicy
         addr = (os.environ.get("PADDLE_COORDINATOR_ADDRESS")
                 or os.environ.get("JAX_COORDINATOR_ADDRESS"))
         policy = RetryPolicy(
@@ -118,14 +120,34 @@ def init_parallel_env():
             journal.emit("bootstrap_retry", coordinator=str(addr),
                          attempt=i + 1, error=repr(e))
 
-        policy.call(
-            jax.distributed.initialize,
-            coordinator_address=addr,
-            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
-            retry_on=(RuntimeError, OSError),
-            site="bootstrap",
-            on_error=_on_error)
+        def _initialize():
+            # each attempt's in-jax connect timeout is clipped to what is
+            # left of the policy's OVERALL deadline, so a dead coordinator
+            # cannot wedge one attempt past the whole budget
+            rem = policy.remaining()
+            kw = {}
+            if rem != float("inf"):
+                kw["initialization_timeout"] = max(1, int(min(rem, 300.0)))
+            return jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+                **kw)
+
+        try:
+            policy.call(_initialize, retry_on=(RuntimeError, OSError),
+                        site="bootstrap", on_error=_on_error)
+        except RetryExhausted as e:
+            # a precise journal event distinguishes "never bootstrapped"
+            # from a later hang when operators read the rank's journal back
+            from ..observability import journal
+            journal.emit("bootstrap_timeout", coordinator=str(addr),
+                         tries=policy.tries, deadline_s=policy.deadline_s,
+                         error=repr(e.last_error))
+            log.error("init_parallel_env: coordinator handshake with %s "
+                      "FAILED after %d tries (deadline_s=%s)", addr,
+                      policy.tries, policy.deadline_s)
+            raise
     _initialized = True
     from . import collective
     collective._ensure_world_group()

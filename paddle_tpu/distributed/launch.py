@@ -25,8 +25,22 @@ import time
 
 from ..observability import journal as run_journal
 from ..observability import metrics
+from ..resilience import health
 
 logger = logging.getLogger("paddle_tpu.launch")
+
+
+class _Worker:
+    """One spawned worker process and its bookkeeping."""
+
+    __slots__ = ("rank", "local_rank", "proc", "out", "spawn_t")
+
+    def __init__(self, rank, local_rank, proc, out, spawn_t):
+        self.rank = rank
+        self.local_rank = local_rank
+        self.proc = proc
+        self.out = out
+        self.spawn_t = spawn_t
 
 
 def _free_port() -> int:
@@ -56,7 +70,18 @@ def _parse_args(argv=None):
                                               "0")),
                    help="total failed-worker respawns before the launch "
                         "gives up (reference: the elastic manager's "
-                        "restart budget); 0 = fail fast")
+                        "restart budget); 0 = fail fast. In a world > 1 "
+                        "collective job each restart is a GANG restart: "
+                        "every local worker is torn down and respawned "
+                        "together (docs/RESILIENCE.md)")
+    p.add_argument("--hang_timeout_s", type=float,
+                   default=float(os.environ.get("PADDLE_TPU_HANG_TIMEOUT_S",
+                                                "0") or 0),
+                   help="declare a worker HUNG (and kill + restart it) "
+                        "when its heartbeat file under --log_dir goes "
+                        "stale this long while the pid is alive; 0 = off. "
+                        "Requires --log_dir; set it well above the "
+                        "slowest legitimate step time")
     p.add_argument("--checkpoint_dir",
                    default=os.environ.get("PADDLE_TPU_CHECKPOINT_DIR"),
                    help="exported to workers as PADDLE_TPU_CHECKPOINT_DIR "
@@ -104,7 +129,9 @@ def launch_collective(args) -> int:
         except OSError as e:
             logger.warning("checkpoint sweep failed: %s", e)
 
-    def spawn(local_rank, respawn=False):
+    grace_s = float(os.environ.get("PADDLE_TPU_GANG_GRACE_S", "10") or 10)
+
+    def spawn(local_rank, respawn=False, restart_round=0):
         rank = args.node_rank * nprocs + local_rank
         sweep_checkpoints()
         env = dict(os.environ)
@@ -116,9 +143,20 @@ def launch_collective(args) -> int:
             "PADDLE_TRAINER_ENDPOINTS": endpoints,
             "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank],
             "PADDLE_RANK_IN_NODE": str(local_rank),
+            # chaos rank faults fire only in round 0 (resilience/chaos.py),
+            # so an injected kill/hang cannot loop the restart budget away
+            "PADDLE_TPU_RESTART_ROUND": str(restart_round),
         })
         if world > 1:
             env["PADDLE_COORDINATOR_ADDRESS"] = master
+        if log_dir:
+            # workers heartbeat into the log dir; the watch loop's hang
+            # detector reads the files back (resilience/health.py)
+            env["PADDLE_TPU_HEARTBEAT_DIR"] = log_dir
+            try:  # a dead incarnation's heartbeat must not damn the new one
+                os.unlink(health.heartbeat_path(log_dir, rank))
+            except OSError:
+                pass
         if nprocs > 1:
             # Several controllers on one host: give each a CPU device set.
             # JAX_PLATFORMS alone is overridden by sitecustomize's axon
@@ -133,21 +171,71 @@ def launch_collective(args) -> int:
                 env.get("XLA_FLAGS", ""), 1)
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
-        out = (open(os.path.join(log_dir, f"workerlog.{rank}"),
-                    "a" if respawn else "w") if log_dir else None)
+        out = None
+        if log_dir:
+            out = open(os.path.join(log_dir, f"workerlog.{rank}"),
+                       "a" if respawn else "w")
+            if respawn:
+                out.write(f"--- respawn {restart_round} ---\n")
+                out.flush()
         proc = subprocess.Popen(cmd, env=env, stdout=out,
                                 stderr=subprocess.STDOUT if out else None)
         logger.info("spawned worker rank %d pid %d%s", rank, proc.pid,
                     " (respawn)" if respawn else "")
         run_journal.emit("worker_spawn", rank=rank, pid=proc.pid,
                          respawn=bool(respawn))
-        return (proc, out)
+        return _Worker(rank=rank, local_rank=local_rank, proc=proc,
+                       out=out, spawn_t=time.time())
+
+    def close_logs():
+        for w in procs:
+            if w.out and not w.out.closed:
+                w.out.close()
+
+    def kill_with_grace(workers):
+        """SIGTERM first (PreemptionGuard flushes its grace-window
+        checkpoint), escalate to SIGKILL after the gang grace budget."""
+        for w in workers:
+            if w.proc.poll() is None:
+                w.proc.send_signal(signal.SIGTERM)
+        deadline = time.time() + grace_s
+        for w in workers:
+            try:
+                w.proc.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                w.proc.wait()
+
+    def find_hung_worker():
+        """The stalest live rank whose heartbeat outaged the timeout, or
+        None. A rank with NO heartbeat yet is never hung — a wedge before
+        the first tick is the bootstrap deadline's problem."""
+        if args.hang_timeout_s <= 0 or not log_dir:
+            return None
+        hung, worst = None, args.hang_timeout_s
+        now = time.time()
+        for w in procs:
+            if w.proc.poll() is not None:
+                continue
+            hb = health.heartbeat_path(log_dir, w.rank)
+            stale = health.stale_seconds(hb, now)
+            # only heartbeats from THIS incarnation count (mtime after
+            # spawn); spawn() also unlinks the previous one defensively
+            if stale is None or now - stale < w.spawn_t:
+                continue
+            if stale > worst:
+                hung, worst = w, stale
+        return (hung, worst) if hung is not None else None
 
     procs = [spawn(lr) for lr in range(nprocs)]
 
     # watch loop (reference: fleet/launch.py:276-347) with a bounded
-    # restart budget (reference: elastic manager) — a crashed worker is
-    # respawned with backoff until --max_restarts is exhausted
+    # restart budget (reference: elastic manager). world == 1: a crashed
+    # worker is respawned individually. world > 1: any worker death —
+    # crash OR detected hang — triggers a GANG restart, because the
+    # surviving ranks of a collective job are blocked on the dead peer:
+    # graceful teardown of every local worker, stale-checkpoint sweep,
+    # full respawn; workers auto-resume from last-good (docs/CHECKPOINT.md)
     max_restarts = max(0, args.max_restarts)
     restarts = 0
     backoff = None
@@ -157,61 +245,106 @@ def launch_collective(args) -> int:
                               max_delay=30.0)
     rc = 0
     try:
-        alive = True
-        while alive:
+        while True:
+            failed = None          # (worker, cause, exit_code)
             alive = False
-            for idx, (p, out) in enumerate(procs):
-                code = p.poll()
+            for w in procs:
+                code = w.proc.poll()
                 if code is None:
                     alive = True
                 elif code != 0:
-                    run_journal.emit("worker_exit", local_rank=idx,
-                                     pid=p.pid, code=code)
-                    if restarts < max_restarts:
-                        restarts += 1
-                        delay = backoff.backoff(restarts)
-                        logger.warning(
-                            "worker pid %d (local rank %d) exited with code "
-                            "%d — restart %d/%d in %.1fs", p.pid, idx, code,
-                            restarts, max_restarts, delay)
-                        metrics.counter("pt_worker_restarts_total",
-                                        "Failed workers respawned by the "
-                                        "launcher").inc()
-                        run_journal.emit("worker_restart", local_rank=idx,
-                                         restart=restarts,
-                                         max_restarts=max_restarts,
-                                         delay_s=round(delay, 3))
-                        time.sleep(delay)
-                        if out:
-                            out.close()
-                        procs[idx] = spawn(idx, respawn=True)
-                        alive = True
-                    else:
-                        rc = code
-                        raise RuntimeError(
-                            f"worker pid {p.pid} exited with code {code}")
-            time.sleep(0.5)
+                    run_journal.emit("worker_exit", rank=w.rank,
+                                     local_rank=w.local_rank,
+                                     pid=w.proc.pid, code=code)
+                    failed = (w, "crash", code)
+                    break
+            if failed is None:
+                hung = find_hung_worker()
+                if hung is not None:
+                    w, stale = hung
+                    hb = health.read_heartbeat(
+                        health.heartbeat_path(log_dir, w.rank)) or {}
+                    logger.warning(
+                        "worker rank %d pid %d HUNG: heartbeat stale "
+                        "%.1fs > %.1fs (last step %s) — killing",
+                        w.rank, w.proc.pid, stale, args.hang_timeout_s,
+                        hb.get("step"))
+                    metrics.counter(
+                        "pt_worker_hangs_total",
+                        "Live workers killed for a stale heartbeat").inc()
+                    run_journal.emit("worker_hang", rank=w.rank,
+                                     local_rank=w.local_rank, pid=w.proc.pid,
+                                     stale_s=round(stale, 3),
+                                     timeout_s=args.hang_timeout_s,
+                                     last_step=hb.get("step"))
+                    kill_with_grace([w])
+                    failed = (w, "hang", None)
+            if failed is None:
+                if not alive:
+                    break          # every worker exited 0
+                time.sleep(0.5)
+                continue
+
+            w, cause, code = failed
+            if restarts >= max_restarts:
+                rc = code if code else 1
+                raise RuntimeError(
+                    f"worker rank {w.rank} pid {w.proc.pid} "
+                    f"{'hung' if cause == 'hang' else f'exited with code {code}'}"
+                    f" — restart budget ({max_restarts}) exhausted")
+            restarts += 1
+            delay = backoff.backoff(restarts)
+            if world > 1:
+                logger.warning(
+                    "worker rank %d %s — GANG restart %d/%d in %.1fs",
+                    w.rank, cause, restarts, max_restarts, delay)
+                metrics.counter(
+                    "pt_gang_restarts_total",
+                    "Whole-gang teardown+respawn cycles").inc()
+                run_journal.emit("gang_restart", failed_rank=w.rank,
+                                 cause=cause, code=code, restart=restarts,
+                                 max_restarts=max_restarts,
+                                 delay_s=round(delay, 3))
+                kill_with_grace(procs)
+                close_logs()
+                time.sleep(delay)
+                procs = [spawn(lr, respawn=True, restart_round=restarts)
+                         for lr in range(nprocs)]
+            else:
+                logger.warning(
+                    "worker pid %d (local rank %d) %s — restart %d/%d "
+                    "in %.1fs", w.proc.pid, w.local_rank,
+                    cause if cause == "hang" else f"exited with code {code}",
+                    restarts, max_restarts, delay)
+                metrics.counter("pt_worker_restarts_total",
+                                "Failed workers respawned by the "
+                                "launcher").inc()
+                run_journal.emit("worker_restart", local_rank=w.local_rank,
+                                 cause=cause, restart=restarts,
+                                 max_restarts=max_restarts,
+                                 delay_s=round(delay, 3))
+                time.sleep(delay)
+                if w.out:
+                    w.out.close()
+                procs[w.local_rank] = spawn(w.local_rank, respawn=True,
+                                            restart_round=restarts)
     except (RuntimeError, KeyboardInterrupt) as e:
-        for p, _ in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        deadline = time.time() + 10
-        for p, _ in procs:
-            try:
-                p.wait(max(0.1, deadline - time.time()))
-            except subprocess.TimeoutExpired:
-                p.kill()
+        kill_with_grace(procs)
         if isinstance(e, RuntimeError):
             logger.error("launch failed: %s", e)
             rc = rc or 1
     finally:
-        for _, out in procs:
-            if out:
-                out.close()
+        close_logs()
         if journal_obj is not None:
             journal_obj.emit("launch_end", rc=rc, restarts=restarts)
             run_journal.set_journal(prev_journal)
             journal_obj.close()
+        if log_dir:
+            try:  # the gate and operators read the counters back from here
+                metrics.REGISTRY.write_json(
+                    os.path.join(log_dir, "metrics-launch.json"))
+            except OSError as e:
+                logger.warning("launch metrics snapshot failed: %s", e)
     return rc
 
 

@@ -21,7 +21,7 @@ from ..io import DataLoader
 from ..metric import Metric
 from ..observability import journal as run_journal
 from ..observability import tracing
-from ..resilience import AnomalyGuard, PreemptionGuard, chaos
+from ..resilience import AnomalyGuard, PreemptionGuard, chaos, health
 from .callbacks import (Callback, CallbackList, ProgBarLogger,
                         ModelCheckpoint, TelemetryCallback)
 
@@ -268,6 +268,7 @@ class Model:
                         if epoch == resume_epoch and step <= resume_step:
                             continue  # consumed before the preemption ckpt
                         chaos.step_hook(it_count)
+                        health.tick(it_count)
                         cbk.on_train_batch_begin(step)
                         inputs, labels = self._split_batch(batch)
                         logs = self.train_batch(inputs, labels)

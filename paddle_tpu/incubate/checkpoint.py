@@ -21,6 +21,7 @@ import re
 from typing import Dict, Optional
 
 from ..checkpoint import engine as _engine
+from ..resilience import health
 from ..checkpoint.engine import (CheckpointCorruptError,  # noqa: F401
                                  RetentionPolicy)
 
@@ -146,6 +147,7 @@ class TrainEpochRange:
         try:
             for e in range(self._epoch + 1, self.max_epoch_num):
                 self._pending = e
+                health.tick(e)  # epoch boundary = liveness for the launcher
                 yield e
                 self._pending = None
                 if guard.triggered:

@@ -21,7 +21,9 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "get_worker_info"]
+           "DataLoaderWorkerError", "get_worker_info"]
+
+from .multiprocess import DataLoaderWorkerError  # noqa: E402,F401
 
 
 class Dataset:

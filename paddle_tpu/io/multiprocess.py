@@ -28,6 +28,14 @@ import numpy as np
 _SHM_MIN_BYTES = 1024  # below this, pickling through the queue is cheaper
 
 
+class DataLoaderWorkerError(RuntimeError):
+    """A DataLoader worker process died without reporting a result (OOM
+    kill, segfault, os._exit in user code). The message names the dead
+    worker's pid and exit code; its orphaned shm segments are unlinked
+    before this is raised (reference: dataloader_iter.py's
+    _on_worker_exit SIGCHLD path)."""
+
+
 # --------------------------------------------------------------------------
 # payload (de)serialization: nested lists/tuples of np arrays + scalars
 
@@ -39,13 +47,18 @@ def _pack_raw(obj):
     return ("__raw__", obj)
 
 
-def _pack(obj, segments):
+def _pack(obj, segments, register=None):
     if isinstance(obj, dict):
-        return {"__dict__": {k: _pack(v, segments) for k, v in obj.items()}}
+        return {"__dict__": {k: _pack(v, segments, register)
+                             for k, v in obj.items()}}
     if isinstance(obj, (list, tuple)):
-        return type(obj)(_pack(o, segments) for o in obj)
+        return type(obj)(_pack(o, segments, register) for o in obj)
     if isinstance(obj, np.ndarray) and obj.nbytes >= _SHM_MIN_BYTES:
         shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        if register is not None:
+            # record the name the instant the segment exists, so a worker
+            # killed mid-pack never strands an unregistered segment
+            register(shm.name)
         view = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
         view[...] = obj
         segments.append(shm)
@@ -95,7 +108,8 @@ def _pick_start_method():
 
 
 def _worker_loop(dataset, collate_fn, index_queue, result_queue, wid,
-                 num_workers, worker_init_fn, seed, use_shm=True):
+                 num_workers, worker_init_fn, seed, use_shm=True,
+                 reg_dir=None):
     """One worker process: pull index lists, push packed batches."""
     from . import _set_worker_info
     _set_worker_info(wid, num_workers, dataset, seed)
@@ -111,13 +125,30 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue, wid,
             batch = collate_fn([dataset[i] for i in indices])
             segments = []
             if use_shm:
-                payload = _pack(batch, segments)
+                # shm registration side-channel: one filesystem file per
+                # batch, a name line flushed per segment AS IT IS CREATED.
+                # A queue is not crash-safe here — put() hands the bytes
+                # to a feeder thread, and os._exit/SIGKILL can drop them
+                # before they reach the pipe, stranding the segments with
+                # nobody who knows their names. A write() that returned
+                # is visible to the consumer no matter how we die next.
+                if reg_dir is not None:
+                    with open(os.path.join(
+                            reg_dir, f"b{bidx}-w{wid}"), "w") as rf:
+                        payload = _pack(
+                            batch, segments,
+                            register=lambda n: (rf.write(n + "\n"),
+                                                rf.flush()))
+                else:
+                    payload = _pack(batch, segments)
             else:  # small-/dev/shm hosts: pickle through the queue
                 payload = _pack_raw(batch)
-            result_queue.put((bidx, payload, None))
             # ownership transfers to the consumer (it unlinks): close our
-            # mapping and unregister from THIS process's resource_tracker
-            # so worker exit doesn't try to unlink already-freed segments
+            # mapping and unregister from the resource_tracker BEFORE the
+            # put — after it, the consumer may attach (which re-registers)
+            # concurrently and the tracker's name-set would collapse the
+            # two entries, making the later unregister a KeyError. The
+            # registry file above covers us if we die before the put.
             for shm in segments:
                 shm.close()
                 try:
@@ -125,6 +156,7 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue, wid,
                     resource_tracker.unregister(shm._name, "shared_memory")
                 except Exception:  # pragma: no cover
                     pass
+            result_queue.put((bidx, payload, None))
         except Exception:
             result_queue.put((bidx, None, traceback.format_exc()))
 
@@ -141,6 +173,12 @@ class MultiprocessIter:
         # ONE shared index queue: workers compete for jobs, so a slow
         # sample never head-of-line-blocks batches assigned to one worker
         self._index_queue = ctx.Queue()
+        # shm registration side-channel: workers record segment names in
+        # b<bidx>-w<wid> files here as they create them, so a worker death
+        # never strands segments (file writes survive os._exit; queue
+        # puts do not — the feeder thread may die with bytes unflushed)
+        import tempfile
+        self._reg_dir = tempfile.mkdtemp(prefix="ptdl-reg-")
         self._num_workers = num_workers
         self._workers = []
         for wid in range(num_workers):
@@ -148,10 +186,12 @@ class MultiprocessIter:
                 target=_worker_loop,
                 args=(dataset, collate_fn, self._index_queue,
                       self._result_queue, wid, num_workers, worker_init_fn,
-                      seed, use_shared_memory),
+                      seed, use_shared_memory, self._reg_dir),
                 daemon=True)
             w.start()
             self._workers.append(w)
+        self._received = set()     # bidx that made it out of result_queue
+        self._registered = {}      # bidx -> (wid, [segment names])
         self._index_iter = enumerate(index_iter)
         self._next_dispatch = 0
         self._next_yield = 0
@@ -178,24 +218,99 @@ class MultiprocessIter:
             self.close()
             raise StopIteration
         import queue as _q
+        import time as _t
+        deadline = (_t.monotonic() + self._timeout) if self._timeout else None
         while self._next_yield not in self._reorder:
+            # poll in short slices so a worker that DIED (no result, no
+            # traceback — e.g. OOM-killed) is noticed instead of blocking
+            # on the queue until the user timeout (or forever without one)
+            poll = 1.0
+            if deadline is not None:
+                remaining = deadline - _t.monotonic()
+                if remaining <= 0.0:
+                    self.close()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self._timeout}s "
+                        f"waiting for batch {self._next_yield} from "
+                        f"workers") from None
+                poll = min(poll, remaining)
             try:
                 bidx, payload, err = self._result_queue.get(
-                    timeout=self._timeout)
+                    timeout=max(0.01, poll))
             except _q.Empty:
-                self.close()
-                raise RuntimeError(
-                    f"DataLoader timed out after {self._timeout}s waiting "
-                    f"for batch {self._next_yield} from workers") from None
+                dead = self._dead_worker()
+                if dead is not None:
+                    self._abort_for_dead_worker(*dead)  # raises
+                continue
             if err is not None:
                 self.close()
                 raise RuntimeError(f"DataLoader worker failed:\n{err}")
             self._reorder[bidx] = payload
+            self._received.add(bidx)
         payload = self._reorder.pop(self._next_yield)
         self._next_yield += 1
         self._inflight -= 1
         self._dispatch_one()
         return _unpack(payload)
+
+    def _dead_worker(self):
+        """(wid, process) of a worker that exited abnormally, else None.
+        Exit 0 means the worker consumed its shutdown sentinel — normal."""
+        for wid, w in enumerate(self._workers):
+            if not w.is_alive() and w.exitcode not in (0, None):
+                return wid, w
+        return None
+
+    def _load_registry(self):
+        """Refresh _registered from the workers' registry files."""
+        try:
+            entries = os.listdir(self._reg_dir)
+        except OSError:
+            return
+        for fn in entries:
+            try:
+                bstr, wstr = fn.lstrip("b").split("-w")
+                bidx, wid = int(bstr), int(wstr)
+                with open(os.path.join(self._reg_dir, fn)) as f:
+                    names = [ln.strip() for ln in f if ln.strip()]
+            except (ValueError, OSError):  # pragma: no cover
+                continue
+            self._registered[bidx] = (wid, names)
+
+    @staticmethod
+    def _unlink_names(names):
+        for name in names:
+            try:
+                shm = shared_memory.SharedMemory(name=name)
+                shm.close()
+                shm.unlink()
+            except FileNotFoundError:
+                pass  # already unlinked through the payload path
+
+    def _abort_for_dead_worker(self, wid, w):
+        """A worker died between accepting a job and delivering its result.
+        Salvage what DID arrive, unlink the shm segments the dead worker
+        registered for batches that never will, then raise."""
+        import queue as _q
+        while True:  # results already queued are intact — keep them
+            try:
+                bidx, payload, err = self._result_queue.get_nowait()
+            except (_q.Empty, OSError, EOFError):
+                break
+            if err is None:
+                self._reorder[bidx] = payload
+            self._received.add(bidx)
+        self._load_registry()
+        for bidx, (owner, names) in list(self._registered.items()):
+            if owner == wid and bidx not in self._received:
+                self._unlink_names(names)
+                del self._registered[bidx]
+        pid, code = w.pid, w.exitcode
+        self.close()
+        raise DataLoaderWorkerError(
+            f"DataLoader worker {wid} (pid {pid}) died with exit code "
+            f"{code} before returning batch {self._next_yield}; its "
+            f"shared-memory segments were reclaimed")
 
     def _unlink_payload(self, payload):
         """Release shm segments of a batch that will never be consumed."""
@@ -232,11 +347,21 @@ class MultiprocessIter:
         self._reorder = {}
         while True:  # drain results produced after the consumer stopped
             try:
-                _, payload, err = self._result_queue.get_nowait()
+                bidx, payload, err = self._result_queue.get_nowait()
             except Exception:
                 break
+            self._received.add(bidx)
             if err is None:
                 self._unlink_payload(payload)
+        # registered-but-never-delivered segments (a worker died with its
+        # result unflushed, or was terminated above with batches in flight)
+        self._load_registry()
+        for bidx, (_owner, names) in self._registered.items():
+            if bidx not in self._received:
+                self._unlink_names(names)
+        self._registered = {}
+        import shutil
+        shutil.rmtree(self._reg_dir, ignore_errors=True)
 
     def __del__(self):  # pragma: no cover
         try:

@@ -159,10 +159,31 @@ class StepTelemetry:
                          total=int(self._retraces.value))
         else:
             self._latency.observe(dt)
+        _health_tick()
 
     @property
     def retraces(self) -> int:
         return int(self._retraces.value)
+
+
+_health_tick_fn = None
+
+
+def _health_tick():
+    """Any finished engine dispatch counts as liveness for the launcher's
+    hang detector. Lazy + cached: observability must not import resilience
+    at module load (resilience imports observability back, best-effort)."""
+    global _health_tick_fn
+    if _health_tick_fn is None:
+        try:
+            from ..resilience import health
+            _health_tick_fn = health.tick
+        except Exception:
+            _health_tick_fn = lambda: False  # noqa: E731
+    try:
+        _health_tick_fn()
+    except Exception:
+        pass
 
 
 def record_sync(seconds: float):

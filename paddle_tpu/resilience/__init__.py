@@ -10,6 +10,8 @@ package is the TPU-native consolidation of those mechanisms:
   anomaly     AnomalyGuard — bounded NaN/Inf step skipping, scaler-coupled
   chaos       deterministic fault injection (PADDLE_TPU_CHAOS) so every one
               of these paths is exercised by tier-1 tests on the CPU mesh
+  health      per-rank heartbeat files the launcher's hang detector reads
+              (PADDLE_TPU_HEARTBEAT_DIR / PADDLE_TPU_HANG_TIMEOUT_S)
 
 Every guard reports into the observability layer when it is importable:
 preemptions, watchdog firings, non-finite skips and retry attempts land as
@@ -27,9 +29,10 @@ from .retry import (DeadlineExceeded, RetryExhausted, RetryPolicy,  # noqa: F401
                     with_deadline)
 from .watchdog import StepWatchdog  # noqa: F401
 from . import chaos  # noqa: F401
+from . import health  # noqa: F401
 
 __all__ = [
     "AnomalyGuard", "NonFiniteLossError", "PreemptionGuard", "active_guard",
     "DeadlineExceeded", "RetryExhausted", "RetryPolicy", "with_deadline",
-    "StepWatchdog", "chaos",
+    "StepWatchdog", "chaos", "health",
 ]

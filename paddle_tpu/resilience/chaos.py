@@ -25,6 +25,17 @@ Spec grammar: `;`-separated `name[:int[:float]]` entries —
                           AFTER its checksum is recorded in the manifest —
                           deterministic bit rot the verified loader must
                           detect, quarantine and fall back from
+    kill_rank:R[:K]       rank R SIGKILLs itself at train step K (default
+                          2) — an abrupt peer death the launcher's gang
+                          restart must recover (distributed/launch.py)
+    hang_rank:R[:K[:S]]   rank R stops making progress at step K (default
+                          2): a host-side sleep of S seconds (default
+                          3600) with the heartbeat stopped, so the hang
+                          detector must notice, kill it, and gang-restart
+
+kill_rank / hang_rank fire only in restart round 0 (the launcher exports
+PADDLE_TPU_RESTART_ROUND to respawned workers), so a gang-restarted job
+resumes instead of re-killing itself into an infinite restart loop.
 
 Injection sites poll this module; with the env var unset every hook is a
 cheap no-op. Counters are in-process (each injected fault fires its exact
@@ -147,6 +158,35 @@ def bitflip_blob() -> bool:
     n = _counts.get("bitflip_ckpt", 0) + 1
     _counts["bitflip_ckpt"] = n
     return n == int(args[0])
+
+
+def _rank_fault(name: str, rank: int, step: int) -> Optional[Tuple[float, ...]]:
+    args = get(name)
+    if not args or int(args[0]) != rank:
+        return None
+    at = int(args[1]) if len(args) > 1 else 2
+    if step != at or _counts.get(name):
+        return None
+    _counts[name] = 1
+    return args
+
+
+def rank_fault_hook(rank: int, step: int) -> None:
+    """Per-train-step host hook for rank-targeted gang faults
+    (kill_rank:R[:K], hang_rank:R[:K[:S]]). Call with this process's rank
+    and the global step BEFORE the heartbeat tick, so a hung rank's last
+    heartbeat is strictly older than its surviving peers'. No-op outside
+    restart round 0 — see the module docstring."""
+    try:
+        if int(os.environ.get("PADDLE_TPU_RESTART_ROUND", "0") or 0) > 0:
+            return
+    except ValueError:
+        return
+    if _rank_fault("kill_rank", rank, step) is not None:
+        os.kill(os.getpid(), signal.SIGKILL)
+    args = _rank_fault("hang_rank", rank, step)
+    if args is not None:
+        time.sleep(args[2] if len(args) > 2 else 3600.0)
 
 
 def hang_before_dispatch(step: int) -> None:

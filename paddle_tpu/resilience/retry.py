@@ -94,11 +94,20 @@ class RetryPolicy:
         self.tries = 0
 
     def backoff(self, attempt: int) -> float:
-        """Planned sleep BEFORE retry `attempt` (attempt 0 never sleeps)."""
+        """Planned sleep BEFORE retry `attempt` (attempt 0 never sleeps).
+        Indices past the configured schedule are CLAMPED, not an error:
+        the launcher legitimately calls backoff(n) with n up to max_tries,
+        and a caller-supplied runaway index must saturate at max_delay
+        instead of overflowing the float exponent."""
         if attempt <= 0:
             return 0.0
-        d = min(self.base_delay * self.multiplier ** (attempt - 1),
-                self.max_delay)
+        if self.max_tries is not None:
+            attempt = min(attempt, self.max_tries)
+        try:
+            d = min(self.base_delay * self.multiplier ** (attempt - 1),
+                    self.max_delay)
+        except OverflowError:
+            d = self.max_delay
         if self.jitter:
             d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
         return max(0.0, d)

@@ -231,6 +231,92 @@ def run_elastic():
     print("ELASTIC_OK", rank)
 
 
+def _file_barrier(bdir, tag, rank, world, timeout=120.0):
+    """Same-host epoch barrier over the shared dir. The gang drill cannot
+    use eager cross-process XLA collectives (this container's CPU backend
+    rejects multiprocess computations — the same limitation that fails the
+    collective-parity tests here), and a barrier that BLOCKS when a peer
+    dies is exactly the symptom the launcher's health protocol must break.
+    The wait loop keeps TICKING the heartbeat (a host-side spin is alive
+    and responsive, unlike a rank wedged inside a C++ collective), so only
+    the genuinely hung peer's heartbeat goes stale."""
+    import time
+    from paddle_tpu.resilience import health
+    os.makedirs(bdir, exist_ok=True)
+    with open(os.path.join(bdir, f"{tag}.{rank}"), "w"):
+        pass
+    t0 = time.time()
+    while not all(os.path.exists(os.path.join(bdir, f"{tag}.{r}"))
+                  for r in range(world)):
+        if time.time() - t0 > timeout:
+            raise RuntimeError(f"barrier {tag} timed out on rank {rank}")
+        health.tick()
+        time.sleep(0.01)
+
+
+def run_gang():
+    """Gang-restart drill: epoch-range training under the launcher's
+    health protocol. A chaos kill_rank/hang_rank fault fells ONE rank in
+    restart round 0 at the top of epoch 2, BEFORE the epoch barrier — so
+    the survivor blocks, epoch 2 is never checkpointed, and the respawned
+    gang must resume from last-good epoch 1 (TrainEpochRange restore) and
+    re-run epochs 2-3. $PT_DIST_OUT.<rank> records the round and resume
+    epoch — the surviving file comes from the final incarnation."""
+    from paddle_tpu.framework.platform import pin_host_platform
+    pin_host_platform(1, verify=False)
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.incubate.checkpoint import TrainEpochRange
+    from paddle_tpu.resilience import chaos, health
+
+    dist.init_parallel_env()   # coordinator handshake (bootstrap deadline)
+    rank, world = dist.get_rank(), dist.get_world_size()
+    rnd = int(os.environ.get("PADDLE_TPU_RESTART_ROUND", "0") or 0)
+    ckpt_root = os.environ["PT_GANG_CKPT"]
+    bdir = os.path.join(ckpt_root, "barrier")
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.Tanh(),
+                               paddle.nn.Linear(16, 1))
+    tr = TrainEpochRange(4, "gang", checkpoint_dir=ckpt_root)
+    tr.restore(net)
+    start = tr.restored_epoch + 1
+
+    rs = np.random.RandomState(42)
+    X = rs.randn(8, 8).astype(np.float32)
+    Y = rs.randn(8, 1).astype(np.float32)
+    per = 8 // world
+    xs, ys = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
+    losses = []
+    for e in tr.get():
+        # fault BEFORE the tick: a hung rank's last heartbeat stays one
+        # epoch older than its blocked peers', so the launcher's
+        # stalest-rank pick lands on the actually-hung rank
+        chaos.rank_fault_hook(rank, e)
+        health.tick(e, force=True)
+        # barrier BEFORE compute: a felled peer stops the epoch for
+        # everyone, so the faulted epoch is never checkpointed
+        _file_barrier(bdir, f"{rnd}-{e}", rank, world)
+        x, y = paddle.to_tensor(xs), paddle.to_tensor(ys)
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        losses.append(float(loss.numpy()))
+        for p in net.parameters():
+            p.set_value(p.numpy() - 0.1 * p.grad.numpy())
+            p.clear_gradient()
+        if rank == 0:
+            tr.save(layer=net)
+
+    out = os.environ.get("PT_DIST_OUT")
+    if out:
+        with open(f"{out}.{rank}", "w") as f:
+            json.dump({"rank": rank, "start": start, "losses": losses,
+                       "round": rnd}, f)
+    print("GANG_OK", rank)
+
+
 def spawn_entry():
     """Entry for the paddle.distributed.spawn path (module-level so the
     mp 'spawn' start method can pickle it by reference)."""
@@ -248,6 +334,8 @@ def main():
         run_hybrid()
     elif mode == "elastic":
         run_elastic()
+    elif mode == "gang":
+        run_gang()
     else:
         run_rank()
 

@@ -2,11 +2,13 @@
 fluid/dataloader/dataloader_iter.py:320 _DataLoaderIterMultiProcess +
 mmap_allocator.cc shm transport): ordering, parity with the in-process
 path, shared-memory round-trip, worker-failure propagation, worker_info."""
+import os
+
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
-from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io import DataLoader, DataLoaderWorkerError, Dataset
 
 
 class ArrDataset(Dataset):
@@ -77,6 +79,38 @@ class TestMultiprocessLoader:
         out = np.concatenate([b.numpy() for b in DataLoader(
             Probe(), batch_size=2, num_workers=2, shuffle=False)])
         assert (out == 2).all()
+
+    def test_dead_worker_raises_and_reclaims_shm(self):
+        """A worker that DIES (os._exit: no traceback through the result
+        queue, unlike a raised exception) must surface as a
+        DataLoaderWorkerError naming the dead pid — not a silent hang —
+        and its registered shm segments must be unlinked."""
+        class Dying(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                from paddle_tpu.io import get_worker_info
+                if i == 9 and get_worker_info() is not None:
+                    os._exit(13)      # abrupt death inside a worker
+                # >= _SHM_MIN_BYTES so batches ride the shm transport
+                return np.full((64, 64), float(i), np.float32)
+
+        def shm_names():
+            try:
+                return {n for n in os.listdir("/dev/shm")
+                        if n.startswith("psm_")}
+            except OSError:           # non-Linux: skip the leak check
+                return None
+
+        before = shm_names()
+        loader = DataLoader(Dying(), batch_size=4, num_workers=2,
+                            shuffle=False)
+        with pytest.raises(DataLoaderWorkerError,
+                           match=r"pid \d+.* exit code 13"):
+            list(loader)
+        if before is not None:
+            assert shm_names() - before == set()   # nothing leaked
 
     def test_custom_collate_passthrough(self):
         def collate(samples):

@@ -170,6 +170,89 @@ def test_elastic_kill_relaunch_resume(tmp_path):
                                rtol=1e-6)
 
 
+def _run_gang(tmp_path, tag, chaos_spec, extra_env=None, timeout=420):
+    """2-rank launcher run of the gang drill with one injected rank fault
+    and a restart budget of 1. Returns (rc-run, out prefix, log dir)."""
+    out = os.path.join(str(tmp_path), tag)
+    log_dir = os.path.join(str(tmp_path), tag + "-logs")
+    env = _clean_env(out)
+    env["PT_GANG_CKPT"] = os.path.join(str(tmp_path), tag + "-ck")
+    env["PADDLE_TPU_CHAOS"] = chaos_spec
+    env["PADDLE_TPU_GANG_GRACE_S"] = "2"   # ranks wedge in C collectives
+    env.update(extra_env or {})
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2", "--max_restarts", "1",
+           "--log_dir", log_dir, WORKER, "gang"]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=timeout)
+    return r, out, log_dir
+
+
+def _check_gang_recovery(r, out, log_dir, cause):
+    """Shared assertions: one gang restart, resume from last-good epoch,
+    correct journal/metrics records, zero leaked worker processes."""
+    assert r.returncode == 0, r.stdout + r.stderr
+    for rank in (0, 1):
+        with open(f"{out}.{rank}") as f:
+            res = json.load(f)
+        # the surviving output is the respawned incarnation's, and it
+        # resumed AFTER the last committed epoch instead of from scratch
+        assert res["round"] == 1
+        assert res["start"] == 2
+        assert len(res["losses"]) == 2
+    events = []
+    with open(os.path.join(log_dir, "journal-launch.jsonl")) as f:
+        for line in f:
+            events.append(json.loads(line))
+    gang = [e for e in events if e["event"] == "gang_restart"]
+    assert len(gang) == 1
+    assert gang[0]["failed_rank"] == 1
+    assert gang[0]["cause"] == cause
+    # both log slots were cycled with a respawn separator
+    for rank in (0, 1):
+        with open(os.path.join(log_dir, f"workerlog.{rank}")) as f:
+            assert "--- respawn 1 ---" in f.read()
+    with open(os.path.join(log_dir, "metrics-launch.json")) as f:
+        metrics = json.load(f)["metrics"]
+    assert metrics["pt_gang_restarts_total"]["series"][0]["value"] == 1
+    # no leaked workers: every pid the launcher ever spawned is gone
+    spawned = [e["pid"] for e in events if e["event"] == "worker_spawn"]
+    assert len(spawned) == 4           # 2 ranks x 2 incarnations
+    for pid in spawned:
+        with pytest.raises(OSError):
+            os.kill(pid, 0)
+    return events
+
+
+def test_gang_restart_after_kill(tmp_path):
+    """Rank 1 SIGKILLs itself at epoch 2 (chaos kill_rank): the launcher
+    must tear down the whole gang, respawn it once, and the job finishes
+    from the last-good checkpoint."""
+    r, out, log_dir = _run_gang(tmp_path, "gkill", "kill_rank:1:2")
+    events = _check_gang_recovery(r, out, log_dir, "crash")
+    exits = [e for e in events if e["event"] == "worker_exit"]
+    assert any(e["rank"] == 1 and e["code"] == -9 for e in exits)
+
+
+def test_gang_restart_after_hang(tmp_path):
+    """Rank 1 stops making progress at epoch 2 with its pid alive (chaos
+    hang_rank): the heartbeat goes stale, the hang detector fires within
+    the timeout, and one gang restart finishes the job."""
+    r, out, log_dir = _run_gang(
+        tmp_path, "ghang", "hang_rank:1:2",
+        extra_env={"PADDLE_TPU_HANG_TIMEOUT_S": "3",
+                   "PADDLE_TPU_HEARTBEAT_INTERVAL_S": "0"},
+        timeout=480)
+    events = _check_gang_recovery(r, out, log_dir, "hang")
+    hangs = [e for e in events if e["event"] == "worker_hang"]
+    assert len(hangs) == 1
+    assert hangs[0]["rank"] == 1
+    assert hangs[0]["stale_s"] >= 3.0
+    with open(os.path.join(log_dir, "metrics-launch.json")) as f:
+        metrics = json.load(f)["metrics"]
+    assert metrics["pt_worker_hangs_total"]["series"][0]["value"] == 1
+
+
 def test_spawn_two_processes(tmp_path):
     out = os.path.join(str(tmp_path), "spawn")
     r = subprocess.run([sys.executable, WORKER, "spawn"],

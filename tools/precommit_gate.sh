@@ -101,4 +101,39 @@ EOF
         rc=$smoke_rc
     fi
 fi
+
+# Dist smoke (docs/RESILIENCE.md "Distributed failures"): a 2-rank
+# launch where chaos SIGKILLs rank 1 mid-run must gang-restart exactly
+# once, auto-resume from the last-good checkpoint, and finish rc=0.
+if [ "$rc" -eq 0 ]; then
+    DIST_DIR="$(mktemp -d /tmp/pt_dist_smoke_XXXXXX)"
+    timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        PADDLE_TPU_CHAOS="kill_rank:1:2" \
+        PADDLE_TPU_GANG_GRACE_S=2 \
+        PT_GANG_CKPT="$DIST_DIR/ckpt" \
+        PT_DIST_OUT="$DIST_DIR/out.json" \
+        python -m paddle_tpu.distributed.launch \
+            --nproc_per_node 2 --max_restarts 1 \
+            --log_dir "$DIST_DIR/logs" \
+            tests/dist_worker.py gang > "$DIST_DIR/launch.log" 2>&1
+    smoke_rc=$?
+    restarts=$(python - "$DIST_DIR/logs/metrics-launch.json" <<'EOF'
+import json, sys
+try:
+    data = json.load(open(sys.argv[1]))
+    print(int(data["metrics"]["pt_gang_restarts_total"]["series"][0]["value"]))
+except Exception:
+    print(-1)
+EOF
+)
+    if [ "$smoke_rc" -eq 0 ] && [ "$restarts" = "1" ]; then
+        echo "DIST_SMOKE=ok (2 ranks, rank 1 killed, gang_restarts=1)"
+        rm -rf "$DIST_DIR"
+    else
+        echo "DIST_SMOKE=FAILED (rc=$smoke_rc gang_restarts=$restarts, logs in $DIST_DIR)"
+        tail -20 "$DIST_DIR/launch.log"
+        [ "$smoke_rc" -ne 0 ] && rc=$smoke_rc || rc=1
+    fi
+fi
 exit $rc
