@@ -184,6 +184,33 @@ def _load_retry():
     return mod
 
 
+def _emit_bench_event(event, **fields):
+    """Journal a bench-level event (e.g. bench_probe_timeout) where the
+    round tooling can find it: journal-bench.jsonl under
+    PADDLE_TPU_BENCH_TELEMETRY_DIR, else PADDLE_TPU_TELEMETRY_DIR, else
+    <tempdir>/pt_bench_telemetry. journal.py is loaded by FILE PATH —
+    the bench parent must never import the paddle_tpu package (jax).
+    Never raises."""
+    try:
+        import importlib.util
+        import tempfile
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "paddle_tpu", "observability", "journal.py")
+        spec = importlib.util.spec_from_file_location(
+            "_pt_journal_standalone", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        d = (os.environ.get("PADDLE_TPU_BENCH_TELEMETRY_DIR")
+             or os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
+             or os.path.join(tempfile.gettempdir(), "pt_bench_telemetry"))
+        j = mod.RunJournal(d, filename="journal-bench.jsonl")
+        j.emit(event, **fields)
+        j.close()
+    except Exception:
+        pass
+
+
 def main():
     """Watchdog wrapper: a wedged TPU tunnel makes the first jax device use
     hang forever inside make_c_api_client — no in-process handling can
@@ -191,7 +218,12 @@ def main():
     body runs in a timed CHILD process, and the whole live-TPU campaign is
     bounded by a RetryPolicy deadline (PADDLE_TPU_BENCH_DEADLINE_S, default
     600s — BENCH_r05 went rc=124 because the old ~35-min linear loop could
-    outlive the caller's budget).
+    outlive the caller's budget). Probing alone is bounded tighter still
+    (PADDLE_TPU_BENCH_PROBE_TOTAL_S, default 300s): when no probe has
+    EVER succeeded inside that budget the tunnel is down, not slow — stop
+    burning the deadline on it, journal a `bench_probe_timeout` event, and
+    fall through to the banked/CPU paths so the caller always gets one
+    JSON line and rc 0 instead of BENCH_r05's bare rc=124.
 
     Order of preference for the headline:
       1. a live TPU bench run that completes within the deadline;
@@ -215,6 +247,10 @@ def main():
     deadline_s = float(os.environ.get("PADDLE_TPU_BENCH_DEADLINE_S", "600"))
     probe_timeout = float(
         os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "150"))
+    probe_total_s = float(
+        os.environ.get("PADDLE_TPU_BENCH_PROBE_TOTAL_S", "300"))
+    probe_t0 = time.monotonic()
+    probe_ok_once = False
     last_err = "live TPU probing disabled (PADDLE_TPU_BENCH_DEADLINE_S<=0)"
     if deadline_s > 0:
         policy = _load_retry().RetryPolicy(
@@ -223,17 +259,31 @@ def main():
                 os.environ.get("PADDLE_TPU_BENCH_RETRY_SLEEP", "60")),
             multiplier=1.5, max_delay=240.0, deadline_s=deadline_s)
         for i in policy.attempts():
+            spent = time.monotonic() - probe_t0
+            if not probe_ok_once and probe_total_s > 0 \
+                    and spent > probe_total_s:
+                # the tunnel never came up once: probing further only
+                # burns the deadline the fallbacks need (BENCH_r05)
+                last_err = ("tpu probe budget exhausted after %d attempts "
+                            "(%.0fs > %.0fs)" % (i, spent, probe_total_s))
+                _emit_bench_event("bench_probe_timeout", attempts=i,
+                                  spent_s=round(spent, 1),
+                                  budget_s=probe_total_s)
+                print("# bench: %s" % last_err, flush=True)
+                break
             if not _probe_tpu(max(5.0, min(probe_timeout,
                                            policy.remaining()))):
                 last_err = "tpu probe timed out (attempt %d)" % (i + 1)
                 print("# bench: %s, %.0fs budget left"
                       % (last_err, max(0.0, policy.remaining())), flush=True)
                 continue
+            probe_ok_once = True
             line, err = _run_bench_child(
                 force_cpu=False,
                 timeout_s=max(60.0, min(900.0, policy.remaining())))
             res = json.loads(line) if line is not None else None
             if res is not None and "error" not in res:
+                res.setdefault("mode", "tpu-live")
                 if cap is not None:
                     res["last_tpu_capture"] = {"file": cap_name, **cap}
                 print(json.dumps(res))
@@ -249,6 +299,7 @@ def main():
         print(json.dumps({
             "metric": _METRIC, "value": banked_gpt2["throughput"],
             "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+            "mode": "tpu-banked",
             "platform": "tpu (in-round capture %s)" % cap["timestamp"],
             "mfu": banked_gpt2.get("mfu"),
             "step_ms": banked_gpt2.get("step_ms"),
@@ -262,15 +313,32 @@ def main():
         }))
         return
 
-    # (4) CPU smoke fallback (no TPU evidence at all this round)
-    line, err = _run_bench_child(force_cpu=True)
+    # (4) CPU smoke fallback (no TPU evidence at all this round). Bounded
+    # by its own knob so the caller's budget is respected even here, and
+    # guaranteed to end in ONE JSON line with the probe failure in `tail`.
+    cpu_timeout = float(
+        os.environ.get("PADDLE_TPU_BENCH_CPU_TIMEOUT_S", "900"))
+    try:
+        line, err = _run_bench_child(force_cpu=True, timeout_s=cpu_timeout)
+    except Exception as e:
+        line, err = None, f"{type(e).__name__}: {e}"
     out = (json.loads(line) if line is not None else {
         "metric": _METRIC, "value": 0.0, "unit": "tokens/sec/chip",
         "vs_baseline": 0.0, "error": f"{last_err}; cpu fallback: {err}"})
+    out["mode"] = "cpu-fallback"
+    out["tail"] = last_err
     if cap is not None:  # capture exists but had no gpt2 row: still attach
         out["last_tpu_capture"] = {"file": cap_name, **cap}
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    # the one-JSON-line contract holds even when main() itself breaks:
+    # a driver parsing stdout must never see rc!=0 with nothing to parse
+    try:
+        main()
+    except Exception as e:
+        print(json.dumps({
+            "metric": _METRIC, "value": 0.0, "unit": "tokens/sec/chip",
+            "vs_baseline": 0.0, "mode": "error",
+            "error": f"{type(e).__name__}: {e}"}), flush=True)

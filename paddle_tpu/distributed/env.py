@@ -149,9 +149,64 @@ def init_parallel_env():
                       policy.tries, policy.deadline_s)
             raise
     _initialized = True
+    _init_worker_telemetry()
     from . import collective
     collective._ensure_world_group()
     return _env()
+
+
+def _init_worker_telemetry() -> None:
+    """Wire this worker into the run-level telemetry the launcher set up
+    (PADDLE_TPU_TELEMETRY_DIR, exported under --log_dir): configure the
+    flight recorder (crash bundles land next to the launcher's journal),
+    install a per-rank RunJournal when the program has none of its own
+    (Model.fit(telemetry_dir=...) would install one later and wins — we
+    only fill the gap for loop-style workers), and register an atexit
+    snapshot so every CLEAN exit leaves metrics-rank<N>.json for the
+    cross-rank rollup (a killed rank's snapshot lives in its crash
+    bundle instead). Best-effort throughout."""
+    tdir = os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
+    if not tdir:
+        return
+    try:
+        rank = int(get_rank())
+    except Exception:
+        try:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        except ValueError:
+            rank = 0
+    try:
+        from ..observability import flight
+        flight.configure(tdir, rank=rank)
+    except Exception:
+        return
+    try:
+        from ..observability import journal, metrics
+        installed = None
+        if journal.get_journal() is None:
+            installed = journal.RunJournal(tdir, rank=rank)
+            journal.set_journal(installed)
+        journal.emit(
+            "worker_start", rank=rank,
+            world=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+            restart_round=int(
+                os.environ.get("PADDLE_TPU_RESTART_ROUND", "0") or 0))
+
+        import atexit
+
+        def _snapshot():
+            try:
+                journal.emit("worker_end", rank=rank)
+                metrics.REGISTRY.write_json(
+                    os.path.join(tdir, "metrics-rank%d.json" % rank))
+                if installed is not None:
+                    installed.close()
+            except Exception:
+                pass
+
+        atexit.register(_snapshot)
+    except Exception:
+        pass
 
 
 def get_rank(group=None) -> int:

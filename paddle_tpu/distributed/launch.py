@@ -30,6 +30,22 @@ from ..resilience import health
 logger = logging.getLogger("paddle_tpu.launch")
 
 
+def _aggregate(log_dir: str, cause: str) -> None:
+    """Merge per-rank journals/heartbeats/crash bundles into
+    timeline.jsonl + metrics-rollup.json (observability/aggregate.py).
+    Called at exit AND after every gang restart, so the run-level view
+    of round N survives even when the launcher itself is later killed.
+    Best-effort: teardown paths must not gain new failure modes."""
+    try:
+        from ..observability import aggregate
+        res = aggregate.aggregate_run(log_dir, cause=cause)
+        if res:
+            logger.info("telemetry aggregated (%s): %d events -> %s",
+                        cause, res["events"], res["timeline"])
+    except Exception as e:
+        logger.warning("telemetry aggregation failed: %s", e)
+
+
 class _Worker:
     """One spawned worker process and its bookkeeping."""
 
@@ -153,6 +169,10 @@ def launch_collective(args) -> int:
             # workers heartbeat into the log dir; the watch loop's hang
             # detector reads the files back (resilience/health.py)
             env["PADDLE_TPU_HEARTBEAT_DIR"] = log_dir
+            # workers journal + crash-bundle into the same dir (setdefault:
+            # an operator-set telemetry home wins over the launcher's)
+            env.setdefault("PADDLE_TPU_TELEMETRY_DIR", log_dir)
+            env.setdefault("PADDLE_TPU_FLIGHT_DIR", log_dir)
             try:  # a dead incarnation's heartbeat must not damn the new one
                 os.unlink(health.heartbeat_path(log_dir, rank))
             except OSError:
@@ -307,6 +327,8 @@ def launch_collective(args) -> int:
                                  delay_s=round(delay, 3))
                 kill_with_grace(procs)
                 close_logs()
+                if log_dir:
+                    _aggregate(log_dir, "gang_restart")
                 time.sleep(delay)
                 procs = [spawn(lr, respawn=True, restart_round=restarts)
                          for lr in range(nprocs)]
@@ -336,15 +358,19 @@ def launch_collective(args) -> int:
     finally:
         close_logs()
         if journal_obj is not None:
+            # per-line flush puts launch_end on disk before aggregation
+            # reads the journal files back
             journal_obj.emit("launch_end", rc=rc, restarts=restarts)
-            run_journal.set_journal(prev_journal)
-            journal_obj.close()
         if log_dir:
             try:  # the gate and operators read the counters back from here
                 metrics.REGISTRY.write_json(
                     os.path.join(log_dir, "metrics-launch.json"))
             except OSError as e:
                 logger.warning("launch metrics snapshot failed: %s", e)
+            _aggregate(log_dir, "exit")
+        if journal_obj is not None:
+            run_journal.set_journal(prev_journal)
+            journal_obj.close()
     return rc
 
 

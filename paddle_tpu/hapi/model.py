@@ -211,6 +211,11 @@ class Model:
             prev_journal = run_journal.set_journal(journal_obj)
             journal_obj.emit("run_start", epochs=epochs,
                              batch_size=batch_size, jit=self._use_jit)
+            try:
+                from ..observability import flight
+                flight.configure(telemetry_dir, rank=rank)
+            except Exception:
+                pass
             if not any(isinstance(c, TelemetryCallback) for c in cbks):
                 cbks.append(TelemetryCallback())
 
@@ -316,6 +321,17 @@ class Model:
             elif ckpt_path and os.path.exists(ckpt_path):
                 import shutil
                 shutil.rmtree(ckpt_path, ignore_errors=True)
+        except Exception as e:
+            # Exception, not BaseException: a clean preemption exits via
+            # sys.exit(0) above and must not leave crash evidence
+            if telemetry_dir:
+                try:
+                    from ..observability import flight
+                    flight.dump_crash_bundle("fit_exception", exc=e,
+                                             last_step=it_count)
+                except Exception:
+                    pass
+            raise
         finally:
             if journal_obj is not None:
                 journal_obj.emit("run_end", it_count=it_count,
@@ -324,6 +340,12 @@ class Model:
                     from ..observability.metrics import REGISTRY
                     REGISTRY.write_json(
                         os.path.join(telemetry_dir, "metrics.json"))
+                    if journal_obj.rank is not None:
+                        # per-rank name too, so the launcher's cross-rank
+                        # rollup (aggregate.py) sees every rank's snapshot
+                        REGISTRY.write_json(os.path.join(
+                            telemetry_dir,
+                            "metrics-rank%d.json" % journal_obj.rank))
                 except OSError as e:
                     logger.warning("metrics snapshot failed: %s", e)
                 run_journal.set_journal(prev_journal)

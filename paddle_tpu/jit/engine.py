@@ -29,7 +29,7 @@ from ..framework import state
 from ..framework.flags import flag
 from ..framework.random import RNG
 from ..framework.tensor import Tensor
-from ..observability import tracing
+from ..observability import flight, tracing
 from ..resilience import chaos
 from ..resilience.watchdog import StepWatchdog
 
@@ -292,6 +292,9 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
         wd_s = float(flag("step_watchdog_s") or 0.0)
         args = (param_arrs, frozen_arrs, buf_arrs, acc_arrs, key, t, lr,
                 in_arrs, lab_arrs)
+        # one dict assignment: lets a crash bundle name the exact step
+        # that was in flight when the process died mid-dispatch
+        flight.note_dispatch("jit_train", optimizer._step_count)
         with telemetry.step(_aval_sig(in_arrs, lab_arrs)):
             if wd_s > 0:
                 # a wedged backend hangs INSIDE dispatch/blocking with no

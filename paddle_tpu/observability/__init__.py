@@ -1,31 +1,40 @@
-"""Unified telemetry layer: metrics registry, run journal, step tracing.
+"""Unified telemetry layer: metrics, journal, tracing, flight recorder.
 
-Three pure-stdlib modules (importable without jax — the same contract as
+Five pure-stdlib modules (importable without jax — the same contract as
 resilience/retry.py, so the launcher and the bench parent process can
 use them):
 
-  * `metrics`  — thread-safe Counter/Gauge/Histogram registry with
-                 Prometheus-text and JSON/JSONL exporters (`REGISTRY`);
-  * `journal`  — append-only JSONL run journal, one file per rank, with
-                 a process-wide `emit()` that resilience guards and the
-                 launcher write into;
-  * `tracing`  — `StepTelemetry` retrace/compile/step-latency accounting
-                 used by the jit engine and the static executor, gated by
-                 `PADDLE_TPU_TELEMETRY` / `tracing.enable()`.
+  * `metrics`   — thread-safe Counter/Gauge/Histogram registry with
+                  Prometheus-text and JSON/JSONL exporters (`REGISTRY`);
+  * `journal`   — append-only JSONL run journal, one file per rank, with
+                  a process-wide `emit()` that resilience guards and the
+                  launcher write into;
+  * `tracing`   — `StepTelemetry` retrace/compile/step-latency accounting
+                  used by the jit engine and the static executor, gated by
+                  `PADDLE_TPU_TELEMETRY` / `tracing.enable()`;
+  * `flight`    — bounded in-memory ring of recent events + HBM gauges,
+                  dumped as a crash bundle (`crash/<rank>-<ts>/`) on
+                  unhandled exception / watchdog fire / chaos kill;
+  * `aggregate` — cross-rank merge of journals/heartbeats/crash bundles
+                  into `timeline.jsonl` + `metrics-rollup.json`
+                  (rendered by `tools/ptdoctor.py`).
 
-See docs/OBSERVABILITY.md for the metric name table and journal event
-schema.
+See docs/OBSERVABILITY.md for the metric name table, journal event
+schema, and the "Post-mortem & crash forensics" section.
 """
-from . import journal, metrics, tracing
+from . import aggregate, flight, journal, metrics, tracing
+from .aggregate import aggregate_run
+from .flight import dump_crash_bundle
 from .journal import RunJournal, emit, get_journal, read_journal, set_journal
 from .metrics import (REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
                       exponential_buckets)
 from .tracing import StepTelemetry, enable, enabled, record_sync
 
 __all__ = [
-    "metrics", "journal", "tracing",
+    "metrics", "journal", "tracing", "flight", "aggregate",
     "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "exponential_buckets",
     "RunJournal", "set_journal", "get_journal", "emit", "read_journal",
     "StepTelemetry", "enabled", "enable", "record_sync",
+    "dump_crash_bundle", "aggregate_run",
 ]

@@ -1,0 +1,214 @@
+"""Cross-rank telemetry aggregation: one timeline, one metrics rollup.
+
+A 2-rank drill leaves `journal-rank0.jsonl`, `journal-rank1.jsonl`,
+`journal-launch.jsonl`, heartbeat files, per-rank metrics snapshots and
+(after a fault) a crash bundle — per-rank evidence with no run-level
+view. This module merges them:
+
+  * `merge_timeline(dir)` — every journal line (rotated `.1` generations
+    first), each heartbeat file as a synthetic `heartbeat_last` event,
+    and each crash bundle MANIFEST as a `crash_bundle_found` event, all
+    sorted by `ts` into one monotonic `timeline.jsonl`. Each record is
+    tagged with its source file (`src`).
+  * `rollup_metrics(dir)` — every metrics snapshot
+    (`metrics*.json` minus the rollup itself) reduced per series to
+    count/min/max/mean/p50/p95 across ranks into `metrics-rollup.json`.
+  * `aggregate_run(dir)` — both, never raises; the launcher calls it at
+    exit and after every gang restart, so the timeline survives even
+    when the run does not.
+
+Pure stdlib and standalone-loadable (`spec_from_file_location`) — the
+launcher and `tools/ptdoctor.py` must aggregate without importing the
+paddle_tpu package (which drags in jax). Torn final journal lines (the
+crash case by construction) are tolerated via `read_journal`'s skip
+counter.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import List, Optional, Tuple
+
+try:                                    # package import (normal case)
+    from . import journal as _journal
+except ImportError:                     # standalone load by file path
+    import importlib.util as _ilu
+
+    _spec = _ilu.spec_from_file_location(
+        "_pt_journal_standalone",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "journal.py"))
+    _journal = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_journal)
+
+read_journal = _journal.read_journal
+
+__all__ = ["load_events", "merge_timeline", "rollup_metrics",
+           "aggregate_run", "percentile"]
+
+TIMELINE = "timeline.jsonl"
+ROLLUP = "metrics-rollup.json"
+
+
+# ---------------------------------------------------------------- sources
+def _journal_files(directory: str) -> List[str]:
+    """Journal files in read order: each stem's rotated `.1` generation
+    (older) before the live file. `timeline.jsonl` can never match the
+    `journal-*` prefix, so re-aggregation is idempotent."""
+    live = sorted(glob.glob(os.path.join(directory, "journal-*.jsonl")))
+    out = []
+    for path in live:
+        if os.path.exists(path + ".1"):
+            out.append(path + ".1")
+        out.append(path)
+    return out
+
+
+def load_events(directory: str, stats: Optional[dict] = None) -> List[dict]:
+    """All events of a run dir, each tagged with `src`, stably sorted by
+    `ts` (ties keep source order, so one rank's equal-timestamp events
+    never interleave backwards)."""
+    events: List[dict] = []
+    for path in _journal_files(directory):
+        src = os.path.basename(path)
+        for rec in read_journal(path, stats=stats):
+            rec.setdefault("src", src)
+            events.append(rec)
+    for path in sorted(glob.glob(os.path.join(directory, "hb-rank*.json"))):
+        try:
+            with open(path) as f:
+                hb = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(hb, dict):
+            continue
+        events.append({"ts": hb.get("ts"), "event": "heartbeat_last",
+                       "rank": hb.get("rank"), "step": hb.get("step"),
+                       "pid": hb.get("pid"),
+                       "src": os.path.basename(path)})
+    for path in sorted(glob.glob(
+            os.path.join(directory, "crash", "*", "MANIFEST.json"))):
+        try:
+            with open(path) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(man, dict):
+            continue
+        events.append({"ts": man.get("ts"), "event": "crash_bundle_found",
+                       "rank": man.get("rank"),
+                       "reason": man.get("reason"),
+                       "last_step": man.get("last_step"),
+                       "pid": man.get("pid"),
+                       "src": os.path.relpath(path, directory)})
+    events.sort(key=lambda r: (r.get("ts") is None,
+                               r.get("ts") if isinstance(
+                                   r.get("ts"), (int, float)) else 0.0))
+    return events
+
+
+def merge_timeline(directory: str,
+                   out_path: Optional[str] = None) -> Tuple[str, int]:
+    """Write the merged monotonic timeline; returns (path, n_events).
+    Atomic tmp+rename so a reader never sees a half-written timeline."""
+    events = load_events(directory)
+    path = out_path or os.path.join(directory, TIMELINE)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        for rec in events:
+            f.write(json.dumps(rec, default=str) + "\n")
+    os.replace(tmp, path)
+    return path, len(events)
+
+
+# ----------------------------------------------------------------- rollup
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile on a sorted copy (no numpy by contract)."""
+    if not values:
+        raise ValueError("percentile of empty list")
+    vs = sorted(values)
+    idx = max(0, min(len(vs) - 1, int(round(q / 100.0 * (len(vs) - 1)))))
+    return vs[idx]
+
+
+def _series_key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join("%s=%s" % (k, labels[k]) for k in sorted(labels))
+    return "%s{%s}" % (name, inner)
+
+
+def _snapshot_files(directory: str) -> List[str]:
+    out = []
+    for path in sorted(glob.glob(os.path.join(directory, "metrics*.json"))):
+        if os.path.basename(path) == ROLLUP:
+            continue
+        out.append(path)
+    return out
+
+
+def rollup_metrics(directory: str,
+                   out_path: Optional[str] = None) -> Tuple[str, int]:
+    """Reduce every per-rank/launch metrics snapshot to run-level stats.
+
+    Counters and gauges contribute their value; histograms contribute
+    their mean (empty ones are skipped) plus a summed `total_count`.
+    Output: {"series": {"name{label=v}": {count,min,max,mean,p50,p95}}}.
+    """
+    per_series: dict = {}
+    hist_counts: dict = {}
+    sources = []
+    for path in _snapshot_files(directory):
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        metrics = snap.get("metrics") if isinstance(snap, dict) else None
+        if not isinstance(metrics, dict):
+            continue
+        sources.append(os.path.basename(path))
+        for name, meta in metrics.items():
+            for s in meta.get("series", []):
+                key = _series_key(name, s.get("labels") or {})
+                if "value" in s:
+                    val = s["value"]
+                elif s.get("count"):
+                    val = s["sum"] / s["count"]
+                    hist_counts[key] = hist_counts.get(key, 0) + s["count"]
+                else:
+                    continue
+                if isinstance(val, (int, float)):
+                    per_series.setdefault(key, []).append(float(val))
+    series = {}
+    for key, vals in sorted(per_series.items()):
+        entry = {"count": len(vals), "min": min(vals), "max": max(vals),
+                 "mean": sum(vals) / len(vals),
+                 "p50": percentile(vals, 50), "p95": percentile(vals, 95)}
+        if key in hist_counts:
+            entry["total_count"] = hist_counts[key]
+        series[key] = entry
+    path = out_path or os.path.join(directory, ROLLUP)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump({"ts": time.time(), "sources": sources,
+                   "series": series}, f, indent=1)
+    os.replace(tmp, path)
+    return path, len(series)
+
+
+def aggregate_run(directory: str, cause: str = "exit") -> Optional[dict]:
+    """Merge timeline + rollup for one run dir; returns a summary dict or
+    None. Never raises — the launcher calls this from teardown paths
+    where a secondary failure must not mask the primary one."""
+    try:
+        if not os.path.isdir(directory):
+            return None
+        t_path, n_events = merge_timeline(directory)
+        r_path, n_series = rollup_metrics(directory)
+        return {"cause": cause, "timeline": t_path, "events": n_events,
+                "rollup": r_path, "series": n_series}
+    except Exception:
+        return None

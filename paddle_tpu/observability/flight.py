@@ -1,0 +1,412 @@
+"""Flight recorder: bounded event ring + HBM gauges + crash bundles.
+
+A run that dies tells you nothing unless it left evidence behind. This
+module is the forensic half of the observability layer (the journal is
+the archival half): a bounded in-memory ring of the most recent
+structured events — step/compile ends, every journal record (steps,
+retraces, syncs, retries, nonfinite skips, checkpoint commits,
+heartbeat gaps), dispatch notes — fed by StepTelemetry and the journal
+tap at near-zero cost, plus per-step HBM gauges sampled from
+`device.memory_stats()`. On a crash, a watchdog fire, an injected
+kill/hang, an unhandled exception (or SIGTERM, behind an opt-in knob)
+the ring is dumped as a **crash bundle**:
+
+    <dir>/crash/<rank>-<ts>/
+        MANIFEST.json   reason, rank, pid, last dispatch/compile/step
+        ring.jsonl      the ring contents, oldest first
+        metrics.json    registry snapshot at death
+        stacks.txt      all-thread Python stacks (faulthandler)
+        env.json        env/config fingerprint (PADDLE/JAX/XLA/... keys)
+
+Env knobs (docs/OBSERVABILITY.md "Post-mortem & crash forensics"):
+
+    PADDLE_TPU_FLIGHT_DIR           bundle root (defaults to
+                                    PADDLE_TPU_TELEMETRY_DIR); unset +
+                                    unconfigured = dumps are no-ops
+    PADDLE_TPU_FLIGHT_EVENTS        ring capacity (default 512)
+    PADDLE_TPU_HBM_SAMPLE_S         min seconds between HBM samples
+                                    (default 0.5; first call always
+                                    samples)
+    PADDLE_TPU_FLIGHT_DUMP_ON_TERM  "1": also dump on SIGTERM (off by
+                                    default — a gang teardown's SIGTERM
+                                    to healthy survivors must not fake
+                                    crash bundles)
+
+Pure stdlib by contract; jax is only read from sys.modules (never
+imported), so standalone loads and jax-free processes stay clean.
+Every public function is best-effort: observing a run must never be
+what kills it.
+"""
+from __future__ import annotations
+
+import collections
+import faulthandler
+import json
+import os
+import platform as _platform
+import signal
+import socket
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+from . import metrics
+
+__all__ = ["record", "record_raw", "note_compile", "note_dispatch",
+           "note_step", "step_finished", "sample_hbm", "configure",
+           "dump_crash_bundle", "last_bundle", "ring_events", "reset"]
+
+ENV_DIR = "PADDLE_TPU_FLIGHT_DIR"
+ENV_EVENTS = "PADDLE_TPU_FLIGHT_EVENTS"
+ENV_HBM_INTERVAL = "PADDLE_TPU_HBM_SAMPLE_S"
+ENV_DUMP_ON_TERM = "PADDLE_TPU_FLIGHT_DUMP_ON_TERM"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+_ring = collections.deque(maxlen=max(16, _env_int(ENV_EVENTS, 512)))
+_dir: Optional[str] = None
+_rank: Optional[int] = None
+_last_compile: Optional[dict] = None
+_last_dispatch: Optional[dict] = None
+_last_step: Optional[int] = None
+_dump_lock = threading.Lock()
+_dumped_path: Optional[str] = None
+_hooks_installed = False
+_prev_excepthook = None
+_prev_term_handler = None
+
+_hbm_last_sample = 0.0
+_hbm_peak = 0.0
+_g_in_use = _g_peak = None
+
+
+# ------------------------------------------------------------------ ring
+def record(event: str, **fields) -> None:
+    """Append one event to the ring (deque append is atomic in CPython;
+    no lock on the hot path). Never raises."""
+    try:
+        rec = {"ts": round(time.time(), 6), "event": event}
+        rec.update(fields)
+        _ring.append(rec)
+    except Exception:
+        pass
+
+
+def record_raw(rec: dict) -> None:
+    """Journal tap: the record already carries the journal envelope."""
+    try:
+        _ring.append(rec)
+    except Exception:
+        pass
+
+
+def ring_events() -> list:
+    """Snapshot of the ring, oldest first."""
+    return list(_ring)
+
+
+def note_compile(engine: str, signature) -> None:
+    """StepTelemetry cache miss: remember what was last (re)compiled —
+    the bundle's answer to 'what signature was XLA building when it
+    died'."""
+    global _last_compile
+    try:
+        _last_compile = {"ts": round(time.time(), 6), "engine": engine,
+                         "signature": repr(signature)[:2000]}
+        _ring.append(dict(_last_compile, event="compile_begin"))
+    except Exception:
+        pass
+
+
+def note_dispatch(engine: str, step: Optional[int] = None) -> None:
+    """Engine hook, per dispatch: what is in flight right now."""
+    global _last_dispatch, _last_step
+    _last_dispatch = {"engine": engine, "step": step,
+                      "ts": round(time.time(), 6)}
+    if step is not None:
+        _last_step = step
+
+
+def note_step(step: Optional[int]) -> None:
+    """Heartbeat/loop hook: highest step this process reached."""
+    global _last_step
+    if step is not None:
+        _last_step = step
+
+
+def step_finished(engine: str, dt: float, miss: bool = False) -> None:
+    """StepTelemetry finish tap: ring the step/compile end and (rate-
+    limited) sample HBM. One dict + one append per step."""
+    try:
+        _ring.append({"ts": round(time.time(), 6),
+                      "event": "compile_end" if miss else "step_end",
+                      "engine": engine, "dt": round(dt, 6)})
+        sample_hbm()
+    except Exception:
+        pass
+
+
+# ------------------------------------------------------------- HBM gauges
+def sample_hbm(force: bool = False) -> Optional[int]:
+    """Sample device memory into pt_hbm_bytes_in_use / pt_hbm_peak_bytes.
+
+    TPU/GPU backends expose memory_stats(); the CPU backend does not, so
+    the fallback sums live jax array footprints (an under-count, but
+    monotone with real usage — same contract as TelemetryCallback's
+    sampler). jax is read from sys.modules only: a process that never
+    imported jax has no device memory to sample. Rate-limited
+    (PADDLE_TPU_HBM_SAMPLE_S, default 0.5s); the first call always
+    samples so a 2-step fit still populates the gauges."""
+    global _hbm_last_sample, _hbm_peak, _g_in_use, _g_peak
+    now = time.monotonic()
+    if not force and _hbm_last_sample and \
+            now - _hbm_last_sample < _env_float(ENV_HBM_INTERVAL, 0.5):
+        return None
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    _hbm_last_sample = now
+    try:
+        in_use = peak = None
+        dev = jax.local_devices()[0]
+        stats_fn = getattr(dev, "memory_stats", None)
+        if stats_fn is not None:
+            stats = stats_fn()
+            if stats and "bytes_in_use" in stats:
+                in_use = int(stats["bytes_in_use"])
+                peak = stats.get("peak_bytes_in_use")
+        if in_use is None:
+            in_use = int(sum(int(getattr(a, "nbytes", 0) or 0)
+                             for a in jax.live_arrays()))
+        _hbm_peak = max(_hbm_peak, float(in_use))
+        if peak is None:
+            peak = _hbm_peak
+        if _g_in_use is None:
+            _g_in_use = metrics.gauge(
+                "pt_hbm_bytes_in_use",
+                "Device memory in use at the last flight sample")
+            _g_peak = metrics.gauge(
+                "pt_hbm_peak_bytes",
+                "Peak device memory (backend peak_bytes_in_use, or the "
+                "running max of samples when the backend lacks it)")
+        _g_in_use.set(in_use)
+        _g_peak.set(float(peak))
+        return in_use
+    except Exception:
+        return None
+
+
+# --------------------------------------------------------------- configure
+def _resolve_dir() -> Optional[str]:
+    return _dir or os.environ.get(ENV_DIR) \
+        or os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
+
+
+def _resolve_rank() -> int:
+    if _rank is not None:
+        return _rank
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def configure(directory: Optional[str], rank: Optional[int] = None) -> None:
+    """Set the bundle root (and rank) and install the process hooks:
+    a chaining sys.excepthook that dumps before the crash unwinds, and —
+    only with PADDLE_TPU_FLIGHT_DUMP_ON_TERM=1 — a SIGTERM dumper.
+    Idempotent; called by Model.fit(telemetry_dir=...) and by
+    init_parallel_env from the launcher-exported env."""
+    global _dir, _rank
+    if directory:
+        _dir = directory
+    if rank is not None:
+        try:
+            _rank = int(rank)
+        except (TypeError, ValueError):
+            pass
+    _install_hooks()
+
+
+def _install_hooks() -> None:
+    global _hooks_installed, _prev_excepthook, _prev_term_handler
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+    _prev_excepthook = sys.excepthook
+
+    def _hook(tp, val, tb):
+        dump_crash_bundle("exception", exc=val)
+        if callable(_prev_excepthook):
+            _prev_excepthook(tp, val, tb)
+
+    try:
+        sys.excepthook = _hook
+    except Exception:
+        pass
+    if os.environ.get(ENV_DUMP_ON_TERM) != "1":
+        return
+    # opt-in only: a gang teardown SIGTERMs HEALTHY survivors; dumping
+    # for those would fake crash evidence (and break "exactly one
+    # bundle per drill"). Installs only when the slot still holds the
+    # default handler — a PreemptionGuard owns SIGTERM otherwise.
+    try:
+        if threading.current_thread() is threading.main_thread() and \
+                signal.getsignal(signal.SIGTERM) == signal.SIG_DFL:
+            def _term(signum, frame):
+                dump_crash_bundle("sigterm")
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+            _prev_term_handler = signal.signal(signal.SIGTERM, _term)
+    except (ValueError, OSError):
+        pass
+
+
+# ------------------------------------------------------------ crash bundle
+def _bundle_dir(base: str) -> str:
+    stamp = time.strftime("%Y%m%dT%H%M%S")
+    path = os.path.join(base, "crash", "%d-%s" % (_resolve_rank(), stamp))
+    if os.path.exists(path):
+        path += "-p%d" % os.getpid()
+    return path
+
+
+def _env_fingerprint() -> dict:
+    prefixes = ("PADDLE", "JAX", "XLA", "TPU_", "FLAGS", "PT_",
+                "LIBTPU")
+    return {
+        "python": sys.version,
+        "platform": _platform.platform(),
+        "argv": list(sys.argv),
+        "cwd": os.getcwd(),
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith(prefixes)},
+    }
+
+
+def dump_crash_bundle(reason: str, exc: Optional[BaseException] = None,
+                      last_step: Optional[int] = None,
+                      force: bool = False, **info) -> Optional[str]:
+    """Write the crash bundle; returns its path (None when no directory
+    is configured). Once per process by default — a fit-loop dump
+    followed by the excepthook firing on the same exception must not
+    produce two bundles — `force=True` overrides. Never raises; each
+    artifact is written independently so a failure in one (e.g. a
+    metrics snapshot racing a writer) cannot void the others. The
+    `crash_bundle` journal line is emitted BEFORE returning: the
+    journal flushes per line, so it survives an immediately following
+    SIGKILL (the chaos kill path dumps pre-mortem)."""
+    global _dumped_path, _last_step
+    base = _resolve_dir()
+    if not base:
+        return None
+    with _dump_lock:
+        if _dumped_path is not None and not force:
+            return _dumped_path
+        if last_step is not None:
+            _last_step = last_step
+        try:
+            bdir = _bundle_dir(base)
+            os.makedirs(bdir, exist_ok=True)
+        except OSError:
+            return None
+        _dumped_path = bdir
+    manifest = {"reason": reason, "ts": round(time.time(), 6),
+                "iso": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "rank": _resolve_rank(), "pid": os.getpid(),
+                "host": socket.gethostname(),
+                "last_step": _last_step,
+                "last_dispatch": _last_dispatch,
+                "last_compile": _last_compile,
+                "ring_events": len(_ring)}
+    if exc is not None:
+        manifest["error"] = "%s: %s" % (type(exc).__name__, exc)
+    manifest.update(info)
+    try:
+        with open(os.path.join(bdir, "MANIFEST.json"), "w") as f:
+            json.dump(manifest, f, indent=1, default=str)
+    except Exception:
+        pass
+    try:
+        with open(os.path.join(bdir, "ring.jsonl"), "w") as f:
+            for rec in list(_ring):
+                f.write(json.dumps(rec, default=str) + "\n")
+    except Exception:
+        pass
+    try:
+        with open(os.path.join(bdir, "stacks.txt"), "w") as f:
+            if exc is not None:
+                f.write("".join(traceback.format_exception(
+                    type(exc), exc, exc.__traceback__)))
+                f.write("\n--- all threads ---\n")
+                # faulthandler writes to the raw fd; flush the buffered
+                # text first or it lands on top of the dump
+                f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=True)
+    except Exception:
+        pass
+    try:
+        metrics.REGISTRY.write_json(os.path.join(bdir, "metrics.json"))
+    except Exception:
+        pass
+    try:
+        with open(os.path.join(bdir, "env.json"), "w") as f:
+            json.dump(_env_fingerprint(), f, indent=1, default=str)
+    except Exception:
+        pass
+    try:
+        metrics.counter("pt_crash_bundles_total",
+                        "Crash bundles dumped by the flight recorder").inc()
+        from . import journal
+        journal.emit("crash_bundle", reason=reason, path=bdir,
+                     last_step=_last_step)
+    except Exception:
+        pass
+    return bdir
+
+
+def on_preemption(signum: int) -> None:
+    """PreemptionGuard hook: a preemption is an ORDERLY death (the guard
+    checkpoints and exits 0), so no bundle unless the operator opted in
+    via PADDLE_TPU_FLIGHT_DUMP_ON_TERM. The ring still gets the event
+    through the journal tap either way."""
+    if os.environ.get(ENV_DUMP_ON_TERM) == "1":
+        dump_crash_bundle("preemption", signum=int(signum))
+
+
+def last_bundle() -> Optional[str]:
+    return _dumped_path
+
+
+def reset() -> None:
+    """Test isolation: clear the ring, notes, dump once-guard and the
+    configured directory; restore a hooked excepthook."""
+    global _dir, _rank, _last_compile, _last_dispatch, _last_step
+    global _dumped_path, _hooks_installed, _hbm_last_sample, _hbm_peak
+    _ring.clear()
+    _dir = _rank = None
+    _last_compile = _last_dispatch = _last_step = None
+    _dumped_path = None
+    _hbm_last_sample = 0.0
+    _hbm_peak = 0.0
+    if _hooks_installed and _prev_excepthook is not None:
+        try:
+            sys.excepthook = _prev_excepthook
+        except Exception:
+            pass
+    _hooks_installed = False

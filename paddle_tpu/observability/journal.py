@@ -44,6 +44,25 @@ def _default_rank() -> int:
         return 0
 
 
+_flight = None  # unresolved → module or False after first tap
+
+
+def _flight_tap(rec: dict) -> None:
+    """Mirror every journal record into the flight recorder's ring so a
+    crash bundle carries the recent event history for free. Lazy and
+    cached: standalone stdlib loads (bench parent, launcher helpers)
+    have no package context, resolve to False once, and skip forever."""
+    global _flight
+    if _flight is None:
+        try:
+            from . import flight as _mod
+            _flight = _mod
+        except Exception:
+            _flight = False
+    if _flight:
+        _flight.record_raw(rec)
+
+
 class RunJournal:
     """Append-only JSONL event log with size-based rotation.
 
@@ -82,6 +101,10 @@ class RunJournal:
                "rank": self.rank, "host": self.host, "pid": os.getpid(),
                "event": event}
         rec.update(fields)
+        try:
+            _flight_tap(rec)
+        except Exception:
+            pass
         try:
             line = json.dumps(rec, default=str) + "\n"
         except (TypeError, ValueError) as e:
@@ -149,21 +172,52 @@ def emit(event: str, **fields) -> bool:
     if logger.isEnabledFor(logging.DEBUG):
         logger.debug("%s %s", event, fields)
     if j is None:
+        # no journal file, but the flight ring still wants the event —
+        # a crash bundle from a journal-less process keeps its history.
+        try:
+            rec = {"ts": round(time.time(), 6), "event": event}
+            rec.update(fields)
+            _flight_tap(rec)
+        except Exception:
+            pass
         return False
     return j.emit(event, **fields)
 
 
-def read_journal(path: str) -> List[dict]:
+def read_journal(path: str, stats: Optional[dict] = None) -> List[dict]:
     """Parse a journal file; corrupt/truncated lines are skipped (a crash
-    mid-write must not make the whole journal unreadable)."""
+    mid-write tears the final line BY CONSTRUCTION — SIGKILL between
+    write and flush — and a torn tail must not make the whole journal
+    unreadable for aggregate.py/ptdoctor). Skips accumulate into
+    `stats["skipped"]` when a dict is passed, and into the
+    `pt_journal_torn_lines_total` counter when the registry is loadable
+    (standalone stdlib loads skip the counter silently). Undecodable
+    bytes are replaced rather than raised, for the same reason."""
     out = []
-    with open(path) as f:
+    skipped = 0
+    with open(path, errors="replace") as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
             try:
-                out.append(json.loads(line))
+                rec = json.loads(line)
             except ValueError:
+                skipped += 1
                 continue
+            if not isinstance(rec, dict):
+                skipped += 1
+                continue
+            out.append(rec)
+    if stats is not None:
+        stats["skipped"] = stats.get("skipped", 0) + skipped
+    if skipped:
+        try:
+            from . import metrics as _metrics
+            _metrics.counter(
+                "pt_journal_torn_lines_total",
+                "Torn/corrupt journal lines skipped on read-back",
+            ).inc(skipped)
+        except Exception:
+            pass
     return out

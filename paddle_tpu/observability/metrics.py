@@ -19,6 +19,7 @@ from __future__ import annotations
 import bisect
 import json
 import math
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -41,6 +42,19 @@ def exponential_buckets(start: float, factor: float, count: int
 DEFAULT_BUCKETS = exponential_buckets(1e-4, 2.0, 21)
 
 
+def _default_max_series() -> int:
+    """Per-metric series cap (env PADDLE_TPU_METRICS_MAX_SERIES, default
+    1000). An unbounded label set — a step id, a pid, a hostname leaking
+    into a labelname — grows the registry forever; past the cap new
+    combinations are DROPPED into a detached overflow child instead of
+    raising, because a metrics call must never take down the run."""
+    try:
+        return max(1, int(os.environ.get(
+            "PADDLE_TPU_METRICS_MAX_SERIES", "") or 1000))
+    except ValueError:
+        return 1000
+
+
 def _escape(v: str) -> str:
     return (str(v).replace("\\", r"\\").replace("\n", r"\n")
             .replace('"', r'\"'))
@@ -60,14 +74,18 @@ class _Metric:
     kind = "untyped"
 
     def __init__(self, name: str, help: str = "",
-                 labelnames: Sequence[str] = (), max_series: int = 1000,
-                 _registry=None):
+                 labelnames: Sequence[str] = (),
+                 max_series: Optional[int] = None, _registry=None):
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self.max_series = int(max_series)
+        self.max_series = int(max_series) if max_series is not None \
+            else _default_max_series()
         self._lock = threading.RLock()
         self._children: Dict[Tuple[str, ...], object] = {}
+        self._overflow = None       # detached sink for over-cap children
+        self._dropped = 0
+        self._drop_journaled = False
         if not self.labelnames:
             self._children[()] = self._new_child()
 
@@ -94,12 +112,46 @@ class _Metric:
             child = self._children.get(values)
             if child is None:
                 if len(self._children) >= self.max_series:
-                    raise ValueError(
-                        f"metric {self.name!r} exceeded max label "
-                        f"cardinality {self.max_series} (adding "
-                        f"{dict(zip(self.labelnames, values))})")
+                    # cardinality guard: hand back a detached child that
+                    # absorbs the writes but is invisible to exporters —
+                    # the caller keeps working, the registry stays
+                    # bounded, and the drop is itself observable.
+                    if self._overflow is None:
+                        self._overflow = self._new_child()
+                    self._dropped += 1
+                    self._note_series_drop(
+                        dict(zip(self.labelnames, values)))
+                    return self._overflow
                 child = self._children[values] = self._new_child()
             return child
+
+    def _note_series_drop(self, labels: dict) -> None:
+        """Count every refused series in pt_metrics_dropped_series_total
+        and journal once per metric on the FIRST drop (one line, not one
+        per call — the drop path may be the hot path that overflowed)."""
+        try:
+            REGISTRY.counter(
+                "pt_metrics_dropped_series_total",
+                "Label combinations refused by the per-metric series "
+                "cardinality cap (PADDLE_TPU_METRICS_MAX_SERIES)",
+            ).inc()
+        except Exception:
+            pass
+        if self._drop_journaled:
+            return
+        self._drop_journaled = True
+        try:
+            from . import journal
+            journal.emit("metrics_series_dropped", metric=self.name,
+                         max_series=self.max_series, labels=labels)
+        except Exception:
+            pass
+
+    @property
+    def dropped_series(self) -> int:
+        """Label combinations refused by the cardinality cap so far."""
+        with self._lock:
+            return self._dropped
 
     def _default(self):
         if self.labelnames:
@@ -235,7 +287,7 @@ class Histogram(_Metric):
     kind = "histogram"
 
     def __init__(self, name, help="", labelnames=(), buckets=None,
-                 max_series=1000):
+                 max_series=None):
         bks = tuple(sorted(buckets)) if buckets else DEFAULT_BUCKETS
         if len(set(bks)) != len(bks):
             raise ValueError("duplicate bucket edges")

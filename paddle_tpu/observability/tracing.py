@@ -32,7 +32,7 @@ import os
 import time
 from typing import Optional
 
-from . import journal, metrics
+from . import flight, journal, metrics
 
 __all__ = ["enabled", "enable", "StepTelemetry", "record_sync",
            "SYNC_SECONDS", "TRAIN_STEPS"]
@@ -140,6 +140,9 @@ class StepTelemetry:
         miss = signature not in self._seen
         if miss:
             self._seen.add(signature)
+            # the flight recorder keeps the last-compiled signature so a
+            # crash bundle can answer "what was XLA building when it died"
+            flight.note_compile(self.engine, signature)
         else:
             now = time.perf_counter()
             if self._last_hit_entry is not None:
@@ -159,6 +162,7 @@ class StepTelemetry:
                          total=int(self._retraces.value))
         else:
             self._latency.observe(dt)
+        flight.step_finished(self.engine, dt, span.miss)
         _health_tick()
 
     @property

@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import os
 import signal
+import sys
 import time
 from typing import Dict, Optional, Tuple
 
@@ -171,6 +172,21 @@ def _rank_fault(name: str, rank: int, step: int) -> Optional[Tuple[float, ...]]:
     return args
 
 
+def _flight_dump(reason: str, step: int) -> None:
+    """Crash-bundle the flight ring BEFORE an injected fault lands.
+    SIGKILL is uncatchable and a hang never returns, so the pre-mortem
+    dump is the only one there will ever be — exactly what a real
+    external SIGKILL denies us, which is why the drill writes it here.
+    sys.modules only (chaos stays pure-stdlib; no package, no dump)."""
+    flight = sys.modules.get("paddle_tpu.observability.flight")
+    if flight is None:
+        return
+    try:
+        flight.dump_crash_bundle(reason, last_step=step)
+    except Exception:
+        pass
+
+
 def rank_fault_hook(rank: int, step: int) -> None:
     """Per-train-step host hook for rank-targeted gang faults
     (kill_rank:R[:K], hang_rank:R[:K[:S]]). Call with this process's rank
@@ -183,9 +199,11 @@ def rank_fault_hook(rank: int, step: int) -> None:
     except ValueError:
         return
     if _rank_fault("kill_rank", rank, step) is not None:
+        _flight_dump("chaos_kill", step)
         os.kill(os.getpid(), signal.SIGKILL)
     args = _rank_fault("hang_rank", rank, step)
     if args is not None:
+        _flight_dump("chaos_hang", step)
         time.sleep(args[2] if len(args) > 2 else 3600.0)
 
 

@@ -66,6 +66,22 @@ def _observe_tick(rank: int, step: Optional[int]):
         pass
 
 
+def _observe_gap(rank: int, gap_s: float, step: Optional[int]):
+    """A tick arriving long after the previous one means the step loop
+    stalled and RECOVERED — invisible to the hang detector (which only
+    sees ranks that never come back) but exactly what a post-mortem
+    wants in the flight ring. Best-effort, standalone-safe."""
+    try:
+        from ..observability import flight
+    except Exception:
+        return
+    try:
+        flight.record("heartbeat_gap", rank=rank, gap_s=round(gap_s, 3),
+                      step=step)
+    except Exception:
+        pass
+
+
 class HeartbeatWriter:
     """Rate-limited atomic heartbeat file writer for ONE rank.
 
@@ -112,6 +128,10 @@ class HeartbeatWriter:
             except OSError:
                 pass
             return False
+        if self.ticks_written and self._last_write:
+            gap = now - self._last_write
+            if gap > max(5.0, 5 * self.min_interval_s):
+                _observe_gap(self.rank, gap, self.last_step)
         self._last_write = now
         self.ticks_written += 1
         _observe_tick(self.rank, self.last_step)

@@ -93,6 +93,15 @@ class PreemptionGuard:
                 eng.flush_on_preemption()
         except Exception:
             pass  # a failed flush must not lose the preemption flag
+        try:
+            # same never-import rule: flight only bundles on preemption
+            # when PADDLE_TPU_FLIGHT_DUMP_ON_TERM opts in (a preemption
+            # is an orderly exit, not a crash)
+            fl = sys.modules.get("paddle_tpu.observability.flight")
+            if fl is not None:
+                fl.on_preemption(signum)
+        except Exception:
+            pass
         for fn in self._callbacks:
             try:
                 fn(signum)

@@ -76,11 +76,17 @@ class StepWatchdog:
         except Exception:
             pass  # diagnostics must never mask the original condition
         try:
-            from ..observability import journal, metrics
+            from ..observability import flight, journal, metrics
             metrics.counter("pt_watchdog_fires_total",
                             "StepWatchdog timeouts").inc()
             journal.emit("watchdog", context=self.context,
                          timeout_s=self.timeout_s, action=self.action)
+            # a firing watchdog means the dispatch is wedged: bundle the
+            # flight ring NOW — with action="abort" this process is gone
+            # two lines from here
+            flight.dump_crash_bundle("watchdog", context=self.context,
+                                     timeout_s=self.timeout_s,
+                                     action=self.action)
         except Exception:
             pass
         if self.on_fire is not None:

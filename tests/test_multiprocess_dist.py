@@ -190,7 +190,8 @@ def _run_gang(tmp_path, tag, chaos_spec, extra_env=None, timeout=420):
 
 def _check_gang_recovery(r, out, log_dir, cause):
     """Shared assertions: one gang restart, resume from last-good epoch,
-    correct journal/metrics records, zero leaked worker processes."""
+    correct journal/metrics records, zero leaked worker processes, and the
+    post-mortem artifacts (timeline, exactly one crash bundle, ptdoctor)."""
     assert r.returncode == 0, r.stdout + r.stderr
     for rank in (0, 1):
         with open(f"{out}.{rank}") as f:
@@ -221,7 +222,48 @@ def _check_gang_recovery(r, out, log_dir, cause):
     for pid in spawned:
         with pytest.raises(OSError):
             os.kill(pid, 0)
+    _check_forensics(log_dir, cause)
     return events
+
+
+def _check_forensics(log_dir, cause):
+    """Post-mortem artifacts (docs/OBSERVABILITY.md): the launcher merged
+    a monotonic cross-rank timeline, the faulted rank (and ONLY it) left a
+    crash bundle before dying, and ptdoctor renders the run."""
+    timeline = os.path.join(log_dir, "timeline.jsonl")
+    assert os.path.exists(timeline)
+    evs = []
+    with open(timeline) as f:
+        for line in f:
+            evs.append(json.loads(line))
+    ts = [e["ts"] for e in evs if e.get("ts") is not None]
+    assert ts == sorted(ts)            # monotonic merge
+    srcs = {e["src"] for e in evs}
+    assert any("journal-rank0" in s for s in srcs), srcs
+    assert any("journal-rank1" in s for s in srcs), srcs
+    # both incarnations of the workers checked in
+    starts = [e for e in evs if e["event"] == "worker_start"]
+    assert {e["restart_round"] for e in starts} == {0, 1}
+    # exactly ONE crash bundle: the chaos rank dumped pre-mortem; the
+    # healthy survivor's gang-teardown SIGTERM must NOT have produced one
+    bundles = sorted(os.listdir(os.path.join(log_dir, "crash")))
+    assert len(bundles) == 1, bundles
+    man = json.load(open(os.path.join(log_dir, "crash", bundles[0],
+                                      "MANIFEST.json")))
+    assert man["rank"] == 1
+    assert man["reason"] == ("chaos_kill" if cause == "crash"
+                             else "chaos_hang")
+    assert man["last_step"] == 2
+    # the rollup saw more than one rank's snapshot
+    roll = json.load(open(os.path.join(log_dir, "metrics-rollup.json")))
+    assert len(roll["sources"]) >= 2, roll
+    # ptdoctor renders the dir and reports the restart + the bundle
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ptdoctor.py"),
+         "summary", log_dir], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "restarts=1" in r.stdout
+    assert "crash bundle" in r.stdout and "rank=1" in r.stdout
 
 
 def test_gang_restart_after_kill(tmp_path):

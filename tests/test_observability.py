@@ -79,8 +79,20 @@ class TestMetricsMath:
         small = metrics.Counter("s_total", labelnames=("k",), max_series=3)
         for i in range(3):
             small.labels(str(i)).inc()
-        with pytest.raises(ValueError, match="cardinality"):
-            small.labels("overflow")
+        # over the cap: the call still WORKS (returns a detached overflow
+        # child) but the series is dropped, counted, and invisible to
+        # exporters — a cardinality explosion must not crash the run
+        before = metrics.REGISTRY.counter(
+            "pt_metrics_dropped_series_total", "").value
+        small.labels("overflow").inc()
+        small.labels("overflow2").inc()
+        assert small.series_count == 3
+        assert small.dropped_series == 2
+        assert metrics.REGISTRY.counter(
+            "pt_metrics_dropped_series_total", "").value == before + 2
+        # an already-registered combination keeps resolving past the cap
+        small.labels("0").inc()
+        assert small.labels("0").value == 2.0
 
     def test_registry_type_and_label_consistency(self):
         r = MetricsRegistry()

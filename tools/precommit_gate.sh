@@ -127,12 +127,26 @@ except Exception:
     print(-1)
 EOF
 )
-    if [ "$smoke_rc" -eq 0 ] && [ "$restarts" = "1" ]; then
-        echo "DIST_SMOKE=ok (2 ranks, rank 1 killed, gang_restarts=1)"
+    # forensics (docs/OBSERVABILITY.md "Post-mortem & crash forensics"):
+    # the launcher must have merged a cross-rank timeline, the killed rank
+    # must have left exactly ONE crash bundle, and ptdoctor must render
+    # the run dir without error.
+    bundles=$(ls -d "$DIST_DIR"/logs/crash/*/ 2>/dev/null | wc -l)
+    doctor_rc=1
+    if [ -d "$DIST_DIR/logs" ]; then
+        python tools/ptdoctor.py summary "$DIST_DIR/logs" \
+            > "$DIST_DIR/ptdoctor.log" 2>&1
+        doctor_rc=$?
+    fi
+    if [ "$smoke_rc" -eq 0 ] && [ "$restarts" = "1" ] \
+            && [ -f "$DIST_DIR/logs/timeline.jsonl" ] \
+            && [ "$bundles" = "1" ] && [ "$doctor_rc" -eq 0 ]; then
+        echo "DIST_SMOKE=ok (2 ranks, rank 1 killed, gang_restarts=1, timeline + 1 crash bundle, ptdoctor ok)"
         rm -rf "$DIST_DIR"
     else
-        echo "DIST_SMOKE=FAILED (rc=$smoke_rc gang_restarts=$restarts, logs in $DIST_DIR)"
+        echo "DIST_SMOKE=FAILED (rc=$smoke_rc gang_restarts=$restarts bundles=$bundles ptdoctor_rc=$doctor_rc, logs in $DIST_DIR)"
         tail -20 "$DIST_DIR/launch.log"
+        [ -f "$DIST_DIR/ptdoctor.log" ] && tail -20 "$DIST_DIR/ptdoctor.log"
         [ "$smoke_rc" -ne 0 ] && rc=$smoke_rc || rc=1
     fi
 fi

@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""ptdoctor: post-mortem CLI for a paddle_tpu telemetry directory.
+
+    python tools/ptdoctor.py summary  <telemetry_dir>
+    python tools/ptdoctor.py timeline <telemetry_dir> [--last N]
+    python tools/ptdoctor.py crash    <telemetry_dir>
+
+`summary` answers "what happened to run X" from one command: per-rank
+step counts/rates and last-alive step, retraces per engine, restart
+count, the stalest rank, and a digest of every crash bundle. `timeline`
+prints the merged cross-rank event stream (monotonic by ts).  `crash`
+dumps each bundle's manifest, the tail of its flight ring, and the head
+of its stack capture.
+
+Stdlib only, and paddle_tpu is never imported (it pulls in jax — this
+tool must run on a machine that has nothing but the run dir). The
+aggregation logic is loaded straight from
+paddle_tpu/observability/aggregate.py by file path.
+
+Exit codes: 0 success, 2 bad usage / missing directory.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_aggregate():
+    path = os.path.join(_REPO, "paddle_tpu", "observability", "aggregate.py")
+    spec = importlib.util.spec_from_file_location("_pt_aggregate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fmt_ts(ts) -> str:
+    if not isinstance(ts, (int, float)):
+        return "?"
+    import time
+    return time.strftime("%H:%M:%S", time.localtime(ts)) + \
+        ("%.3f" % (ts % 1.0))[1:]
+
+
+def _rank_of(rec) -> object:
+    src = rec.get("src", "")
+    if src.startswith("journal-rank"):
+        try:
+            return int(src[len("journal-rank"):].split(".")[0])
+        except ValueError:
+            pass
+    return None
+
+
+def _collect(events):
+    """Per-rank stats from the merged event stream."""
+    ranks = {}
+    for rec in events:
+        r = _rank_of(rec)
+        if r is None:
+            continue
+        st = ranks.setdefault(r, {"events": 0, "steps": [], "first_ts": None,
+                                  "last_ts": None, "last_step": None,
+                                  "hb_step": None, "hb_ts": None})
+        st["events"] += 1
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            if st["first_ts"] is None:
+                st["first_ts"] = ts
+            st["last_ts"] = max(st["last_ts"] or ts, ts)
+        if rec.get("event") == "step" and isinstance(ts, (int, float)):
+            st["steps"].append(ts)
+        step = rec.get("step")
+        if isinstance(step, (int, float)):
+            st["last_step"] = max(st["last_step"] or 0, int(step))
+    for rec in events:
+        if rec.get("event") == "heartbeat_last":
+            st = ranks.get(rec.get("rank"))
+            if st is not None:
+                st["hb_step"] = rec.get("step")
+                st["hb_ts"] = rec.get("ts")
+                if isinstance(rec.get("step"), (int, float)):
+                    st["last_step"] = max(st["last_step"] or 0,
+                                          int(rec["step"]))
+    return ranks
+
+
+def _step_rate(steps):
+    """(overall, first-half, second-half) steps/sec, or None."""
+    if len(steps) < 2:
+        return None
+    span = steps[-1] - steps[0]
+    if span <= 0:
+        return None
+    overall = (len(steps) - 1) / span
+    mid = len(steps) // 2
+    halves = []
+    for part in (steps[:mid + 1], steps[mid:]):
+        d = part[-1] - part[0]
+        halves.append((len(part) - 1) / d if d > 0 and len(part) > 1
+                      else overall)
+    return overall, halves[0], halves[1]
+
+
+def _manifests(directory):
+    import glob
+    out = []
+    for path in sorted(glob.glob(
+            os.path.join(directory, "crash", "*", "MANIFEST.json"))):
+        try:
+            with open(path) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(man, dict):
+            man["_dir"] = os.path.dirname(path)
+            out.append(man)
+    return out
+
+
+def cmd_summary(agg, directory) -> int:
+    stats = {}
+    events = agg.load_events(directory, stats=stats)
+    if not events:
+        print("ptdoctor: no telemetry events under %s" % directory)
+        return 2
+    ts0 = next((e["ts"] for e in events
+                if isinstance(e.get("ts"), (int, float))), None)
+    ts1 = next((e["ts"] for e in reversed(events)
+                if isinstance(e.get("ts"), (int, float))), None)
+    span = (ts1 - ts0) if ts0 is not None and ts1 is not None else 0.0
+    restarts = sum(1 for e in events
+                   if e.get("event") in ("gang_restart", "worker_restart"))
+    hangs = sum(1 for e in events if e.get("event") == "worker_hang")
+    retraces = {}
+    for e in events:
+        if e.get("event") == "retrace":
+            eng = e.get("engine", "?")
+            retraces[eng] = retraces.get(eng, 0) + 1
+    ranks = _collect(events)
+
+    print("run: %s" % os.path.abspath(directory))
+    print("  events=%d  span=%.1fs  ranks=%s" %
+          (len(events), span, sorted(ranks) or "none"))
+    print("  restarts=%d  hangs=%d  torn_lines=%d" %
+          (restarts, hangs, stats.get("skipped", 0)))
+    if retraces:
+        print("  retraces: " + "  ".join(
+            "%s=%d" % kv for kv in sorted(retraces.items())))
+    stalest = None
+    for r in sorted(ranks):
+        st = ranks[r]
+        line = "  rank %s: events=%d" % (r, st["events"])
+        rate = _step_rate(st["steps"])
+        if rate:
+            line += "  step-rate=%.2f/s (%.2f -> %.2f)" % rate
+        if st["last_step"] is not None:
+            line += "  last-alive step=%d" % st["last_step"]
+        if st["last_ts"] is not None and ts1 is not None:
+            behind = ts1 - st["last_ts"]
+            line += "  last-seen %s (-%.1fs)" % (_fmt_ts(st["last_ts"]),
+                                                 behind)
+            if stalest is None or behind > stalest[1]:
+                stalest = (r, behind)
+        print(line)
+    if stalest is not None and len(ranks) > 1:
+        print("  stalest rank: %d (%.1fs behind run end)" % stalest)
+    for man in _manifests(directory):
+        line = "  crash bundle: rank=%s reason=%s" % (
+            man.get("rank"), man.get("reason"))
+        if man.get("last_step") is not None:
+            line += " last-alive step=%s" % man["last_step"]
+        if man.get("error"):
+            line += " error=%r" % man["error"]
+        print(line)
+        print("    %s (%d ring events)" %
+              (man["_dir"], man.get("ring_events", 0)))
+    return 0
+
+
+def cmd_timeline(agg, directory, last=None) -> int:
+    events = agg.load_events(directory)
+    if not events:
+        print("ptdoctor: no telemetry events under %s" % directory)
+        return 2
+    if last:
+        events = events[-last:]
+    for rec in events:
+        rank = rec.get("rank", _rank_of(rec))
+        extra = {k: v for k, v in rec.items()
+                 if k not in ("ts", "rank", "event", "src", "run_id",
+                              "host", "pid")}
+        print("%s  r%-2s %-20s %s" % (
+            _fmt_ts(rec.get("ts")),
+            "?" if rank is None else rank,
+            rec.get("event", "?"),
+            json.dumps(extra, default=str) if extra else ""))
+    return 0
+
+
+def cmd_crash(agg, directory) -> int:
+    mans = _manifests(directory)
+    if not mans:
+        print("ptdoctor: no crash bundles under %s" %
+              os.path.join(directory, "crash"))
+        return 0
+    for man in mans:
+        bdir = man.pop("_dir")
+        print("== %s" % bdir)
+        for k in ("reason", "rank", "pid", "host", "iso", "last_step",
+                  "error", "last_dispatch", "last_compile"):
+            if man.get(k) is not None:
+                print("  %-13s %s" % (k, man[k]))
+        ring = os.path.join(bdir, "ring.jsonl")
+        if os.path.exists(ring):
+            tail = agg.read_journal(ring)[-10:]
+            print("  last %d ring events:" % len(tail))
+            for rec in tail:
+                print("    %s %s" % (_fmt_ts(rec.get("ts")),
+                                     rec.get("event", "?")))
+        stacks = os.path.join(bdir, "stacks.txt")
+        if os.path.exists(stacks):
+            with open(stacks, errors="replace") as f:
+                head = f.read(2000)
+            print("  stacks.txt (head):")
+            for line in head.splitlines()[:20]:
+                print("    " + line)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ptdoctor", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("summary", "timeline", "crash"):
+        p = sub.add_parser(name)
+        p.add_argument("dir", help="telemetry directory (--log_dir / "
+                                   "telemetry_dir of the run)")
+        if name == "timeline":
+            p.add_argument("--last", type=int, default=None,
+                           help="only the last N events")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.dir):
+        print("ptdoctor: not a directory: %s" % args.dir, file=sys.stderr)
+        return 2
+    agg = _load_aggregate()
+    if args.cmd == "summary":
+        return cmd_summary(agg, args.dir)
+    if args.cmd == "timeline":
+        return cmd_timeline(agg, args.dir, last=args.last)
+    return cmd_crash(agg, args.dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
