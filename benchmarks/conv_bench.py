@@ -1,0 +1,107 @@
+"""Conv lowering micro-bench: per-layer algo sweep (auto/direct/im2col).
+
+Run:  python benchmarks/conv_bench.py [auto|direct|im2col|all]
+Prints one JSON line per (layer, algo):
+  {"layer": ..., "algo": ..., "ms": ..., "tflops": ..., "mfu": ...}
+
+The r3 ResNet verdict ("MFU 0.003 — a ~50x bug, not a tuning problem")
+needed a bench that isolates WHERE conv time goes: this times a single
+fwd+bwd conv per representative ResNet-50 layer shape, per lowering, so
+a conv-path regression (or an XLA relayout tax like the NCHW one 'auto'
+exists to dodge) shows up as a per-layer number instead of a dead
+bench-child. MFU here is per-conv (analytic 3x-forward train FLOPs over
+chip peak) — the layer-level ceiling the full-model number can't exceed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from train_bench import peak_flops  # noqa: E402  (same-dir import)
+
+# (name, Cin, HW, Cout, k, stride) — ResNet-50/224 representatives:
+# the 7x7 stem, an early wide-spatial 3x3, a 1x1 bottleneck projection,
+# and a late deep-channel 3x3. HW is scaled down for CPU smoke runs.
+_LAYERS = (
+    ("stem7x7", 3, 224, 64, 7, 2),
+    ("conv3x3_s56", 64, 56, 64, 3, 1),
+    ("proj1x1_s56", 256, 56, 64, 1, 1),
+    ("conv3x3_s14", 512, 14, 512, 3, 1),
+)
+
+_ALGOS = ("auto", "direct", "im2col")
+
+
+def bench_layer(name, cin, hw, cout, k, stride, algo, B, steps, warmup):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.nn_ops import conv
+
+    conv_fn = conv.fn  # raw jax-level body (the Primitive wrapper returns
+    #                    framework Tensors — this bench times pure XLA)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(B, cin, hw, hw), jnp.float32)
+    w = jnp.asarray(rs.randn(cout, cin, k, k), jnp.float32)
+    pad = k // 2
+
+    def train_conv(x, w):
+        # fwd + both grads: what a train step actually pays per conv
+        out = conv_fn(x, w, stride=(stride, stride), padding=(pad, pad),
+                      algo=algo)
+        return jnp.sum(out * out)
+
+    fn = jax.jit(jax.grad(train_conv, argnums=(0, 1)))
+    for _ in range(warmup):
+        gx, gw = fn(x, w)
+    jax.block_until_ready((gx, gw))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        gx, gw = fn(x, w)
+    jax.block_until_ready((gx, gw))
+    dt = (time.perf_counter() - t0) / steps
+
+    hout = (hw + 2 * pad - k) // stride + 1
+    fwd_flops = 2.0 * B * cout * hout * hout * cin * k * k
+    flops = 3.0 * fwd_flops  # train ≈ 3x forward (dx + dw passes)
+    pk = peak_flops()
+    return {"layer": name, "algo": algo, "batch": B,
+            "in": [cin, hw, hw], "out": [cout, hout, hout], "k": k,
+            "stride": stride,
+            "ms": round(dt * 1e3, 3),
+            "tflops": round(flops / dt / 1e12, 3),
+            "mfu": round(flops / dt / pk, 4) if pk else None}
+
+
+def main():
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    algos = _ALGOS if which == "all" else (which,)
+    if on_tpu:
+        B, steps, warmup, scale = 32, 20, 3, 1
+    else:  # smoke: tiny spatial dims, the same code paths
+        B, steps, warmup, scale = 2, 2, 1, 7
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device_kind": jax.devices()[0].device_kind,
+                      "batch": B}), flush=True)
+    for name, cin, hw, cout, k, stride in _LAYERS:
+        hw = max(k, hw // scale)
+        for algo in algos:
+            try:
+                print(json.dumps(bench_layer(name, cin, hw, cout, k,
+                                             stride, algo, B, steps,
+                                             warmup)), flush=True)
+            except Exception as e:
+                print(json.dumps({"layer": name, "algo": algo,
+                                  "error": f"{type(e).__name__}: {e}"}),
+                      flush=True)
+
+
+if __name__ == "__main__":
+    main()

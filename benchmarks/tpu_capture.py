@@ -202,6 +202,8 @@ def capture(suite_timeout_s: float = 1800.0) -> str | None:
         "platform": "tpu",
         "device_kind": backend.get("device_kind"),
         "pallas_healthy": backend.get("pallas_healthy"),
+        "pallas_prng_healthy": backend.get("pallas_prng_healthy"),
+        "pallas_health_reasons": backend.get("pallas_health_reasons"),
         "results": benches,
         "error": err,
     }

@@ -82,13 +82,17 @@ def _gpt_train_bench(net, B, T, steps, warmup, on_tpu, config, next_batch):
     ihist = tracing.STEP_INTERVAL.labels("jit_train")
     comp0 = comp.value
 
-    from paddle_tpu.ops.pallas_kernels import attention_path_counts
-    attention_path_counts(reset=True)
+    # attn paths from the metrics registry (pt_attn_path_total deltas) —
+    # the same series ptdoctor summary reads, so a BENCH row and a
+    # post-mortem can never disagree about which attention impl traced
+    from paddle_tpu.ops.pallas_kernels import attention_path_totals
+    attn0 = attention_path_totals()
     for _ in range(warmup):
         loss, _ = step(*next_batch())
     float(loss.numpy())
     compile_s = comp.value - comp0
-    attn_paths = attention_path_counts()
+    attn_paths = {k: v - attn0.get(k, 0)
+                  for k, v in attention_path_totals().items()}
     sum0, count0 = ihist.sum, ihist.count
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -234,14 +238,15 @@ def bench_ernie(on_tpu):
     args = ([paddle.to_tensor(ids)],
             [paddle.to_tensor(labels), paddle.to_tensor(nsp)])
 
-    from paddle_tpu.ops.pallas_kernels import attention_path_counts
+    from paddle_tpu.ops.pallas_kernels import attention_path_totals
     import paddle_tpu.amp as amp
-    attention_path_counts(reset=True)
+    attn0 = attention_path_totals()
     with amp.auto_cast(level="O2"):
         for _ in range(warmup):
             loss, _ = step(*args)
         float(loss.numpy())
-        attn_paths = attention_path_counts()
+        attn_paths = {k: v - attn0.get(k, 0)
+                      for k, v in attention_path_totals().items()}
         t0 = time.perf_counter()
         for _ in range(steps):
             loss, _ = step(*args)
@@ -263,12 +268,14 @@ def bench_ernie(on_tpu):
             "mfu": _mfu(flops, dt)}
 
 
-def bench_resnet50(on_tpu, conv_algo="direct"):
+def bench_resnet50(on_tpu, conv_algo="auto"):
     """ResNet-50 static-graph Executor training (BASELINE config 2).
 
-    conv_algo: 'direct' or 'im2col' (FLAGS_conv_algo) — the r4 comparison
-    settling whether the environment's conv lowering is the ResNet
-    bottleneck (VERDICT item 5)."""
+    conv_algo: 'auto', 'direct' or 'im2col' (FLAGS_conv_algo) — the r4
+    comparison settling whether the environment's conv lowering is the
+    ResNet bottleneck (VERDICT item 5; answer: the NCHW dimension numbers
+    were, hence 'auto' = NHWC-internal on TPU. benchmarks/conv_bench.py
+    holds the per-layer sweep)."""
     import paddle_tpu as paddle
     from paddle_tpu import static
     from paddle_tpu.framework.flags import get_flags, set_flags
@@ -347,18 +354,22 @@ def main():
     # tunnel environments serve XLA but 500 every Mosaic remote-compile,
     # and the framework then degrades to its XLA attention/optimizer paths
     pallas_healthy = pallas_prng = None
+    reasons = {}
     if on_tpu:
-        from paddle_tpu.ops.pallas_kernels import (pallas_prng_healthy,
+        from paddle_tpu.ops.pallas_kernels import (pallas_health_reasons,
+                                                   pallas_prng_healthy,
                                                    pallas_tpu_healthy)
         pallas_healthy = pallas_tpu_healthy()
         pallas_prng = pallas_prng_healthy()
+        reasons = pallas_health_reasons()
     # flush: a capture child killed on timeout must still yield this line
     # to the parent's stdout salvage, or the whole run is misread as
     # "no TPU backend"
     print(json.dumps({"backend": jax.default_backend(),
                       "device_kind": jax.devices()[0].device_kind,
                       "pallas_healthy": pallas_healthy,
-                      "pallas_prng_healthy": pallas_prng}), flush=True)
+                      "pallas_prng_healthy": pallas_prng,
+                      "pallas_health_reasons": reasons or None}), flush=True)
     benches = {name: globals()["bench_" + name] for name in BENCH_CONFIGS}
     for name, fn in benches.items():
         if which not in ("all", name):
@@ -370,10 +381,10 @@ def main():
                 # the missing path (the first capture banked only `direct`
                 # before its child's time share ran out)
                 algos = os.environ.get("PADDLE_TPU_RESNET_ALGOS",
-                                       "direct,im2col")
+                                       "auto,direct,im2col")
                 for algo in [a.strip() for a in algos.split(",")
                              if a.strip()]:
-                    if algo not in ("direct", "im2col"):
+                    if algo not in ("auto", "direct", "im2col"):
                         # a typo'd algo would silently run the direct
                         # lowering but label the row with the bogus name,
                         # corrupting the conv-path comparison

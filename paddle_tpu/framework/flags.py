@@ -68,11 +68,14 @@ define_flag("allocator_strategy", "xla",
             "accepted for parity; XLA/PJRT owns device memory")
 define_flag("tpu_matmul_precision", "default",
             "jax matmul precision: default|high|highest")
-define_flag("conv_algo", "direct",
-            "convolution lowering: 'direct' (lax.conv -> XLA conv) or "
-            "'im2col' (patches + one MXU matmul; groups=1 only). The "
-            "im2col path exists to bench/bypass environments whose conv "
-            "lowering underperforms (BASELINE.md ResNet-50 investigation)")
+define_flag("conv_algo", "auto",
+            "convolution lowering: 'auto' (on TPU, 4-D NCHW convs run "
+            "through an NHWC-internal layout — XLA-TPU's native conv "
+            "layout, avoiding the per-layer relayouts the NCHW dimension "
+            "numbers force; elsewhere identical to direct), 'direct' "
+            "(lax.conv with the model's own layout) or 'im2col' (patches "
+            "+ one MXU matmul; groups=1 only). benchmarks/conv_bench.py "
+            "compares the three (BASELINE.md ResNet-50 investigation)")
 define_flag("flash_dropout_interpret", False,
             "allow the dropout-enabled flash kernel in interpret mode "
             "(CPU kernel tests only — the emulator is too slow for train "
@@ -87,6 +90,12 @@ define_flag("sdpa_chunked_threshold", 2048,
 define_flag("use_flash_attention", True,
             "route F.scaled_dot_product_attention to the Pallas flash "
             "kernel when shapes/backend allow")
+define_flag("flash_autotune_blocks", True,
+            "one-shot timed sweep of flash-attention (block_q, block_k) "
+            "over {128,256,512} per attention shape on TPU; the choice is "
+            "cached in-process and persisted to "
+            "<PADDLE_TPU_TELEMETRY_DIR>/flash_autotune.json. False pins "
+            "the 128x128 defaults")
 define_flag("use_fused_optimizer", True,
             "route Adam/AdamW updates to the Pallas fused kernel on TPU "
             "(single HBM pass, in-place via buffer aliasing)")

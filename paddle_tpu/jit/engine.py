@@ -405,6 +405,8 @@ def train_jaxpr(network, inputs):
 
 def make_eval_step(network, loss_fn=None, mesh=None):
     """Compile forward (+loss) for evaluation."""
+    from ..ops.pallas_kernels import preprobe_pallas_health
+    preprobe_pallas_health(needs_prng=False)
     if mesh is None:
         mesh = getattr(network, "_pt_mesh", None)
     params, frozen, buffers, _ = _collect_train_state(network, None)

@@ -234,6 +234,38 @@ def _conv_im2col(x, w, stride, pad, dilation, channel_last):
     return out if x.dtype == jnp.bfloat16 else out.astype(x.dtype)
 
 
+def _note_conv_path(algo):
+    """Trace-time conv lowering counter (pt_conv_path_total{algo=}) —
+    like attention's _note_attn_path, so BENCH artifacts and ptdoctor can
+    show which lowering a run actually compiled, not just the flag."""
+    try:
+        from ..observability import metrics
+        metrics.counter("pt_conv_path_total",
+                        "conv lowerings traced, by algorithm",
+                        labelnames=("algo",)).labels(algo).inc()
+    except Exception:
+        pass
+
+
+def _conv_nhwc(x, w, stride, pad, dilation, groups):
+    """4-D NCHW conv computed internally in NHWC/HWIO — XLA-TPU's native
+    conv layout. The model keeps its NCHW activations; the explicit
+    transposes bracket the conv so consecutive conv layers' NHWC→NCHW →
+    NCHW→NHWC pairs cancel in XLA's algebraic simplifier, where the NCHW
+    dimension-numbers form forced the TPU backend into a per-layer
+    relayout of every activation AND filter (the r3 resnet50 "MFU 0.003"
+    — a ~50x layout tax, not a conv-speed problem)."""
+    xt = jnp.transpose(x, (0, 2, 3, 1))            # NCHW -> NHWC
+    wt = jnp.transpose(w, (2, 3, 1, 0))            # OIHW -> HWIO
+    dn = lax.conv_dimension_numbers(xt.shape, wt.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    out = lax.conv_general_dilated(
+        xt, wt, window_strides=tuple(stride), padding=pad,
+        rhs_dilation=tuple(dilation), dimension_numbers=dn,
+        feature_group_count=groups)
+    return jnp.transpose(out, (0, 3, 1, 2))        # NHWC -> NCHW
+
+
 @primitive("conv2d_op")
 def conv(x, w, *, stride=(1, 1), padding=(0, 0), dilation=(1, 1), groups=1,
          channel_last=False, algo="direct"):
@@ -243,8 +275,19 @@ def conv(x, w, *, stride=(1, 1), padding=(0, 0), dilation=(1, 1), groups=1,
         pad = padding  # 'SAME' / 'VALID'
     else:
         pad = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    if algo == "auto":
+        # NHWC-internal only where the layout tax exists: TPU, 4-D, model
+        # in NCHW. Everywhere else (CPU tier-1, 3-D/5-D, channel_last
+        # models already in the native layout) auto == direct.
+        algo = ("nhwc" if nd == 4 and not channel_last
+                and jax.default_backend() == "tpu" else "direct")
+    _note_conv_path(algo)
     if algo == "im2col" and groups == 1:
         return _conv_im2col(x, w, stride, pad, dilation, channel_last)
+    if algo == "nhwc":
+        out = _conv_nhwc(x, w, stride, pad, dilation, groups)
+        # same dtype contract as the direct path below
+        return out.astype(jnp.float32) if x.dtype == jnp.bfloat16 else out
     dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
     out = lax.conv_general_dilated(
         x, w, window_strides=tuple(stride), padding=pad,
@@ -827,7 +870,7 @@ def sdpa(q, k, v, mask, key, *, dropout_p=0.0, causal=False,
     if chunked is None:
         thr = flag("sdpa_chunked_threshold")
         chunked = bool(thr and k.shape[-2] >= thr)
-    from .pallas_kernels import _ATTN_PATHS
+    from .pallas_kernels import _note_attn_path
     if (chunked and mask is None
             and not return_weights
             # dropout rides the blockwise path (per-block fold_in masks,
@@ -839,13 +882,13 @@ def sdpa(q, k, v, mask, key, *, dropout_p=0.0, causal=False,
             # pinned at the END for Tq<Tk) stays on the dense path
             and (not causal or q.shape[-2] == k.shape[-2])):
         from .ring_attention import _blockwise_attention
-        _ATTN_PATHS["xla_chunked"] += 1
+        _note_attn_path("xla_chunked")
         return _blockwise_attention(q, k, v, causal=bool(causal),
                                     scale=float(d) ** -0.5,
                                     checkpoint_blocks=True,
                                     dropout_p=float(dropout_p),
                                     dropout_key=key)
-    _ATTN_PATHS["xla_sdpa"] += 1
+    _note_attn_path("xla_sdpa")
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (float(d) ** -0.5)
     if causal:
         Tq, Tk = s.shape[-2], s.shape[-1]

@@ -58,6 +58,60 @@ EOF
     fi
 fi
 
+# Flash-attention smoke (docs/PERFORMANCE.md): a 2-step GPT-2-tiny fit
+# with interpret-mode flash dropout enabled must trace the Pallas path
+# (attn_paths.flash_dropout > 0, nothing on xla_sdpa), keep grads/loss
+# finite, and route eval forwards onto the dropout-free flash kernel.
+if [ "$rc" -eq 0 ]; then
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.models import GPTPretrainingCriterion, gpt_tiny
+from paddle_tpu.ops.pallas_kernels import attention_path_counts
+
+paddle.seed(0)
+set_flags({"FLAGS_flash_dropout_interpret": True})
+m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+             intermediate_size=64, max_position_embeddings=32,
+             attn_dropout_prob=0.1, hidden_dropout_prob=0.0)
+crit = GPTPretrainingCriterion()
+opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+ids = np.random.RandomState(0).randint(0, 64, (2, 17)).astype(np.int64)
+x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+
+attention_path_counts(reset=True)
+losses = []
+for _ in range(2):
+    loss = crit(m(x), y)
+    loss.backward()
+    g = m.gpt.embeddings.word_embeddings.weight.grad
+    assert g is not None and np.isfinite(g.numpy()).all()
+    opt.step()
+    opt.clear_grad()
+    losses.append(float(loss.numpy()))
+counts = attention_path_counts()
+assert counts.get("flash_dropout", 0) > 0, counts
+assert counts.get("xla_sdpa", 0) == 0, counts
+assert all(np.isfinite(l) for l in losses), losses
+
+m.eval()
+attention_path_counts(reset=True)
+m(x)
+ev = attention_path_counts()
+assert ev.get("flash", 0) > 0 and ev.get("flash_dropout", 0) == 0, ev
+print("FLASH_SMOKE=ok (2-step fit: train=%d flash_dropout traces, "
+      "eval=%d flash traces, losses=%s)"
+      % (counts["flash_dropout"], ev["flash"],
+         ["%.3f" % l for l in losses]))
+EOF
+    smoke_rc=$?
+    if [ "$smoke_rc" -ne 0 ]; then
+        echo "FLASH_SMOKE=FAILED (rc=$smoke_rc)"
+        rc=$smoke_rc
+    fi
+fi
+
 # Checkpoint smoke (docs/CHECKPOINT.md): save two epochs, corrupt a blob
 # of the newest, and resume — the loader must quarantine the corrupt dir
 # and fall back to the last-good checkpoint without raising.

@@ -122,6 +122,30 @@ def _manifests(directory):
     return out
 
 
+def _counter_by_label(agg, directory, name, label):
+    """Sum a labelled counter across every metrics*.json snapshot in the
+    run dir (rollup excluded): {label_value: total}. Counters are
+    per-process cumulative, so summing across rank snapshots gives the
+    run-wide total."""
+    totals = {}
+    for path in agg._snapshot_files(directory):
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        meta = (snap.get("metrics") or {}).get(name) \
+            if isinstance(snap, dict) else None
+        if not isinstance(meta, dict):
+            continue
+        for s in meta.get("series", []):
+            key = (s.get("labels") or {}).get(label)
+            if key is None or not isinstance(s.get("value"), (int, float)):
+                continue
+            totals[key] = totals.get(key, 0) + s["value"]
+    return totals
+
+
 def cmd_summary(agg, directory) -> int:
     stats = {}
     events = agg.load_events(directory, stats=stats)
@@ -151,6 +175,33 @@ def cmd_summary(agg, directory) -> int:
     if retraces:
         print("  retraces: " + "  ".join(
             "%s=%d" % kv for kv in sorted(retraces.items())))
+    # attention / conv lowering mix — "is the fast path actually on?" from
+    # the same counters bench.py reports (pt_attn_path_total etc.)
+    attn = _counter_by_label(agg, directory, "pt_attn_path_total", "path")
+    if attn:
+        print("  attn paths: " + "  ".join(
+            "%s=%d" % (k, int(v)) for k, v in sorted(attn.items())))
+    convp = _counter_by_label(agg, directory, "pt_conv_path_total", "algo")
+    if convp:
+        print("  conv paths: " + "  ".join(
+            "%s=%d" % (k, int(v)) for k, v in sorted(convp.items())))
+    # Pallas health: probe-failure counter + the per-tier reason strings
+    # captured in pallas_probe_failed / pallas_health events
+    probe_fail = _counter_by_label(agg, directory,
+                                   "pt_pallas_probe_failures_total", "tier")
+    reasons = {}
+    for e in events:
+        if e.get("event") == "pallas_probe_failed" and e.get("tier"):
+            reasons[e["tier"]] = e.get("reason", "?")
+        elif e.get("event") == "pallas_health":
+            for tier, reason in (e.get("reasons") or {}).items():
+                reasons.setdefault(tier, reason)
+    if probe_fail or reasons:
+        print("  pallas probe failures: " + ("  ".join(
+            "%s=%d" % (k, int(v)) for k, v in sorted(probe_fail.items()))
+            or "(reasons only)"))
+        for tier in sorted(reasons):
+            print("    %s: %s" % (tier, reasons[tier]))
     stalest = None
     for r in sorted(ranks):
         st = ranks[r]
