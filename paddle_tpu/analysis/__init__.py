@@ -1,0 +1,41 @@
+"""Static analysis: jit-hazard and sharding-consistency lint.
+
+Two halves (docs/STATIC_ANALYSIS.md):
+
+  * source_pass — pure-stdlib AST lint over paddle_tpu/ source. Flags
+    the hazard classes this repo has already shipped as bugs: host
+    syncs under jit, tracer leakage into persistent state, unstable
+    jit cache keys, x64 config wraps around pallas_call.
+  * jaxpr_pass — imports jax; walks a traced train step's ClosedJaxpr
+    and lowering metadata for compiler-visible performance hazards:
+    missing buffer donation, step-boundary sharding mismatches, silent
+    bf16 upcasts, uncancelled transpose pairs.
+
+`findings` is the shared record/baseline/emission layer. The CLI is
+tools/ptlint.py; tools/precommit_gate.sh gates on unsuppressed
+findings.
+"""
+from .findings import (Finding, apply_baseline, assign_indices,
+                       baseline_entries, emit_findings, findings_to_json,
+                       load_baseline, write_baseline)
+from .source_pass import RULES as SOURCE_RULES, lint_file, lint_paths, \
+    lint_source
+
+__all__ = [
+    "Finding", "SOURCE_RULES", "JAXPR_RULES",
+    "lint_source", "lint_file", "lint_paths",
+    "analyze_fn", "analyze_train_step",
+    "assign_indices", "load_baseline", "apply_baseline",
+    "baseline_entries", "write_baseline", "findings_to_json",
+    "emit_findings",
+]
+
+
+def __getattr__(name):
+    # jaxpr_pass imports jax; keep the package importable (and the
+    # source pass usable) on boxes without it
+    if name in ("JAXPR_RULES", "analyze_fn", "analyze_train_step",
+                "train_step_layout"):
+        from . import jaxpr_pass
+        return getattr(jaxpr_pass, name)
+    raise AttributeError(name)

@@ -1,0 +1,216 @@
+"""Lint findings: the shared record type of both analysis halves, plus
+the suppression baseline and the observability emission path.
+
+A `Finding` is one detected hazard — a (rule, path, symbol, snippet)
+anchor with a human message. Its `fingerprint` deliberately excludes the
+line number: a finding keeps its identity when unrelated edits shift the
+file, so the checked-in baseline (tools/ptlint_baseline.json) only goes
+stale when the flagged code itself is touched. Identical snippets inside
+one symbol are disambiguated by an occurrence index.
+
+The baseline is the debt ledger: every suppression carries a `reason`,
+CI (tools/precommit_gate.sh) fails on any finding NOT in it, and entries
+whose code has been fixed are reported as STALE so the ledger can only
+shrink deliberately (docs/STATIC_ANALYSIS.md "Suppression workflow").
+
+Pure stdlib by contract (same rule as observability/journal.py): the
+ptlint source pass must run on a box with no jax installed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Finding", "SEVERITIES", "assign_indices", "load_baseline",
+    "apply_baseline", "baseline_entries", "write_baseline",
+    "emit_findings", "findings_to_json",
+]
+
+SEVERITIES = ("error", "warning")
+
+BASELINE_VERSION = 1
+
+
+@dataclasses.dataclass
+class Finding:
+    """One detected hazard.
+
+    path is repo-relative for source findings; jaxpr findings use a
+    pseudo-path like "<train_step:gpt-tiny>" (there is no file — the
+    anchor is the traced program).
+    """
+    rule: str
+    severity: str
+    path: str
+    line: int
+    message: str
+    symbol: str = ""
+    snippet: str = ""
+    index: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        raw = "|".join((self.rule, self.path, self.symbol,
+                        self.snippet, str(self.index)))
+        return hashlib.sha1(raw.encode("utf-8", "replace")).hexdigest()[:16]
+
+    def format(self) -> str:
+        loc = "%s:%d" % (self.path, self.line) if self.line else self.path
+        sym = " (%s)" % self.symbol if self.symbol else ""
+        return "%s: %s: [%s] %s%s" % (loc, self.severity, self.rule,
+                                      self.message, sym)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "snippet": self.snippet, "index": self.index,
+                "fingerprint": self.fingerprint}
+
+
+def assign_indices(findings: List[Finding]) -> List[Finding]:
+    """Disambiguate findings that share (rule, path, symbol, snippet):
+    number them in line order so each gets a distinct fingerprint.
+    Fixing the first of three identical hazards shifts the survivors'
+    indices — acceptable: touching one of an identical group is exactly
+    the moment to re-baseline the rest."""
+    groups: Dict[Tuple[str, str, str, str], List[Finding]] = {}
+    for f in findings:
+        groups.setdefault((f.rule, f.path, f.symbol, f.snippet),
+                          []).append(f)
+    for group in groups.values():
+        group.sort(key=lambda f: (f.line, f.message))
+        for i, f in enumerate(group):
+            f.index = i
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.index))
+    return findings
+
+
+def load_baseline(path: Optional[str]) -> Dict[str, dict]:
+    """fingerprint -> suppression entry; {} when the file is absent (a
+    missing baseline suppresses nothing)."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out = {}
+    for entry in data.get("suppressions", []):
+        fp = entry.get("fingerprint")
+        if isinstance(fp, str):
+            out[fp] = entry
+    return out
+
+
+def apply_baseline(findings: List[Finding], baseline: Dict[str, dict]
+                   ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Split into (unsuppressed, suppressed, stale_baseline_entries).
+    Stale = a suppression whose finding no longer exists: the debt was
+    paid (or the code moved) and the ledger entry must be removed."""
+    seen = set()
+    unsuppressed, suppressed = [], []
+    for f in findings:
+        fp = f.fingerprint
+        if fp in baseline:
+            seen.add(fp)
+            suppressed.append(f)
+        else:
+            unsuppressed.append(f)
+    stale = [entry for fp, entry in baseline.items() if fp not in seen]
+    stale.sort(key=lambda e: (e.get("path", ""), e.get("rule", ""),
+                              e.get("fingerprint", "")))
+    return unsuppressed, suppressed, stale
+
+
+def baseline_entries(findings: Iterable[Finding],
+                     previous: Optional[Dict[str, dict]] = None
+                     ) -> List[dict]:
+    """Suppression entries for `findings`, preserving the hand-written
+    `reason` of any entry that already existed."""
+    previous = previous or {}
+    entries = []
+    for f in findings:
+        fp = f.fingerprint
+        entries.append({
+            "fingerprint": fp, "rule": f.rule, "path": f.path,
+            "symbol": f.symbol, "snippet": f.snippet, "index": f.index,
+            "reason": previous.get(fp, {}).get(
+                "reason", "TODO: justify or fix"),
+        })
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["snippet"],
+                                e["index"]))
+    return entries
+
+
+def write_baseline(path: str, entries: List[dict]) -> str:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": BASELINE_VERSION, "tool": "ptlint",
+                   "suppressions": entries}, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def findings_to_json(unsuppressed: List[Finding],
+                     suppressed: List[Finding],
+                     stale: List[dict]) -> str:
+    """Machine-stable report: fixed key order, findings sorted by
+    (path, line, rule, index), no timestamps — two runs over the same
+    tree produce byte-identical output."""
+    doc = {
+        "version": 1,
+        "tool": "ptlint",
+        "summary": {"unsuppressed": len(unsuppressed),
+                    "suppressed": len(suppressed),
+                    "stale_baseline_entries": len(stale)},
+        "findings": [f.to_dict() for f in unsuppressed],
+        "suppressed": [f.to_dict() for f in suppressed],
+        "stale": stale,
+    }
+    return json.dumps(doc, indent=1, sort_keys=False) + "\n"
+
+
+def emit_findings(findings: Iterable[Finding],
+                  stale: Iterable[dict] = ()) -> int:
+    """Surface findings on the observability plane: one `lint_finding`
+    journal event per finding plus the
+    pt_lint_findings_total{rule,severity} counter (and
+    pt_lint_stale_suppressions_total for paid-off debt still in the
+    baseline) — so ptdoctor's lint section and dashboards see the same
+    facts the CLI prints. Import-guarded: emission is best-effort and a
+    missing registry must not fail the lint."""
+    n = 0
+    try:
+        from ..observability import journal as _journal
+        from ..observability import metrics as _metrics
+    except Exception:
+        return 0
+    for f in findings:
+        _journal.emit("lint_finding", rule=f.rule, severity=f.severity,
+                      path=f.path, line=f.line, symbol=f.symbol,
+                      message=f.message, fingerprint=f.fingerprint)
+        try:
+            _metrics.counter(
+                "pt_lint_findings_total",
+                "Static-analysis findings by rule and severity",
+                ("rule", "severity"),
+            ).labels(rule=f.rule, severity=f.severity).inc()
+        except Exception:
+            pass
+        n += 1
+    for entry in stale:
+        _journal.emit("lint_stale_suppression",
+                      rule=entry.get("rule"), path=entry.get("path"),
+                      fingerprint=entry.get("fingerprint"))
+        try:
+            _metrics.counter(
+                "pt_lint_stale_suppressions_total",
+                "Baseline suppressions whose finding no longer exists",
+            ).inc()
+        except Exception:
+            pass
+    return n

@@ -1,0 +1,398 @@
+"""Jaxpr/trace pass: compiler-visible performance hazards of a traced
+step (DeepCompile's thesis, applied: these properties are all statically
+decidable from the jaxpr + lowering metadata, no bench run needed).
+
+Rules, each anchored to a bug this repo has already paid for:
+
+  non-donated-buffer          a large state input (params / optimizer
+                              state / buffers) replaced by a matching
+                              output but NOT donated — XLA must keep
+                              both copies live, double-buffering the
+                              training state in HBM (the r3 MFU
+                              suspect; fixed by donate_argnums in
+                              jit/engine.py, verified here).
+  sharding-boundary-mismatch  the out-sharding of step N's state differs
+                              from the in-sharding step N+1 expects for
+                              the same buffer — GSPMD inserts a full
+                              resharding (or rematerialization) between
+                              every step (the MULTICHIP_r03 involuntary
+                              full-remat trigger).
+  bf16-upcast                 convert_element_type bf16->f32 on a large
+                              operand: a silent 2x widening of a hot
+                              buffer.
+  transpose-pair              dataflow-adjacent inverse transpose pairs
+                              (and per-conv relayout sandwiches): the
+                              NCHW<->NHWC per-layer relayout tax behind
+                              ResNet's 0.003 MFU in r3.
+
+Entry points: `analyze_fn` for any function + args, and
+`analyze_train_step` for the handle `jit/engine.py:make_train_step`
+attaches to its compiled step (`call.analysis_handle`), which knows the
+flat-index layout of the train state so donation and step-boundary
+sharding can be checked group by group.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+__all__ = [
+    "JAXPR_RULES", "analyze_fn", "analyze_train_step",
+    "donation_findings", "sharding_findings", "upcast_findings",
+    "transpose_findings", "train_step_layout",
+]
+
+#: rule -> (severity, one-line description)
+JAXPR_RULES = {
+    "non-donated-buffer": (
+        "error",
+        "large state input replaced by a matching output but not "
+        "donated (double-buffers HBM)"),
+    "sharding-boundary-mismatch": (
+        "error",
+        "state out-sharding of step N differs from the in-sharding of "
+        "step N+1 (forces per-step resharding/remat)"),
+    "bf16-upcast": (
+        "warning",
+        "silent bf16->f32 convert_element_type on a large operand"),
+    "transpose-pair": (
+        "warning",
+        "inverse transpose pair / per-conv relayout sandwich in the "
+        "traced program"),
+}
+
+
+def _walk_jaxprs(jaxpr):
+    """Yield `jaxpr` and every jaxpr nested in its equations (pjit
+    bodies, scan/while/cond branches, custom_* calls) — duck-typed so it
+    tracks jax's internal class moves."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for val in eqn.params.values():
+            vals = val if isinstance(val, (list, tuple)) else (val,)
+            for v in vals:
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    yield from _walk_jaxprs(inner)
+                elif hasattr(v, "eqns"):
+                    yield from _walk_jaxprs(v)
+
+
+def _nbytes(shape, dtype) -> int:
+    try:
+        item = dtype.itemsize
+    except AttributeError:
+        import numpy as np
+        item = np.dtype(dtype).itemsize
+    return int(math.prod(shape)) * item if shape else item
+
+
+def _finding(rule: str, label: str, message: str, snippet: str = "",
+             symbol: str = "") -> Finding:
+    return Finding(rule=rule, severity=JAXPR_RULES[rule][0], path=label,
+                   line=0, message=message, symbol=symbol,
+                   snippet=snippet)
+
+
+# -- donation --------------------------------------------------------------
+
+def donation_findings(lowered, label: str, *, big_bytes: int = 1 << 20,
+                      expect_donated: Optional[Dict[int, str]] = None
+                      ) -> List[Finding]:
+    """Non-donated double-buffer candidates from lowering metadata.
+
+    `expect_donated` maps flat input index -> human name for inputs the
+    caller KNOWS are replaced-by-output state (train-step params/accs/
+    buffers): those are flagged whenever not donated, regardless of
+    size. Without it, the heuristic flags any non-donated input of at
+    least `big_bytes` whose (shape, dtype) also appears among the
+    outputs — the signature of a state buffer updated out-of-place."""
+    import jax
+
+    args = jax.tree_util.tree_leaves(lowered.args_info)
+    outs = jax.tree_util.tree_leaves(lowered.out_info)
+    out_sigs: Dict[Tuple[tuple, str], int] = {}
+    for o in outs:
+        key = (tuple(o.shape), str(o.dtype))
+        out_sigs[key] = out_sigs.get(key, 0) + 1
+    # donated inputs claim their matching output slot first, so a
+    # non-donated input (e.g. a gradient the same shape as a param) is
+    # not blamed for an output the donation already absorbs
+    for a in args:
+        if a.donated:
+            key = (tuple(a.shape), str(a.dtype))
+            if out_sigs.get(key):
+                out_sigs[key] -= 1
+
+    findings: List[Finding] = []
+    for i, a in enumerate(args):
+        if a.donated:
+            continue
+        shape, dtype = tuple(a.shape), str(a.dtype)
+        nbytes = _nbytes(shape, a.dtype)
+        if expect_donated is not None and i in expect_donated:
+            findings.append(_finding(
+                "non-donated-buffer", label,
+                "state input #%d (%s, %s%s, %d bytes) is replaced by an "
+                "output every step but not donated — params/opt-state "
+                "double-buffer in HBM" % (i, expect_donated[i], dtype,
+                                          list(shape), nbytes),
+                snippet="%s:%s%s" % (expect_donated[i], dtype,
+                                     list(shape))))
+        elif expect_donated is None and nbytes >= big_bytes and \
+                out_sigs.get((shape, dtype)):
+            out_sigs[(shape, dtype)] -= 1
+            findings.append(_finding(
+                "non-donated-buffer", label,
+                "input #%d (%s%s, %d bytes) matches an output aval but "
+                "is not donated — likely out-of-place state update "
+                "double-buffering HBM" % (i, dtype, list(shape),
+                                          nbytes),
+                snippet="arg%d:%s%s" % (i, dtype, list(shape))))
+    return findings
+
+
+# -- step-boundary shardings ----------------------------------------------
+
+def sharding_findings(compiled, label: str,
+                      state_pairs: Sequence[Tuple[int, int, str]],
+                      ndims: Sequence[int]) -> List[Finding]:
+    """Compare the compiled step's output shardings against its own
+    input shardings for each (in_idx, out_idx, name) state pair: the
+    output of step N IS the input of step N+1, so any inequivalence
+    here is a guaranteed per-step reshard (the MULTICHIP_r03 remat)."""
+    import jax
+    # input_shardings[0] mirrors the top-level arg tree (list args stay
+    # lists); flatten both sides to leaf order — that is what the flat
+    # state-pair indices address
+    ins = jax.tree_util.tree_leaves(compiled.input_shardings[0])
+    outs = jax.tree_util.tree_leaves(compiled.output_shardings)
+    findings: List[Finding] = []
+    for in_idx, out_idx, name in state_pairs:
+        try:
+            si, so = ins[in_idx], outs[out_idx]
+        except IndexError:
+            continue
+        try:
+            ok = so.is_equivalent_to(si, ndims[in_idx])
+        except (TypeError, ValueError, AttributeError):
+            ok = repr(so) == repr(si)
+        if not ok:
+            findings.append(_finding(
+                "sharding-boundary-mismatch", label,
+                "%s: step-N out-sharding %s != step-N+1 in-sharding %s "
+                "— every step pays a reshard (involuntary remat under "
+                "memory pressure)" % (name, _sh(so), _sh(si)),
+                snippet=name))
+    return findings
+
+
+def _sh(s) -> str:
+    spec = getattr(s, "spec", None)
+    return str(spec) if spec is not None else type(s).__name__
+
+
+# -- jaxpr walks -----------------------------------------------------------
+
+def upcast_findings(closed_jaxpr, label: str, *,
+                    min_elems: int = 1 << 16) -> List[Finding]:
+    """Silent bf16->f32 widenings of large operands."""
+    hits: Dict[tuple, int] = {}
+    for jaxpr in _walk_jaxprs(closed_jaxpr.jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            aval = getattr(eqn.invars[0], "aval", None)
+            if aval is None:
+                continue
+            new = eqn.params.get("new_dtype")
+            if str(aval.dtype) == "bfloat16" and str(new) == "float32" \
+                    and int(math.prod(aval.shape or (1,))) >= min_elems:
+                key = tuple(aval.shape)
+                hits[key] = hits.get(key, 0) + 1
+    return [
+        _finding("bf16-upcast", label,
+                 "bf16->f32 upcast of a %s operand x%d on the traced "
+                 "hot path — 2x HBM traffic for the widened copy"
+                 % (list(shape), count),
+                 snippet="bf16->f32:%s" % (list(shape),))
+        for shape, count in sorted(hits.items())
+    ]
+
+
+def _compose_is_identity(p, q) -> bool:
+    """True when transpose(q) applied after transpose(p) is a no-op."""
+    return all(p[q[i]] == i for i in range(len(q)))
+
+
+def transpose_findings(closed_jaxpr, label: str) -> List[Finding]:
+    """Inverse transpose pairs the compiler may or may not cancel, and
+    the per-conv relayout sandwich (transpose -> conv -> inverse
+    transpose repeated per layer — the r3 NCHW tax)."""
+    pairs = 0
+    sandwiches = 0
+    example = ""
+    for jaxpr in _walk_jaxprs(closed_jaxpr.jaxpr):
+        producer = {}
+        conv_wrapped = {}   # conv outvar -> inbound permutation
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "transpose":
+                perm = tuple(eqn.params.get("permutation", ()))
+                src = eqn.invars[0]
+                src_eqn = producer.get(id(src))
+                if src_eqn is not None:
+                    if src_eqn.primitive.name == "transpose":
+                        prev = tuple(src_eqn.params.get("permutation",
+                                                        ()))
+                        if len(prev) == len(perm) and \
+                                _compose_is_identity(prev, perm):
+                            pairs += 1
+                            if not example:
+                                example = "transpose%s o transpose%s" \
+                                    % (perm, prev)
+                    elif id(src) in {id(v) for v in
+                                     src_eqn.outvars} and \
+                            src_eqn.primitive.name == \
+                            "conv_general_dilated":
+                        inbound = conv_wrapped.get(id(src))
+                        if inbound is not None and \
+                                len(inbound) == len(perm) and \
+                                _compose_is_identity(inbound, perm):
+                            sandwiches += 1
+            elif name == "conv_general_dilated":
+                src_eqn = producer.get(id(eqn.invars[0]))
+                if src_eqn is not None and \
+                        src_eqn.primitive.name == "transpose":
+                    for ov in eqn.outvars:
+                        conv_wrapped[id(ov)] = tuple(
+                            src_eqn.params.get("permutation", ()))
+            for ov in eqn.outvars:
+                producer[id(ov)] = eqn
+    findings: List[Finding] = []
+    if pairs:
+        findings.append(_finding(
+            "transpose-pair", label,
+            "%d dataflow-adjacent inverse transpose pair(s) (%s) — "
+            "relayout churn the compiler must cancel (and, interleaved "
+            "with other ops, often cannot)" % (pairs, example),
+            snippet="inverse-pairs:%d" % pairs))
+    if sandwiches >= 2:
+        findings.append(_finding(
+            "transpose-pair", label,
+            "%d convs individually sandwiched in inverse transposes — "
+            "a per-layer NCHW<->NHWC relayout tax (the r3 ResNet "
+            "0.003-MFU pattern); hoist the layout change outside the "
+            "layer loop" % sandwiches,
+            snippet="conv-sandwich:%d" % sandwiches))
+    return findings
+
+
+# -- entry points ----------------------------------------------------------
+
+def analyze_fn(fn, args: Sequence, *, donate_argnums: Sequence[int] = (),
+               state_pairs: Optional[Sequence[Tuple[int, int, str]]]
+               = None,
+               label: str = "<fn>", big_bytes: int = 1 << 20,
+               min_upcast_elems: int = 1 << 16,
+               expect_donated: Optional[Dict[int, str]] = None,
+               check_shardings: bool = True) -> List[Finding]:
+    """Run every jaxpr rule over `jax.jit(fn, donate_argnums=...)`
+    traced at `args`. One trace serves all rules."""
+    import jax
+
+    jitted = jax.jit(fn, donate_argnums=tuple(donate_argnums))
+    traced = jitted.trace(*args)
+    lowered = traced.lower()
+    findings = donation_findings(lowered, label, big_bytes=big_bytes,
+                                 expect_donated=expect_donated)
+    findings += upcast_findings(traced.jaxpr, label,
+                                min_elems=min_upcast_elems)
+    findings += transpose_findings(traced.jaxpr, label)
+    if state_pairs and check_shardings:
+        compiled = lowered.compile()
+        flat = jax.tree_util.tree_leaves(lowered.args_info)
+        ndims = [len(a.shape) for a in flat]
+        findings += sharding_findings(compiled, label, state_pairs,
+                                      ndims)
+    return findings
+
+
+def train_step_layout(handle, n_inputs: int, n_labels: int,
+                      n_out_leaves: int):
+    """Flat-index layout of make_train_step's (args, outputs) pytrees.
+
+    Args flatten as [params..., frozen..., buffers..., accs(param-major)
+    ..., rng_key, t, lr, inputs..., labels...]; outputs as [loss,
+    out_arrs..., new_bufs..., new_key, new_params..., new_accs..., ok].
+    Returns (expect_donated: {in_idx: name}, state_pairs, key_pair)."""
+    g = handle["groups"]
+    n_p, n_f, n_b = g["params"], g["frozen"], g["buffers"]
+    n_acc = n_p * g["acc_names"]
+    names = handle.get("param_names") or \
+        ["param%d" % i for i in range(n_p)]
+
+    in_param = list(range(0, n_p))
+    in_buf = list(range(n_p + n_f, n_p + n_f + n_b))
+    acc0 = n_p + n_f + n_b
+    in_acc = list(range(acc0, acc0 + n_acc))
+    idx_key = acc0 + n_acc
+
+    n_out = n_out_leaves - (1 + n_b + 1 + n_p + n_acc + 1)
+    out_buf0 = 1 + n_out
+    out_key = out_buf0 + n_b
+    out_p0 = out_key + 1
+    out_acc0 = out_p0 + n_p
+
+    expect = {}
+    pairs = []
+    for i in range(n_p):
+        expect[in_param[i]] = "param %s" % names[i]
+        pairs.append((in_param[i], out_p0 + i, "param %s" % names[i]))
+    for i in range(n_b):
+        expect[in_buf[i]] = "buffer[%d]" % i
+        pairs.append((in_buf[i], out_buf0 + i, "buffer[%d]" % i))
+    for i in range(n_acc):
+        pname = names[i // g["acc_names"]] if g["acc_names"] else "?"
+        expect[in_acc[i]] = "opt-state[%d] of %s" % (
+            i % max(g["acc_names"], 1), pname)
+        pairs.append((in_acc[i], out_acc0 + i, expect[in_acc[i]]))
+    key_pair = (idx_key, out_key, "rng_key")
+    return expect, pairs, key_pair
+
+
+def analyze_train_step(step_call, inputs, labels, *,
+                       label: str = "<train_step>",
+                       min_upcast_elems: int = 1 << 16,
+                       check_shardings: bool = True) -> List[Finding]:
+    """Run the jaxpr pass over a compiled train step built by
+    jit/engine.py:make_train_step, using the `analysis_handle` the
+    engine attaches (step_fn, its jit wrapper, the arg packer, and the
+    state-group sizes that define the flat-index layout)."""
+    import jax
+
+    handle = getattr(step_call, "analysis_handle", None)
+    if handle is None:
+        raise ValueError(
+            "step has no analysis_handle — build it with "
+            "jit.engine.make_train_step")
+    args = handle["pack"](inputs, labels)
+    traced = handle["jitted"].trace(*args)
+    lowered = traced.lower()
+    n_out = len(jax.tree_util.tree_leaves(lowered.out_info))
+    expect, pairs, key_pair = train_step_layout(
+        handle, len(inputs), len(labels), n_out)
+
+    findings = donation_findings(lowered, label, expect_donated=expect)
+    findings += upcast_findings(traced.jaxpr, label,
+                                min_elems=min_upcast_elems)
+    findings += transpose_findings(traced.jaxpr, label)
+    if check_shardings:
+        compiled = lowered.compile()
+        flat = jax.tree_util.tree_leaves(lowered.args_info)
+        ndims = [len(a.shape) for a in flat]
+        findings += sharding_findings(
+            compiled, label, list(pairs) + [key_pair], ndims)
+    return findings

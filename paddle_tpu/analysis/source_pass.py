@@ -1,0 +1,558 @@
+"""Source pass: AST lint for jit hazards over the paddle_tpu tree.
+
+Every rule here encodes a bug this repo has already shipped (or a class
+the jaxpr pass caught at trace time) — the point is to catch the NEXT
+instance at review time instead of at the bottom of a bench log:
+
+  jit-host-sync       `.item()` / `.numpy()` / `float()` on tracers /
+                      `np.asarray` inside a jit-staged body: a host
+                      round-trip inside the compiled region either
+                      fails the trace or silently forces a device sync
+                      per step.
+  tracer-leak         assignment to `self.*`, a global, or a closure
+                      object's attribute inside a jit-staged body: the
+                      traced value outlives the trace (the PR 1 MoE
+                      `l_aux` bug — a tracer stored on the layer
+                      escaped into the next step's python).
+  hot-host-sync       per-batch device→host sync on the fit/metric hot
+                      path (`Model.fit` batch loop helpers, Metric
+                      compute/update): each one blocks the python
+                      thread on the device once per step.
+  unstable-cache-key  compiled-fn lifetime / cache-key hazards that
+                      force retraces: `jax.jit(f)(x)` rebuilt per call,
+                      jit inside a loop body, unhashable (list/dict/
+                      ndarray) components in a jit cache key.
+  x64-pallas-wrap     an `enable_x64`-style config wrap around
+                      `pallas_call` (the PR 6 bug: the kernel jaxpr and
+                      the interpret-mode grid machinery traced under
+                      DIFFERENT x64 modes, producing mixed i64/i32
+                      while-loops the MLIR verifier rejects).
+
+Scope rules are lexical and deliberately conservative: a function is
+"jit-staged" when it is decorated with a jit-like decorator, passed by
+name to a staging call (`jax.jit`, `grad`, `vmap`, `pallas_call`, ...)
+in the scope that defines it, or nested inside a staged function.
+Heuristics miss indirection (a function staged in another module) and
+that is fine — this lint trades recall for a near-zero false-positive
+rate, with the suppression baseline absorbing the deliberate survivors.
+
+Pure stdlib by contract — runs without jax installed.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from .findings import Finding
+
+__all__ = ["RULES", "lint_source", "lint_file", "lint_paths"]
+
+#: rule -> (severity, one-line description)
+RULES = {
+    "jit-host-sync": (
+        "error",
+        "host sync (.item()/.numpy()/float()/np.asarray) inside a "
+        "jit-staged body"),
+    "tracer-leak": (
+        "error",
+        "tracer leaks into self/global/closure state inside a "
+        "jit-staged body"),
+    "hot-host-sync": (
+        "warning",
+        "per-batch device->host sync on the fit/metric hot path"),
+    "unstable-cache-key": (
+        "warning",
+        "jit cache-key / compiled-fn lifetime hazard forcing retraces"),
+    "x64-pallas-wrap": (
+        "error",
+        "enable_x64-style config wrap around pallas_call"),
+}
+
+# calls whose function-valued argument becomes a traced body
+_STAGING_CALLS = {
+    "jit", "pjit", "grad", "value_and_grad", "vmap", "pmap",
+    "make_jaxpr", "checkpoint", "remat", "custom_vjp", "custom_jvp",
+    "pallas_call", "scan", "while_loop", "fori_loop",
+}
+_JIT_DECORATORS = {"jit", "pjit", "to_static"}
+_HOST_SYNC_METHODS = {"item", "numpy", "tolist"}
+_NP_ROOTS = {"np", "numpy", "onp"}
+_NP_SYNC_FUNCS = {"asarray", "array"}
+# names whose access chain marks an expression as shape/meta (static
+# under trace, so float()/int() on it is safe)
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "name"}
+# the per-step surface of the high-level API: syncs here run once per
+# batch for the whole fit (hapi/model.py + metric/__init__.py)
+_HOT_FUNCS = {"train_batch", "eval_batch", "predict_batch", "_pack",
+              "_run_metrics", "accuracy"}
+_METRIC_METHODS = {"update", "compute"}
+
+
+def _dotted(node) -> str:
+    """Best-effort dotted name of an expression ("jax.jit", "float")."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        parts.append(_dotted(node.func) + "()")
+    return ".".join(reversed(parts))
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _root_name(node) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _iter_scope(node):
+    """Yield nodes of `node`'s body without descending into nested
+    function/class scopes (lexical-scope walk)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _staged_names(func_node) -> Set[str]:
+    """Names defined in this scope that are passed to a staging call in
+    this scope (e.g. `jax.jit(step_fn, ...)` marks `step_fn`)."""
+    out: Set[str] = set()
+    for n in _iter_scope(func_node):
+        if not isinstance(n, ast.Call):
+            continue
+        if _last(_dotted(n.func)) not in _STAGING_CALLS:
+            continue
+        for arg in list(n.args) + [k.value for k in n.keywords]:
+            if isinstance(arg, ast.Name):
+                out.add(arg.id)
+    return out
+
+
+def _has_jit_decorator(func_node) -> bool:
+    for dec in func_node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if _last(_dotted(target)) in _JIT_DECORATORS:
+            return True
+        # @partial(jax.jit, ...) / @functools.partial(jit, ...)
+        if isinstance(dec, ast.Call) and _last(_dotted(dec.func)) == \
+                "partial" and dec.args:
+            if _last(_dotted(dec.args[0])) in _JIT_DECORATORS:
+                return True
+    return False
+
+
+def _local_bindings(func_node) -> Set[str]:
+    """Names bound in the function's own scope: parameters, assignment
+    targets, for/with/comprehension targets, nested def/class names."""
+    names: Set[str] = set()
+    a = func_node.args
+    for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        names.add(arg.arg)
+    for n in _iter_scope(func_node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            names.add(n.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.add(n.name)
+        elif isinstance(n, ast.comprehension):
+            for t in ast.walk(n.target):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _mentions_static_meta(node) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(n, ast.Call) and _last(_dotted(n.func)) == "len":
+            return True
+    return False
+
+
+def _has_unhashable(node) -> bool:
+    # anything projected through a static-meta attribute is a hashable
+    # scalar/tuple regardless of what produced it: np.asarray(a).shape
+    # in a cache key is stable, the array itself is not
+    safe: Set[int] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            for inner in ast.walk(n.value):
+                safe.add(id(inner))
+    for n in ast.walk(node):
+        if id(n) in safe:
+            continue
+        if isinstance(n, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(n, ast.Call):
+            name = _last(_dotted(n.func))
+            if name in {"list", "dict", "set", "bytearray"} or \
+                    (name in _NP_SYNC_FUNCS
+                     and _root_name(n.func) in _NP_ROOTS) or \
+                    name in _HOST_SYNC_METHODS:
+                return True
+    return False
+
+
+class _Frame:
+    __slots__ = ("node", "name", "qual", "staged", "is_class",
+                 "class_bases", "locals", "staged_children",
+                 "assigns")
+
+    def __init__(self, node, name, qual, staged, is_class=False,
+                 class_bases=()):
+        self.node = node
+        self.name = name
+        self.qual = qual
+        self.staged = staged
+        self.is_class = is_class
+        self.class_bases = tuple(class_bases)
+        self.locals: Set[str] = set()
+        self.staged_children: Set[str] = set()
+        self.assigns: Dict[str, ast.AST] = {}
+
+
+class _SourceLint(ast.NodeVisitor):
+    def __init__(self, src: str, rel: str):
+        self.rel = rel
+        self.lines = src.splitlines()
+        self.findings: List[Finding] = []
+        self.frames: List[_Frame] = []
+        self.loop_depth = 0
+
+    # -- plumbing ---------------------------------------------------------
+    def _snippet(self, node) -> str:
+        try:
+            return " ".join(self.lines[node.lineno - 1].split())
+        except IndexError:
+            return ""
+
+    def _sym(self) -> str:
+        names = [f.name for f in self.frames if f.name]
+        return ".".join(names)
+
+    def _add(self, rule: str, node, message: str):
+        severity = RULES[rule][0]
+        self.findings.append(Finding(
+            rule=rule, severity=severity, path=self.rel,
+            line=getattr(node, "lineno", 0), message=message,
+            symbol=self._sym(), snippet=self._snippet(node)))
+
+    def _func_frame(self) -> Optional[_Frame]:
+        for f in reversed(self.frames):
+            if not f.is_class:
+                return f
+        return None
+
+    def _staged(self) -> bool:
+        f = self._func_frame()
+        return bool(f and f.staged and f.node is not None)
+
+    def _hot(self) -> bool:
+        """On the per-batch hot path: a known hot function, or a
+        compute/update method of a Metric subclass."""
+        f = self._func_frame()
+        if f is None or f.node is None:
+            return False
+        if f.name in _HOT_FUNCS:
+            return True
+        if f.name in _METRIC_METHODS and len(self.frames) >= 2:
+            parent = self.frames[-2]
+            if parent.is_class and any(
+                    "Metric" in b for b in parent.class_bases):
+                return True
+        return False
+
+    # -- scopes -----------------------------------------------------------
+    def visit_Module(self, node):
+        self.frames.append(_Frame(None, "", "", False))
+        self.frames[-1].staged_children = _staged_names(node)
+        self.generic_visit(node)
+        self.frames.pop()
+
+    def visit_ClassDef(self, node):
+        bases = [_dotted(b) for b in node.bases]
+        self.frames.append(_Frame(None, node.name, node.name, False,
+                                  is_class=True, class_bases=bases))
+        self.generic_visit(node)
+        self.frames.pop()
+
+    def _visit_func(self, node):
+        enclosing = self._func_frame()
+        staged = (_has_jit_decorator(node)
+                  or node.name in self.frames[-1].staged_children
+                  or (node.name in enclosing.staged_children
+                      if enclosing else False)
+                  or (enclosing.staged if enclosing
+                      and enclosing.node is not None else False))
+        frame = _Frame(node, node.name, self._sym() + "." + node.name,
+                       staged)
+        frame.locals = _local_bindings(node)
+        frame.staged_children = _staged_names(node)
+        saved_loops, self.loop_depth = self.loop_depth, 0
+        self.frames.append(frame)
+        self.generic_visit(node)
+        self.frames.pop()
+        self.loop_depth = saved_loops
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_For(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_While = visit_For
+
+    def visit_Global(self, node):
+        f = self._func_frame()
+        if f is not None and f.node is not None:
+            # a `global` declaration means stores to the name escape
+            f.locals.difference_update(node.names)
+        self.generic_visit(node)
+
+    visit_Nonlocal = visit_Global
+
+    # -- assignments (tracer-leak, cache-key bookkeeping) ------------------
+    def _check_leak_target(self, target):
+        if not self._staged():
+            return
+        if isinstance(target, ast.Attribute):
+            root = _root_name(target)
+            if root == "self":
+                self._add("tracer-leak", target,
+                          "assignment to self.%s inside a jit-staged "
+                          "body stores a tracer on the module (it "
+                          "escapes the trace and poisons the next "
+                          "python step)" % target.attr)
+            else:
+                f = self._func_frame()
+                if root is not None and f is not None and \
+                        root not in f.locals:
+                    self._add("tracer-leak", target,
+                              "assignment to closure/global object "
+                              "%r inside a jit-staged body leaks the "
+                              "traced value past the trace" %
+                              _dotted(target))
+        elif isinstance(target, ast.Name):
+            f = self._func_frame()
+            if f is not None and target.id not in f.locals:
+                self._add("tracer-leak", target,
+                          "assignment to global %r inside a jit-staged "
+                          "body" % target.id)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            for tt in ([t] if not isinstance(t, (ast.Tuple, ast.List))
+                       else list(t.elts)):
+                self._check_leak_target(tt)
+        f = self._func_frame()
+        if f is not None and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            f.assigns[node.targets[0].id] = node.value
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_leak_target(node.target)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        # cache-key hygiene: indexing a *cache* container with an
+        # unhashable or numpy-materialized key forces (at best) a
+        # TypeError and (at worst — stringified keys) a retrace per call
+        name = _dotted(node.value)
+        if "cache" in name.lower():
+            key = node.slice
+            if isinstance(key, ast.Name):
+                f = self._func_frame()
+                if f is not None:
+                    key = f.assigns.get(key.id, key)
+            if _has_unhashable(key):
+                self._add("unstable-cache-key", node,
+                          "jit cache key for %r contains an unhashable "
+                          "or per-call-unstable component (list/dict/"
+                          "ndarray) — every lookup misses and forces a "
+                          "retrace" % name)
+        self.generic_visit(node)
+
+    # -- calls (host-sync, cache lifetime) ---------------------------------
+    def visit_Call(self, node):
+        name = _dotted(node.func)
+        last = _last(name)
+        staged = self._staged()
+        hot = self._hot()
+
+        if last in _HOST_SYNC_METHODS and isinstance(node.func,
+                                                     ast.Attribute):
+            if staged:
+                self._add("jit-host-sync", node,
+                          ".%s() inside a jit-staged body forces a "
+                          "device->host sync (or fails the trace on an "
+                          "abstract tracer)" % last)
+            elif hot:
+                self._add("hot-host-sync", node,
+                          ".%s() on the per-batch hot path blocks the "
+                          "python thread on the device every step" %
+                          last)
+        elif last in _NP_SYNC_FUNCS and _root_name(node.func) in _NP_ROOTS:
+            if staged:
+                self._add("jit-host-sync", node,
+                          "np.%s() inside a jit-staged body "
+                          "materializes the tracer on host" % last)
+            elif hot:
+                self._add("hot-host-sync", node,
+                          "np.%s() on the per-batch hot path pulls the "
+                          "array to host every step" % last)
+        elif last == "_np" and hot:
+            self._add("hot-host-sync", node,
+                      "_np() on the per-batch hot path syncs the full "
+                      "array to host every step")
+        elif last in {"float", "int", "bool"} and staged and node.args:
+            arg = node.args[0]
+            if isinstance(arg, (ast.Call, ast.Attribute, ast.Subscript)) \
+                    and not _mentions_static_meta(arg):
+                self._add("jit-host-sync", node,
+                          "%s() on a traced value inside a jit-staged "
+                          "body is a concretization point — it fails "
+                          "under trace or silently syncs" % last)
+
+        # compiled-fn lifetime: jit(f)(x) rebuilds + retraces per call
+        if isinstance(node.func, ast.Call) and \
+                _last(_dotted(node.func.func)) in {"jit", "pjit"}:
+            self._add("unstable-cache-key", node,
+                      "jit-wrapped function is immediately invoked: the "
+                      "compiled callable (and its cache) is rebuilt on "
+                      "every call, retracing each time")
+        elif last in {"jit", "pjit"} and self.loop_depth > 0:
+            self._add("unstable-cache-key", node,
+                      "jax.jit called inside a loop body creates a "
+                      "fresh compiled function (fresh cache) per "
+                      "iteration")
+        self.generic_visit(node)
+
+
+def _check_x64_pallas(tree: ast.AST, src: str, rel: str
+                      ) -> List[Finding]:
+    """Flag enable_x64-style wraps whose enclosing-function chain also
+    references pallas_call. Full-subtree (not lexical) pallas search per
+    enclosing function: the PR 6 wrap lived in a closure nested inside
+    the function that BUILT the pallas_call, with the call itself in the
+    outer scope. An x64 toggle in a function with no pallas anywhere in
+    its chain (checkpoint IO, config fixtures) is not this bug."""
+    lines = src.splitlines()
+
+    def is_x64(n) -> bool:
+        if not isinstance(n, ast.Call):
+            return False
+        name = _dotted(n.func)
+        if "enable_x64" in name:
+            return True
+        return (_last(name) == "update" and bool(n.args)
+                and isinstance(n.args[0], ast.Constant)
+                and n.args[0].value == "jax_enable_x64")
+
+    _pallas_cache: Dict[int, bool] = {}
+
+    def has_pallas(scope) -> bool:
+        hit = _pallas_cache.get(id(scope))
+        if hit is None:
+            hit = any(
+                (isinstance(n, ast.Attribute) and n.attr == "pallas_call")
+                or (isinstance(n, ast.Name) and n.id == "pallas_call")
+                for n in ast.walk(scope))
+            _pallas_cache[id(scope)] = hit
+        return hit
+
+    findings: List[Finding] = []
+    seen_lines = set()
+    func_stack: List[ast.AST] = []
+
+    def visit(node):
+        is_func = isinstance(node, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))
+        if is_func:
+            func_stack.append(node)
+        if is_x64(node):
+            scopes = func_stack or [tree]
+            line = getattr(node, "lineno", 0)
+            if line not in seen_lines and any(has_pallas(s)
+                                              for s in scopes):
+                seen_lines.add(line)
+                try:
+                    snippet = " ".join(lines[line - 1].split())
+                except IndexError:
+                    snippet = ""
+                findings.append(Finding(
+                    rule="x64-pallas-wrap",
+                    severity=RULES["x64-pallas-wrap"][0], path=rel,
+                    line=line,
+                    symbol=getattr(func_stack[0] if func_stack else None,
+                                   "name", ""),
+                    snippet=snippet,
+                    message="x64-mode wrap around a pallas_call: the "
+                            "kernel jaxpr and the surrounding lowering "
+                            "trace under different int widths (the PR 6 "
+                            "'Cannot lower jaxpr' / mixed i64-i32 "
+                            "while-loop bug class)"))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if is_func:
+            func_stack.pop()
+
+    visit(tree)
+    return findings
+
+
+def lint_source(src: str, rel: str) -> List[Finding]:
+    """All source-pass findings for one file's contents."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(rule="jit-host-sync", severity="error", path=rel,
+                        line=e.lineno or 0, symbol="",
+                        snippet="<unparseable>",
+                        message="file does not parse: %s" % e.msg)]
+    visitor = _SourceLint(src, rel)
+    visitor.visit(tree)
+    return visitor.findings + _check_x64_pallas(tree, src, rel)
+
+
+def lint_file(path: str, repo_root: Optional[str] = None
+              ) -> List[Finding]:
+    rel = os.path.relpath(path, repo_root) if repo_root else path
+    with open(path, encoding="utf-8", errors="replace") as f:
+        src = f.read()
+    return lint_source(src, rel.replace(os.sep, "/"))
+
+
+def lint_paths(paths, repo_root: Optional[str] = None) -> List[Finding]:
+    """Lint every .py file under `paths` (files or directories)."""
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isfile(p):
+            findings.extend(lint_file(p, repo_root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    findings.extend(
+                        lint_file(os.path.join(dirpath, fn), repo_root))
+    return findings

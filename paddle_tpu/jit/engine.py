@@ -336,10 +336,41 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
         return (Tensor(loss, _internal=True),
                 [Tensor(o, _internal=True) for o in out_arrs])
 
+    def _pack_for_analysis(inputs: Sequence[Tensor],
+                           labels: Sequence[Tensor]):
+        """call()'s exact argument packing, minus side effects (no step
+        increment, no dispatch): what analysis.jaxpr_pass traces so its
+        jaxpr/lowering is the one the real step runs."""
+        if mesh is not None:
+            _place_state()
+            from jax.sharding import NamedSharding
+            for t in list(inputs) + list(labels):
+                t._data = _place(
+                    t._data, NamedSharding(mesh,
+                                           _batch_spec(mesh, t._data.ndim)))
+        return ([p._data for p in params], [p._data for p in frozen],
+                [b._data for b in buffers],
+                [[a[n] for n in acc_names] for a in accs],
+                RNG.key, np.int32(optimizer._step_count + 1),
+                np.float32(optimizer.get_lr()),
+                [x._data for x in inputs], [x._data for x in labels])
+
+    _pname = {id(p): n for n, p in network.named_parameters()}
     call._params = params
     call.telemetry = telemetry
     call.last_step_skipped = False
     call.skipped_steps = 0
+    # handle for analysis.jaxpr_pass: enough to re-trace the step and map
+    # flat arg/output indices back to named state groups (donation and
+    # step-boundary sharding checks)
+    call.analysis_handle = {
+        "fn": step_fn, "jitted": jitted, "pack": _pack_for_analysis,
+        "donate_argnums": (0, 2, 3),
+        "groups": {"params": len(params), "frozen": len(frozen),
+                   "buffers": len(buffers), "acc_names": len(acc_names)},
+        "param_names": [_pname.get(id(p), "param%d" % i)
+                        for i, p in enumerate(params)],
+    }
     return call
 
 

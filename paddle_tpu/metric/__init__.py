@@ -1,7 +1,15 @@
 """Metrics (reference: python/paddle/metric/metrics.py — Accuracy,
-Precision, Recall, Auc)."""
+Precision, Recall, Auc).
+
+Accuracy / Precision / Recall do their reductions device-side (jnp) and
+sync only the resulting scalars: these run once per batch inside
+Model.fit's hot loop, and pulling the full logits to host there was a
+per-step transfer ptlint's hot-host-sync rule flags. Auc keeps its
+host-side streaming histogram (baseline-suppressed, see
+tools/ptlint_baseline.json)."""
 from __future__ import annotations
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tensor import Tensor
@@ -11,6 +19,11 @@ __all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
 
 def _np(x):
     return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+def _dev(x):
+    """Device array of x without a host round-trip for Tensors."""
+    return x._data if isinstance(x, Tensor) else Tensor(x)._data
 
 
 class Metric:
@@ -38,25 +51,26 @@ class Accuracy(Metric):
         self.reset()
 
     def compute(self, pred, label, *args):
-        p = _np(pred)
-        l = _np(label)
+        p = _dev(pred)
+        l = _dev(label)
         if l.ndim == p.ndim and l.shape[-1] > 1:  # one-hot
-            l = np.argmax(l, axis=-1)
+            l = jnp.argmax(l, axis=-1)
         if l.ndim == p.ndim:
             l = l.squeeze(-1)
-        topk_idx = np.argsort(-p, axis=-1)[..., :self.maxk]
+        topk_idx = jnp.argsort(-p, axis=-1)[..., :self.maxk]
         correct = (topk_idx == l[..., None])
-        return Tensor(correct.astype(np.float32))
+        return Tensor(correct.astype(jnp.float32), _internal=True)
 
     def update(self, correct, *args):
-        c = _np(correct)
-        num = c.shape[0] if c.ndim > 0 else 1
+        c = _dev(correct)
         res = []
         for i, k in enumerate(self.topk):
-            ck = c[..., :k].sum(-1).mean()
-            self.total[i] += float(c[..., :k].sum())
-            self.count[i] += int(np.prod(c.shape[:-1]))
-            res.append(float(ck))
+            # one scalar D2H per k instead of the whole correct mask
+            ck_sum = float(jnp.sum(c[..., :k]))
+            n = int(np.prod(c.shape[:-1]))
+            self.total[i] += ck_sum
+            self.count[i] += n
+            res.append(ck_sum / n if n else 0.0)
         return res if len(res) > 1 else res[0]
 
     def reset(self):
@@ -79,10 +93,10 @@ class Precision(Metric):
         self.reset()
 
     def update(self, preds, labels):
-        p = (_np(preds) > 0.5).astype(np.int32).reshape(-1)
-        l = _np(labels).astype(np.int32).reshape(-1)
-        self.tp += int(((p == 1) & (l == 1)).sum())
-        self.fp += int(((p == 1) & (l == 0)).sum())
+        p = _dev(preds).reshape(-1) > 0.5
+        l = _dev(labels).reshape(-1).astype(jnp.int32)
+        self.tp += int(jnp.sum(p & (l == 1)))
+        self.fp += int(jnp.sum(p & (l == 0)))
 
     def reset(self):
         self.tp = 0
@@ -102,10 +116,10 @@ class Recall(Metric):
         self.reset()
 
     def update(self, preds, labels):
-        p = (_np(preds) > 0.5).astype(np.int32).reshape(-1)
-        l = _np(labels).astype(np.int32).reshape(-1)
-        self.tp += int(((p == 1) & (l == 1)).sum())
-        self.fn += int(((p == 0) & (l == 1)).sum())
+        p = _dev(preds).reshape(-1) > 0.5
+        l = _dev(labels).reshape(-1).astype(jnp.int32)
+        self.tp += int(jnp.sum(p & (l == 1)))
+        self.fn += int(jnp.sum(~p & (l == 1)))
 
     def reset(self):
         self.tp = 0
@@ -157,9 +171,10 @@ class Auc(Metric):
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):
-    """functional metric op (reference: operators/metrics/accuracy_op)."""
-    p = _np(input)
-    l = _np(label).reshape(-1)
-    topk_idx = np.argsort(-p, axis=-1)[:, :k]
-    corr = (topk_idx == l[:, None]).any(-1).mean()
-    return Tensor(np.asarray(corr, np.float32))
+    """functional metric op (reference: operators/metrics/accuracy_op).
+    Computed device-side; the scalar result stays on device."""
+    p = _dev(input)
+    l = _dev(label).reshape(-1)
+    topk_idx = jnp.argsort(-p, axis=-1)[:, :k]
+    corr = (topk_idx == l[:, None]).any(-1)
+    return Tensor(jnp.mean(corr.astype(jnp.float32)), _internal=True)

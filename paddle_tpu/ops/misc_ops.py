@@ -112,7 +112,7 @@ def viterbi_decode(potentials, transition, lengths, *,
         path.insert(0, upd)
         last_ids = jnp.where(left_v < 0, last_ids, upd)
     path = jnp.stack(path, axis=1).astype(jnp.int64)      # [B, steps]
-    max_len = int(np.asarray(jnp.max(lengths)))
+    max_len = int(jnp.max(lengths))  # scalar D2H, not an array pull
     return scores, path[:, :max_len]
 
 
@@ -457,8 +457,8 @@ def segment_pool(x, segment_ids, *, pooltype="SUM"):
     """reference: operators/segment_pool_op.cc — pool rows of x by
     (sorted) segment id: SUM / MEAN / MAX / MIN. Output has
     max(segment_ids)+1 rows (dynamic — eager / concrete-shape use)."""
-    ids = np.asarray(segment_ids)
-    n = int(ids.max()) + 1 if ids.size else 0
+    segment_ids = jnp.asarray(segment_ids)  # no-op on device arrays
+    n = (int(jnp.max(segment_ids)) + 1) if segment_ids.size else 0
     if pooltype == "SUM":
         return jax.ops.segment_sum(x, segment_ids, num_segments=n)
     if pooltype == "MEAN":

@@ -1,0 +1,27 @@
+"""ptlint fixture: NEGATIVE unstable-cache-key — a module-lifetime jit
+wrapper and a cache keyed by static meta (shape/dtype tuples — even
+when projected off np.asarray, the executor.py:run pattern) are
+stable."""
+import jax
+import numpy as np
+
+
+def _step(x):
+    return x * 2.0
+
+
+_compiled = jax.jit(_step)   # compiled once, cached for the module lifetime
+
+
+class Runner:
+    def __init__(self):
+        self._cache = {}
+
+    def run(self, feed_arrays):
+        key = tuple(tuple(np.asarray(a).shape) + (str(np.asarray(a).dtype),)
+                    for a in feed_arrays)
+        cp = self._cache.get(key)
+        if cp is None:
+            cp = _compiled
+            self._cache[key] = cp
+        return [cp(a) for a in feed_arrays]

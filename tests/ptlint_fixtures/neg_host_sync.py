@@ -1,0 +1,19 @@
+"""ptlint fixture: NEGATIVE jit-host-sync — nothing here may be
+flagged: syncs in plain eager code, and shape/meta concretizations
+inside jit (static under trace) are all fine."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def eager_path(x):
+    # not staged anywhere: sync away
+    return float(np.asarray(x).sum()) + x.item()
+
+
+@jax.jit
+def staged_meta_only(x):
+    n = float(x.shape[0])        # static meta, safe
+    d = int(x.ndim)              # static meta, safe
+    k = float(len(x.shape))      # len() of meta, safe
+    return jnp.sum(x) * n * d * k

@@ -1,0 +1,26 @@
+"""ptlint fixture: NEGATIVE tracer-leak — local mutation inside a
+staged body and module state written from UNstaged code are both
+fine."""
+import jax
+import jax.numpy as jnp
+
+
+class Holder:
+    pass
+
+
+H = Holder()
+
+
+def record(x):
+    # not jit-staged: storing concrete values on module state is fine
+    H.last = x
+    return x
+
+
+@jax.jit
+def step(x):
+    acc = jnp.zeros_like(x)     # local store: fine
+    acc = acc + x
+    tmp = {"y": acc}            # local container: fine
+    return tmp["y"]

@@ -1,0 +1,22 @@
+"""ptlint fixture: NEGATIVE x64-pallas-wrap — an x64 wrap with no
+pallas_call in scope, and a bare pallas_call with no wrap, are both
+fine."""
+import contextlib
+
+from jax.experimental import pallas as pl
+
+
+@contextlib.contextmanager
+def enable_x64(on):
+    yield
+
+
+def load_legacy_checkpoint(path, reader):
+    # x64 toggle around plain host IO — no kernel anywhere in scope
+    with enable_x64(True):
+        return reader(path)
+
+
+def build_kernel(kernel, shape):
+    # pallas_call with no x64 wrap anywhere
+    return pl.pallas_call(kernel, out_shape=shape)

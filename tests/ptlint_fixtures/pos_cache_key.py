@@ -1,0 +1,21 @@
+"""ptlint fixture: POSITIVE unstable-cache-key — compiled-fn lifetime
+and cache-key hazards that force a retrace per call."""
+import jax
+import numpy as np
+
+
+def relayout(fn, xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(fn)(x))        # PTLINT: unstable-cache-key (IIFE; also jit-in-loop)
+    return out
+
+
+class Runner:
+    def __init__(self):
+        self._cache = {}
+
+    def run(self, fn, arr):
+        key = [fn, np.asarray(arr)]          # unhashable list + ndarray
+        cp = self._cache[key]                 # PTLINT: unstable-cache-key
+        return cp(arr)

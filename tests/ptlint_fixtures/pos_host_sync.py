@@ -1,0 +1,20 @@
+"""ptlint fixture: POSITIVE jit-host-sync — every marked line must be
+flagged. Never imported; consumed by tests/test_ptlint.py."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    s = x.item()                      # PTLINT: jit-host-sync
+    h = np.asarray(x)                 # PTLINT: jit-host-sync
+    v = float(jnp.sum(x))             # PTLINT: jit-host-sync
+    return s + h.sum() + v
+
+
+def outer(x):
+    def inner(y):
+        return y.numpy()              # PTLINT: jit-host-sync (staged via jit below)
+
+    return jax.jit(inner)(x)             # PTLINT: unstable-cache-key

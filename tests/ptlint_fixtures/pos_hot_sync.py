@@ -1,0 +1,27 @@
+"""ptlint fixture: POSITIVE hot-host-sync — full-array pulls on the
+per-batch fit/metric hot path."""
+import numpy as np
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+class Metric:
+    pass
+
+
+class MyAccuracy(Metric):
+    def compute(self, pred, label):
+        p = _np(pred)                     # PTLINT: hot-host-sync
+        return p.argmax(-1) == _np(label)  # PTLINT: hot-host-sync
+
+    def update(self, correct):
+        c = correct.numpy()               # PTLINT: hot-host-sync
+        self.total = c.sum()
+        return c.mean()
+
+
+class Model:
+    def _pack(self, loss):
+        return float(loss.numpy())        # PTLINT: hot-host-sync

@@ -1,0 +1,25 @@
+"""ptlint fixture: POSITIVE tracer-leak — the PR 1 MoE `l_aux` bug
+class: a traced value stored on the module / a global outlives the
+trace and poisons the next python step."""
+import jax
+import jax.numpy as jnp
+
+
+class _Aux:
+    pass
+
+
+AUX = _Aux()
+TOTAL = 0.0
+
+
+class MoELayer:
+    def build_step(self):
+        def step(x):
+            global TOTAL
+            self.l_aux = jnp.sum(x)       # PTLINT: tracer-leak (self)
+            AUX.last = x                  # PTLINT: tracer-leak (closure obj)
+            TOTAL = jnp.sum(x)            # PTLINT: tracer-leak (global)
+            return x * 2.0
+
+        return jax.jit(step)

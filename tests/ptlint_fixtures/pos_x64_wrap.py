@@ -1,0 +1,35 @@
+"""ptlint fixture: POSITIVE x64-pallas-wrap — the PR 6 bug shape: an
+enable_x64 wrap in a closure nested inside the function that builds the
+pallas_call, so kernel jaxpr and interpret-grid machinery trace under
+different int widths."""
+import contextlib
+
+from jax.experimental import pallas as pl
+
+
+@contextlib.contextmanager
+def enable_x64(on):
+    yield
+
+
+def build_kernel(kernel, shape):
+    inner = pl.pallas_call(kernel, out_shape=shape)
+
+    def call(*operands):
+        with enable_x64(False):           # PTLINT: x64-pallas-wrap
+            return inner(*operands)
+
+    return call
+
+
+def build_kernel_config_update(kernel, shape, config):
+    inner = pl.pallas_call(kernel, out_shape=shape)
+
+    def call(*operands):
+        config.update("jax_enable_x64", False)   # PTLINT: x64-pallas-wrap
+        try:
+            return inner(*operands)
+        finally:
+            config.update("jax_enable_x64", True)  # PTLINT: x64-pallas-wrap
+
+    return call

@@ -1,0 +1,411 @@
+"""ptlint: static jit-hazard + sharding-consistency analyzer
+(paddle_tpu/analysis/, tools/ptlint.py — docs/STATIC_ANALYSIS.md).
+
+Source pass is exercised against the seeded fixture tree in
+tests/ptlint_fixtures/: every `# PTLINT: <rule>` marker line must be
+found (100% seeded-violation detection, the ISSUE 7 acceptance bar) and
+negative fixtures must be finding-free. The jaxpr pass is exercised on
+real traced programs, including a deliberately mismatched pjit
+in/out-sharding pair reproducing the MULTICHIP_r03 remat trigger and
+the donation check on an engine-built train step."""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.analysis import (Finding, apply_baseline, assign_indices,
+                                 baseline_entries, emit_findings,
+                                 findings_to_json, lint_file, lint_paths,
+                                 lint_source, load_baseline,
+                                 write_baseline)
+from paddle_tpu.analysis import SOURCE_RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "ptlint_fixtures")
+PTLINT = os.path.join(REPO, "tools", "ptlint.py")
+
+
+def _markers(path):
+    out = set()
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            m = re.search(r"# PTLINT: ([\w-]+)", line)
+            if m:
+                out.add((i, m.group(1)))
+    return out
+
+
+def _fixture_files(prefix):
+    return sorted(f for f in os.listdir(FIXTURES)
+                  if f.startswith(prefix) and f.endswith(".py"))
+
+
+# -- source pass over the seeded fixtures ---------------------------------
+
+class TestSourcePassFixtures:
+    def test_fixture_coverage(self):
+        """One positive and one negative fixture exists per rule."""
+        pos = " ".join(_fixture_files("pos_"))
+        neg = " ".join(_fixture_files("neg_"))
+        assert len(_fixture_files("pos_")) >= 5
+        assert len(_fixture_files("neg_")) >= 5
+        for part in ("host_sync", "tracer_leak", "hot_sync", "cache_key",
+                     "x64_wrap"):
+            assert part in pos and part in neg
+
+    @pytest.mark.parametrize("fname", _fixture_files("pos_"))
+    def test_positive_fixture_all_seeded_violations_found(self, fname):
+        path = os.path.join(FIXTURES, fname)
+        marked = _markers(path)
+        assert marked, "positive fixture %s has no PTLINT markers" % fname
+        got = {(f.line, f.rule) for f in lint_file(path)}
+        assert got == marked
+
+    @pytest.mark.parametrize("fname", _fixture_files("neg_"))
+    def test_negative_fixture_clean(self, fname):
+        path = os.path.join(FIXTURES, fname)
+        assert lint_file(path) == []
+
+    def test_rule_catalog_complete(self):
+        """Every source rule fires on at least one fixture line."""
+        fired = set()
+        for fname in _fixture_files("pos_"):
+            for f in lint_file(os.path.join(FIXTURES, fname)):
+                fired.add(f.rule)
+        assert fired == set(SOURCE_RULES)
+
+    def test_lint_paths_walks_directory(self):
+        findings = lint_paths([FIXTURES], repo_root=REPO)
+        assert {f.rule for f in findings} == set(SOURCE_RULES)
+        # repo-relative, forward-slash paths
+        assert all(f.path.startswith("tests/ptlint_fixtures/")
+                   for f in findings)
+
+    def test_real_tree_has_no_unsuppressed_findings(self):
+        """`ptlint paddle_tpu/` is clean modulo the checked-in baseline
+        (the ISSUE 7 acceptance criterion, in-process)."""
+        findings = assign_indices(
+            lint_paths([os.path.join(REPO, "paddle_tpu")],
+                       repo_root=REPO))
+        baseline = load_baseline(
+            os.path.join(REPO, "tools", "ptlint_baseline.json"))
+        unsup, _sup, _stale = apply_baseline(findings, baseline)
+        assert unsup == [], "\n".join(f.format() for f in unsup)
+
+    def test_unparseable_file_reports_instead_of_raising(self):
+        fs = lint_source("def broken(:\n", "x.py")
+        assert len(fs) == 1 and "does not parse" in fs[0].message
+
+
+# -- fingerprints and the suppression baseline ----------------------------
+
+SRC_LEAK = """
+import jax
+
+STATE = type("S", (), {})()
+
+def build():
+    def step(x):
+        STATE.loss = x.sum()
+        return x
+    return jax.jit(step)
+"""
+
+
+class TestBaseline:
+    def test_fingerprint_survives_line_shift(self):
+        a = lint_source(SRC_LEAK, "m.py")
+        b = lint_source("# pad\n# pad\n" + SRC_LEAK, "m.py")
+        assert len(a) == len(b) == 1
+        assert a[0].line != b[0].line
+        assert a[0].fingerprint == b[0].fingerprint
+
+    def test_fingerprint_distinguishes_identical_snippets(self):
+        src = SRC_LEAK.replace("STATE.loss = x.sum()",
+                               "STATE.loss = x.sum()\n        "
+                               "STATE.loss = x.sum()")
+        fs = assign_indices(lint_source(src, "m.py"))
+        assert len(fs) == 2
+        assert fs[0].fingerprint != fs[1].fingerprint
+
+    def test_roundtrip_and_stale_reporting(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        findings = assign_indices(lint_source(SRC_LEAK, "m.py"))
+        write_baseline(path, baseline_entries(findings))
+        # suppressed on the next run
+        unsup, sup, stale = apply_baseline(findings, load_baseline(path))
+        assert unsup == [] and len(sup) == 1 and stale == []
+        # fix ships -> the entry is reported stale
+        unsup, sup, stale = apply_baseline([], load_baseline(path))
+        assert unsup == [] and sup == []
+        assert len(stale) == 1
+        assert stale[0]["fingerprint"] == findings[0].fingerprint
+
+    def test_update_preserves_handwritten_reasons(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        findings = assign_indices(lint_source(SRC_LEAK, "m.py"))
+        entries = baseline_entries(findings)
+        entries[0]["reason"] = "deliberate: test double"
+        write_baseline(path, entries)
+        again = baseline_entries(findings, previous=load_baseline(path))
+        assert again[0]["reason"] == "deliberate: test double"
+
+    def test_missing_baseline_suppresses_nothing(self):
+        assert load_baseline("/nonexistent/x.json") == {}
+        assert load_baseline(None) == {}
+
+    def test_json_report_is_stable(self):
+        fs = assign_indices(lint_source(SRC_LEAK, "m.py"))
+        a = findings_to_json(fs, [], [])
+        b = findings_to_json(
+            assign_indices(lint_source(SRC_LEAK, "m.py")), [], [])
+        assert a == b
+        doc = json.loads(a)
+        assert doc["summary"]["unsuppressed"] == 1
+        assert doc["findings"][0]["rule"] == "tracer-leak"
+
+
+# -- jaxpr pass -----------------------------------------------------------
+
+class TestJaxprPass:
+    def test_non_donated_buffer_flagged_and_donation_clears_it(self):
+        import jax.numpy as jnp
+        from paddle_tpu.analysis import analyze_fn
+
+        def step(w, g):
+            return w - 0.1 * g, jnp.sum(g)
+
+        w = np.zeros((512, 512), np.float32)  # 1 MiB: over big_bytes
+        g = np.ones((512, 512), np.float32)
+        fs = analyze_fn(step, (w, g), label="<t>", check_shardings=False)
+        assert any(f.rule == "non-donated-buffer" for f in fs)
+        fs = analyze_fn(step, (w, g), donate_argnums=(0,), label="<t>",
+                        check_shardings=False)
+        assert [f for f in fs if f.rule == "non-donated-buffer"] == []
+
+    def test_expected_donation_flags_small_state_too(self):
+        from paddle_tpu.analysis.jaxpr_pass import donation_findings
+        import jax
+
+        def step(w, g):
+            return w - 0.1 * g
+
+        lowered = jax.jit(step).trace(np.zeros(4, np.float32),
+                                      np.ones(4, np.float32)).lower()
+        fs = donation_findings(lowered, "<t>",
+                               expect_donated={0: "param w"})
+        assert len(fs) == 1 and "param w" in fs[0].message
+
+    def test_bf16_upcast_flagged(self):
+        import jax.numpy as jnp
+        from paddle_tpu.analysis import analyze_fn
+
+        def f(x):
+            return x.astype(jnp.float32) * 2.0
+
+        x = np.zeros((256, 512), np.float32).astype(jnp.bfloat16)
+        fs = analyze_fn(f, (x,), label="<t>", check_shardings=False)
+        assert any(f.rule == "bf16-upcast" for f in fs)
+        # small operands stay quiet
+        small = np.zeros((4, 4), np.float32).astype(jnp.bfloat16)
+        fs = analyze_fn(f, (small,), label="<t>", check_shardings=False)
+        assert [f for f in fs if f.rule == "bf16-upcast"] == []
+
+    def test_inverse_transpose_pair_flagged(self):
+        import jax.numpy as jnp
+        from paddle_tpu.analysis import analyze_fn
+
+        def f(x):
+            return jnp.transpose(jnp.transpose(x)) + 0.0
+
+        fs = analyze_fn(f, (np.zeros((8, 16), np.float32),),
+                        label="<t>", check_shardings=False)
+        assert any(f.rule == "transpose-pair" for f in fs)
+
+        def g(x):   # single transpose: no pair
+            return jnp.transpose(x) + 0.0
+
+        fs = analyze_fn(g, (np.zeros((8, 16), np.float32),),
+                        label="<t>", check_shardings=False)
+        assert [f for f in fs if f.rule == "transpose-pair"] == []
+
+    def test_mismatched_pjit_sharding_pair_flagged(self):
+        """MULTICHIP_r03 repro: a step whose state output lands with a
+        DIFFERENT sharding than its state input expects — the next
+        step's dispatch pays a reshard (or forces remat)."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.analysis.jaxpr_pass import sharding_findings
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs >= 2 devices")
+        mesh = Mesh(np.array(devs[:2]), ("x",))
+        sh_in = NamedSharding(mesh, P("x"))
+        sh_out = NamedSharding(mesh, P())   # deliberately mismatched
+
+        def step(w):
+            return w * 2.0
+
+        compiled = jax.jit(step, in_shardings=sh_in,
+                           out_shardings=sh_out).trace(
+            np.zeros((8, 4), np.float32)).lower().compile()
+        fs = sharding_findings(compiled, "<t>", [(0, 0, "param w")],
+                               ndims=[2])
+        assert len(fs) == 1
+        assert fs[0].rule == "sharding-boundary-mismatch"
+        assert "param w" in fs[0].message
+
+        # equivalent shardings: clean
+        compiled = jax.jit(step, in_shardings=sh_in,
+                           out_shardings=sh_in).trace(
+            np.zeros((8, 4), np.float32)).lower().compile()
+        assert sharding_findings(compiled, "<t>", [(0, 0, "param w")],
+                                 ndims=[2]) == []
+
+
+# -- engine integration ---------------------------------------------------
+
+def _tiny_step():
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    from paddle_tpu.jit.engine import make_train_step
+    step = make_train_step(net, nn.CrossEntropyLoss(), opt)
+    X = paddle.to_tensor(
+        np.random.RandomState(0).rand(4, 8).astype("float32"))
+    Y = paddle.to_tensor(np.zeros((4, 1), np.int64))
+    return step, X, Y
+
+
+class TestTrainStepAnalysis:
+    def test_engine_attaches_analysis_handle(self):
+        step, _, _ = _tiny_step()
+        h = step.analysis_handle
+        assert h["donate_argnums"] == (0, 2, 3)
+        assert h["groups"]["params"] == 2          # weight + bias
+        assert h["groups"]["acc_names"] >= 2       # adam moments
+        assert "weight" in " ".join(h["param_names"])
+
+    def test_train_step_donates_params_and_opt_state(self):
+        """ISSUE 7 acceptance: the engine step passes the non-donation
+        rule (and sharding/upcast rules) with NO suppression."""
+        from paddle_tpu.analysis import analyze_train_step
+        step, X, Y = _tiny_step()
+        fs = analyze_train_step(step, [X], [Y], label="<train_step>")
+        assert fs == [], "\n".join(f.format() for f in fs)
+
+    def test_missing_donation_detected_on_train_step_shape(self):
+        """Sanity that the rule would actually catch the regression:
+        re-trace the SAME engine step_fn without donate_argnums."""
+        import jax
+        from paddle_tpu.analysis.jaxpr_pass import (donation_findings,
+                                                    train_step_layout)
+        step, X, Y = _tiny_step()
+        h = step.analysis_handle
+        args = h["pack"]([X], [Y])
+        lowered = jax.jit(h["fn"]).trace(*args).lower()   # no donation
+        n_out = len(jax.tree_util.tree_leaves(lowered.out_info))
+        expect, _pairs, _key = train_step_layout(h, 1, 1, n_out)
+        fs = donation_findings(lowered, "<t>", expect_donated=expect)
+        # every param + buffer + acc input must be flagged
+        assert len(fs) == len(expect)
+
+
+# -- observability + CLI --------------------------------------------------
+
+class TestEmission:
+    def test_emit_findings_journal_and_metrics(self, tmp_path):
+        from paddle_tpu.observability import REGISTRY, read_journal
+        from paddle_tpu.observability import journal as journal_mod
+
+        findings = assign_indices(lint_source(SRC_LEAK, "m.py"))
+        j = journal_mod.RunJournal(str(tmp_path),
+                                   filename="journal-lint.jsonl")
+        prev = journal_mod.set_journal(j)
+        try:
+            before = REGISTRY.counter(
+                "pt_lint_findings_total", "",
+                ("rule", "severity")).labels(
+                rule="tracer-leak", severity="error").value
+            n = emit_findings(findings,
+                              [{"rule": "gone", "path": "old.py",
+                                "fingerprint": "deadbeef00000000"}])
+        finally:
+            journal_mod.set_journal(prev)
+            j.close()
+        assert n == 1
+        evs = read_journal(str(tmp_path / "journal-lint.jsonl"))
+        kinds = [e["event"] for e in evs]
+        assert kinds.count("lint_finding") == 1
+        assert kinds.count("lint_stale_suppression") == 1
+        ev = next(e for e in evs if e["event"] == "lint_finding")
+        assert ev["rule"] == "tracer-leak"
+        assert ev["fingerprint"] == findings[0].fingerprint
+        after = REGISTRY.counter(
+            "pt_lint_findings_total", "", ("rule", "severity")).labels(
+            rule="tracer-leak", severity="error").value
+        assert after == before + 1
+
+
+@pytest.mark.slow
+class TestCLI:
+    def _run(self, *argv):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run([sys.executable, PTLINT] + list(argv),
+                              capture_output=True, text=True, env=env,
+                              cwd=REPO, timeout=300)
+
+    def test_fixture_violations_fail_and_json_is_stable(self):
+        pos = os.path.join(FIXTURES, "pos_tracer_leak.py")
+        a = self._run(pos, "--no-baseline", "--json")
+        b = self._run(pos, "--no-baseline", "--json")
+        assert a.returncode == 1 and b.returncode == 1
+        assert a.stdout == b.stdout          # byte-stable report
+        doc = json.loads(a.stdout)
+        assert doc["summary"]["unsuppressed"] == 3
+        assert all(f["rule"] == "tracer-leak" for f in doc["findings"])
+
+    def test_repo_tree_gates_clean(self):
+        r = self._run(os.path.join(REPO, "paddle_tpu"))
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_update_baseline_then_clean_then_stale(self, tmp_path):
+        pos = os.path.join(FIXTURES, "pos_host_sync.py")
+        neg = os.path.join(FIXTURES, "neg_host_sync.py")
+        bl = str(tmp_path / "bl.json")
+        r = self._run(pos, "--baseline", bl, "--update-baseline")
+        assert r.returncode == 0
+        r = self._run(pos, "--baseline", bl)
+        assert r.returncode == 0, r.stdout + r.stderr
+        # different file -> every entry is stale; reported, rc 0 unless
+        # --fail-stale
+        r = self._run(neg, "--baseline", bl)
+        assert r.returncode == 0 and "STALE" in r.stderr
+        r = self._run(neg, "--baseline", bl, "--fail-stale")
+        assert r.returncode == 1
+
+    def test_telemetry_dir_feeds_ptdoctor_lint(self, tmp_path):
+        d = str(tmp_path / "tel")
+        r = self._run(os.path.join(FIXTURES, "pos_hot_sync.py"),
+                      "--no-baseline", "--telemetry-dir", d)
+        assert r.returncode == 1
+        assert os.path.exists(os.path.join(d, "journal-lint.jsonl"))
+        assert os.path.exists(os.path.join(d, "metrics-lint.json"))
+        doctor = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ptdoctor.py"),
+             "lint", d], capture_output=True, text=True, timeout=120)
+        assert doctor.returncode == 0
+        assert "hot-host-sync" in doctor.stdout
+        summary = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ptdoctor.py"),
+             "summary", d], capture_output=True, text=True, timeout=120)
+        assert summary.returncode == 0
+        assert "lint findings" in summary.stdout

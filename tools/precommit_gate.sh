@@ -12,6 +12,17 @@ cd "$(dirname "$0")/.."
 TARGET="${@:-tests/}"
 LOG="${PRECOMMIT_GATE_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
+
+# Static-analysis gate (docs/STATIC_ANALYSIS.md): ptlint over paddle_tpu/
+# must report zero unsuppressed findings. Cheapest check — runs first so
+# a lint failure doesn't cost a full tier-1 round.
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/ptlint.py paddle_tpu/
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then
+    echo "PTLINT=FAILED (rc=$lint_rc — fix the findings or suppress with a reason via --update-baseline)"
+    exit "$lint_rc"
+fi
+echo "PTLINT=ok"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest $TARGET -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$LOG"

@@ -4,6 +4,7 @@
     python tools/ptdoctor.py summary  <telemetry_dir>
     python tools/ptdoctor.py timeline <telemetry_dir> [--last N]
     python tools/ptdoctor.py crash    <telemetry_dir>
+    python tools/ptdoctor.py lint     <telemetry_dir>
 
 `summary` answers "what happened to run X" from one command: per-rank
 step counts/rates and last-alive step, retraces per engine, restart
@@ -202,6 +203,26 @@ def cmd_summary(agg, directory) -> int:
             or "(reasons only)"))
         for tier in sorted(reasons):
             print("    %s: %s" % (tier, reasons[tier]))
+    # static-analysis findings recorded into this run dir (ptlint
+    # --telemetry-dir, or emit_findings from a test harness)
+    lint = _counter_by_label(agg, directory, "pt_lint_findings_total",
+                             "rule")
+    lint_sev = _counter_by_label(agg, directory, "pt_lint_findings_total",
+                                 "severity")
+    stale_sup = sum(1 for e in events
+                    if e.get("event") == "lint_stale_suppression")
+    if lint or stale_sup:
+        line = "  lint findings: " + ("  ".join(
+            "%s=%d" % (k, int(v)) for k, v in sorted(lint.items()))
+            or "none")
+        if lint_sev:
+            line += "  (" + " ".join(
+                "%s=%d" % (k, int(v))
+                for k, v in sorted(lint_sev.items())) + ")"
+        if stale_sup:
+            line += "  stale-suppressions=%d" % stale_sup
+        print(line)
+        print("    (ptdoctor lint %s for details)" % directory)
     stalest = None
     for r in sorted(ranks):
         st = ranks[r]
@@ -283,12 +304,47 @@ def cmd_crash(agg, directory) -> int:
     return 0
 
 
+def cmd_lint(agg, directory) -> int:
+    """Every lint_finding / lint_stale_suppression event in the run dir,
+    rendered like ptlint's own output (docs/STATIC_ANALYSIS.md)."""
+    events = agg.load_events(directory)
+    finds = [e for e in events if e.get("event") == "lint_finding"]
+    stale = [e for e in events
+             if e.get("event") == "lint_stale_suppression"]
+    if not finds and not stale:
+        print("ptdoctor: no lint events under %s" % directory)
+        return 0
+    finds.sort(key=lambda e: (str(e.get("path", "")), e.get("line", 0)
+                              if isinstance(e.get("line"), (int, float))
+                              else 0))
+    for e in finds:
+        loc = str(e.get("path", "?"))
+        if e.get("line"):
+            loc += ":%s" % e["line"]
+        sym = " (%s)" % e["symbol"] if e.get("symbol") else ""
+        print("%s: %s: [%s] %s%s" % (loc, e.get("severity", "?"),
+                                     e.get("rule", "?"),
+                                     e.get("message", ""), sym))
+    for e in stale:
+        print("STALE suppression: [%s] %s %s" %
+              (e.get("rule"), e.get("path"), e.get("fingerprint")))
+    sev = {}
+    for e in finds:
+        sev[e.get("severity", "?")] = sev.get(e.get("severity", "?"), 0) + 1
+    print("lint: %d finding(s)%s, %d stale suppression(s)" %
+          (len(finds),
+           " (" + " ".join("%s=%d" % kv for kv in sorted(sev.items()))
+           + ")" if sev else "",
+           len(stale)))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="ptdoctor", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     sub = ap.add_subparsers(dest="cmd", required=True)
-    for name in ("summary", "timeline", "crash"):
+    for name in ("summary", "timeline", "crash", "lint"):
         p = sub.add_parser(name)
         p.add_argument("dir", help="telemetry directory (--log_dir / "
                                    "telemetry_dir of the run)")
@@ -304,6 +360,8 @@ def main(argv=None) -> int:
         return cmd_summary(agg, args.dir)
     if args.cmd == "timeline":
         return cmd_timeline(agg, args.dir, last=args.last)
+    if args.cmd == "lint":
+        return cmd_lint(agg, args.dir)
     return cmd_crash(agg, args.dir)
 
 

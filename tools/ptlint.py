@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""ptlint: static jit-hazard and sharding-consistency lint.
+
+    python tools/ptlint.py [paths ...]          # source pass (default: paddle_tpu/)
+    python tools/ptlint.py --train-step         # + jaxpr pass over the gpt-tiny train step
+    python tools/ptlint.py --json               # machine-stable report on stdout
+    python tools/ptlint.py --update-baseline    # rewrite tools/ptlint_baseline.json
+    python tools/ptlint.py --telemetry-dir DIR  # emit lint_finding events + metrics
+
+Exit codes: 0 = no unsuppressed findings, 1 = unsuppressed findings
+(what tools/precommit_gate.sh gates on), 2 = lint could not run.
+Stale baseline entries (suppressed hazards that no longer exist) are
+reported on stderr and exit 1 only under --fail-stale; see
+docs/STATIC_ANALYSIS.md for the rule catalog and suppression workflow.
+
+The source pass is pure stdlib; when `paddle_tpu` itself cannot be
+imported (no jax on the box), the analysis modules are loaded straight
+from their files and only --train-step / --telemetry-dir are off.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+import types
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(ROOT, "tools", "ptlint_baseline.json")
+
+
+def _load_analysis():
+    """(findings, source_pass) modules — via the real package when it
+    imports, else loaded standalone from file (stdlib-only path)."""
+    sys.path.insert(0, ROOT)
+    try:
+        from paddle_tpu.analysis import findings, source_pass
+        return findings, source_pass, True
+    except Exception:
+        pkg = types.ModuleType("_ptlint_analysis")
+        pkg.__path__ = [os.path.join(ROOT, "paddle_tpu", "analysis")]
+        sys.modules["_ptlint_analysis"] = pkg
+        mods = []
+        for name in ("findings", "source_pass"):
+            spec = importlib.util.spec_from_file_location(
+                "_ptlint_analysis." + name,
+                os.path.join(ROOT, "paddle_tpu", "analysis", name + ".py"))
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[spec.name] = mod
+            spec.loader.exec_module(mod)
+            mods.append(mod)
+        return mods[0], mods[1], False
+
+
+def _train_step_findings(label="<train_step:gpt-tiny>"):
+    """Jaxpr pass over the canonical GPT-tiny train step: trace + lower
+    + compile (no dispatch) of exactly what jit/engine.py would run."""
+    from paddle_tpu.framework.platform import pin_host_platform
+    pin_host_platform(1)
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis.jaxpr_pass import analyze_train_step
+    from paddle_tpu.jit.engine import make_train_step
+    from paddle_tpu.models import GPTPretrainingCriterion, gpt_tiny
+
+    paddle.seed(0)
+    m = gpt_tiny(vocab_size=128, hidden_size=32, num_layers=2,
+                 num_heads=4, intermediate_size=64,
+                 max_position_embeddings=32)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=m.parameters())
+    step = make_train_step(m, GPTPretrainingCriterion(), opt)
+    ids = np.random.RandomState(0).randint(0, 128, (2, 17))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int64))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int64))
+    return analyze_train_step(step, [x], [y], label=label)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="ptlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(ROOT, "paddle_tpu")],
+                    help="files/dirs to lint (default: paddle_tpu/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-stable JSON report on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression baseline (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report everything, suppress nothing")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to suppress all current "
+                         "findings (keeps existing reasons)")
+    ap.add_argument("--train-step", action="store_true",
+                    help="also run the jaxpr pass over the gpt-tiny "
+                         "train step (imports jax)")
+    ap.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                    help="emit lint_finding journal events + metrics "
+                         "snapshot into DIR")
+    ap.add_argument("--fail-stale", action="store_true",
+                    help="exit 1 when the baseline has stale entries")
+    args = ap.parse_args(argv)
+
+    findings_mod, source_mod, have_pkg = _load_analysis()
+
+    try:
+        found = source_mod.lint_paths(args.paths, repo_root=ROOT)
+    except (OSError, SyntaxError) as e:
+        print("ptlint: source pass failed: %s" % e, file=sys.stderr)
+        return 2
+
+    if args.train_step:
+        if not have_pkg:
+            print("ptlint: --train-step needs the paddle_tpu package "
+                  "(jax) importable", file=sys.stderr)
+            return 2
+        found += _train_step_findings()
+
+    findings_mod.assign_indices(found)
+    baseline = {} if args.no_baseline else \
+        findings_mod.load_baseline(args.baseline)
+
+    if args.update_baseline:
+        entries = findings_mod.baseline_entries(found, previous=baseline)
+        findings_mod.write_baseline(args.baseline, entries)
+        print("ptlint: baseline updated: %d suppression(s) -> %s"
+              % (len(entries), os.path.relpath(args.baseline, ROOT)))
+        return 0
+
+    unsup, sup, stale = findings_mod.apply_baseline(found, baseline)
+    if not args.train_step:
+        # jaxpr-pass suppressions anchor to pseudo-paths like
+        # "<train_step:gpt-tiny>"; when that pass didn't run, a missing
+        # finding proves nothing about them
+        stale = [e for e in stale
+                 if not str(e.get("path", "")).startswith("<")]
+
+    if args.telemetry_dir:
+        if not have_pkg:
+            print("ptlint: --telemetry-dir needs the paddle_tpu package "
+                  "importable", file=sys.stderr)
+            return 2
+        from paddle_tpu.observability import REGISTRY
+        from paddle_tpu.observability import journal as _journal
+        j = _journal.RunJournal(args.telemetry_dir,
+                                filename="journal-lint.jsonl")
+        prev = _journal.set_journal(j)
+        try:
+            findings_mod.emit_findings(unsup + sup, stale)
+        finally:
+            _journal.set_journal(prev)
+            j.close()
+        REGISTRY.write_json(os.path.join(args.telemetry_dir,
+                                         "metrics-lint.json"))
+
+    if args.json:
+        sys.stdout.write(
+            findings_mod.findings_to_json(unsup, sup, stale))
+    else:
+        for f in unsup:
+            print(f.format())
+        for entry in stale:
+            print("STALE suppression (fix shipped? remove the entry): "
+                  "[%s] %s %s" % (entry.get("rule"), entry.get("path"),
+                                  entry.get("fingerprint")),
+                  file=sys.stderr)
+        print("ptlint: %d finding(s), %d suppressed, %d stale baseline "
+              "entr%s" % (len(unsup), len(sup), len(stale),
+                          "y" if len(stale) == 1 else "ies"))
+        if unsup:
+            print("ptlint: fix the findings or (with a reason) run "
+                  "--update-baseline", file=sys.stderr)
+
+    if unsup:
+        return 1
+    if stale and args.fail_stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
