@@ -24,6 +24,11 @@ _forced = _os.environ.get("PADDLE_TPU_FORCE_PLATFORM")
 if _forced:
     _jax.config.update("jax_platforms", _forced)
 
+# jax 0.4.37 lacks the top-level jax.shard_map alias; install it before any
+# shard_map call site imports (framework/platform.py).
+from .framework.platform import ensure_shard_map_alias as _ensure_shard_map
+_ensure_shard_map()
+
 # dtypes
 from .framework.dtype import (bool_ as bool, uint8, int8, int16, int32,  # noqa: A004
                               int64, float16, bfloat16, float32, float64,

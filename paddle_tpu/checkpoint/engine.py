@@ -291,26 +291,38 @@ def save_checkpoint(path: str, layer=None, optimizer=None, meta=None, *,
                     async_: bool = False, sharded: bool = False,
                     rank: Optional[int] = None,
                     world_size: Optional[int] = None,
-                    barrier_fn=None):
+                    barrier_fn=None, shard_arrays: bool = False,
+                    mesh_axes: Optional[Sequence[str]] = None):
     """Durable checkpoint save. Returns the committed path, or a
     `PendingSave` when `async_=True` (host capture happens synchronously
     either way; only the disk work moves off-thread).
 
     With `sharded=True` each rank commits `path/rank_<r>/` and rank 0
     commits the global manifest after `barrier_fn` (defaults to the
-    distributed env + collective barrier)."""
+    distributed env + collective barrier).
+
+    With `shard_arrays=True` (implies sharded) ranks hold REPLICATED state
+    and each writes only its axis-0 slice of every array, with the slice
+    bounds recorded per array in the shard manifest (reshard.shard_for_rank
+    layout). Such a store restores at ANY world size: the load reassembles
+    full arrays from the recorded bounds (docs/CHECKPOINT.md "Elastic
+    topology changes"). `mesh_axes` is recorded in the global manifest as
+    topology metadata for forensics/ptdoctor."""
     d = os.path.dirname(os.path.abspath(path))
     if d:
         os.makedirs(d, exist_ok=True)
     snap = snapshot(layer, optimizer, meta)
-    if sharded:
-        return _save_sharded(path, snap, rank, world_size, barrier_fn)
+    if sharded or shard_arrays:
+        return _save_sharded(path, snap, rank, world_size, barrier_fn,
+                             shard_arrays=shard_arrays, mesh_axes=mesh_axes)
     if async_:
         return _submit(path, snap)
     return _do_write(path, snap, mode="sync")
 
 
-def _save_sharded(path: str, snap: dict, rank, world_size, barrier_fn) -> str:
+def _save_sharded(path: str, snap: dict, rank, world_size, barrier_fn,
+                  shard_arrays: bool = False,
+                  mesh_axes: Optional[Sequence[str]] = None) -> str:
     if rank is None or world_size is None:
         from ..distributed.env import get_rank, get_world_size
         rank = int(get_rank()) if rank is None else int(rank)
@@ -318,7 +330,20 @@ def _save_sharded(path: str, snap: dict, rank, world_size, barrier_fn) -> str:
                       else int(world_size))
     os.makedirs(path, exist_ok=True)
     shard = os.path.join(path, "rank_%d" % rank)
-    snap = dict(snap, extras=dict(snap["extras"], shard_rank=rank))
+    extras = dict(snap["extras"], shard_rank=rank)
+    arrays = snap["arrays"]
+    if shard_arrays:
+        from ..distributed.auto_parallel.reshard import shard_for_rank
+        sliced, layout = {}, {}
+        for name, arr in arrays.items():
+            sliced[name], layout[name] = shard_for_rank(arr, rank,
+                                                        world_size)
+        arrays = sliced
+        # the bounds travel with the shard: the read side reassembles from
+        # what was RECORDED, never from a re-derived split convention
+        extras["shard_layout"] = layout
+        extras["world_size"] = int(world_size)
+    snap = dict(snap, arrays=arrays, extras=extras)
     _do_write(shard, snap, mode="shard")
     if barrier_fn is None and world_size > 1:
         from ..distributed.collective import barrier as barrier_fn
@@ -326,12 +351,17 @@ def _save_sharded(path: str, snap: dict, rank, world_size, barrier_fn) -> str:
         barrier_fn()
     if rank == 0:
         # global manifest: an empty store at the top level whose COMMIT
-        # marks every shard durably written (ranks passed the barrier)
+        # marks every shard durably written (ranks passed the barrier);
+        # its extras are the topology record a future restore at a
+        # different world size reshards against
+        gextras = {"sharded": True, "world_size": int(world_size)}
+        if shard_arrays:
+            gextras["shard_arrays"] = True
+        if mesh_axes is not None:
+            gextras["mesh_axes"] = [str(a) for a in mesh_axes]
         gtmp = "%s.tmp.%d-%d" % (path.rstrip(os.sep) + os.sep + "global",
                                  os.getpid(), next(_tmp_counter))
-        store.write_store(gtmp, {}, meta=snap["meta"],
-                          extras={"sharded": True,
-                                  "world_size": int(world_size)})
+        store.write_store(gtmp, {}, meta=snap["meta"], extras=gextras)
         for name in (store.MANIFEST, store.COMMIT):
             os.replace(os.path.join(gtmp, name), os.path.join(path, name))
         shutil.rmtree(gtmp, ignore_errors=True)
@@ -388,18 +418,97 @@ def _recover_sibling(path: str) -> bool:
     return False
 
 
+def _note_reshard(path: str, old_world: int, new_world: int,
+                  mode: str) -> None:
+    metrics.counter("pt_ckpt_reshards_total",
+                    "Checkpoint restores that crossed a topology change "
+                    "(saved world size != restoring world size)").inc()
+    run_journal.emit("checkpoint_reshard", path=str(path),
+                     from_world=int(old_world), to_world=int(new_world),
+                     mode=mode)
+    logger.warning("checkpoint %s saved at world=%d, restoring at world=%d "
+                   "(%s)", path, old_world, new_world, mode)
+
+
 def _read_verified(path: str) -> Tuple[Dict[str, np.ndarray], dict, dict]:
-    """read_store + legacy-pickle compat + sharded indirection."""
+    """read_store + legacy-pickle compat + sharded indirection.
+
+    Sharded stores are topology-aware: a `shard_arrays` store always
+    reassembles full arrays from the recorded per-shard bounds (valid at
+    ANY restoring world size); a legacy per-rank-state store restores this
+    rank's own shard, falling back to `rank % saved_world` when the world
+    changed (best effort — per-rank LOCAL state has no global layout to
+    reassemble from). Either topology mismatch emits a
+    `checkpoint_reshard` journal event + pt_ckpt_reshards_total."""
     if not store.is_complete(path) and \
             os.path.isfile(os.path.join(path, "ckpt.pkl")):
         return _read_legacy(path)
     arrays, meta, extras = store.read_store(path)
     if extras.get("sharded"):
-        from ..distributed.env import get_rank
-        shard = os.path.join(path, "rank_%d" % int(get_rank()))
-        arrays, smeta, extras = store.read_store(shard)
+        from ..distributed.env import get_rank, get_world_size
+        old_world = int(extras.get("world_size", 1))
+        cur_world = int(get_world_size())
+        if extras.get("shard_arrays"):
+            arrays, smeta, extras = _load_assembled(path, old_world)
+            if old_world != cur_world:
+                _note_reshard(path, old_world, cur_world, "reassemble")
+        else:
+            r = int(get_rank())
+            if old_world != cur_world:
+                _note_reshard(path, old_world, cur_world, "rank_modulo")
+                r = r % old_world
+            arrays, smeta, extras = store.read_store(
+                os.path.join(path, "rank_%d" % r))
         meta = dict(meta, **smeta)
     return arrays, meta, extras
+
+
+def _load_assembled(path: str, old_world: int
+                    ) -> Tuple[Dict[str, np.ndarray], dict, dict]:
+    """Reassemble full arrays from a `shard_arrays` store's rank shards.
+
+    Memory-efficient: each array is streamed shard-by-shard through
+    `reshard.assemble_shards`, so at most one full array plus one shard
+    are resident at a time — never old_world full copies (arxiv
+    2112.01075). Every shard manifest is hash-verified against its COMMIT
+    and every blob sha256-verified on the way through; any violation
+    raises CheckpointCorruptError, which the caller quarantines."""
+    from ..distributed.auto_parallel.reshard import assemble_shards
+    shards = []
+    for r in range(old_world):
+        spath = os.path.join(path, "rank_%d" % r)
+        shards.append((spath, store.read_manifest(spath)))
+    base_path, base_man = shards[0]
+    base_extras = base_man.get("extras", {})
+    layouts = base_extras.get("shard_layout", {})
+    arrays: Dict[str, np.ndarray] = {}
+    for name, lay0 in layouts.items():
+        ent = base_man.get("arrays", {}).get(name)
+        if ent is None:
+            raise CheckpointCorruptError(
+                base_path, "blob_missing",
+                f"{name}: in shard_layout but not in manifest")
+        if lay0.get("replicated"):  # 0-d: every shard holds the full value
+            arrays[name] = store.read_array(base_path, name,
+                                            manifest=base_man)
+            continue
+
+        def shards_of(name=name):
+            for spath, man in shards:
+                lay = man.get("extras", {}).get("shard_layout",
+                                                {}).get(name)
+                if lay is None:
+                    raise CheckpointCorruptError(
+                        spath, "blob_missing",
+                        f"{name}: missing from shard_layout")
+                yield lay, store.read_array(spath, name, manifest=man)
+
+        arrays[name] = assemble_shards(lay0["global_shape"],
+                                       store._resolve_dtype(ent["dtype"]),
+                                       shards_of())
+    extras = {k: v for k, v in base_extras.items()
+              if k not in ("shard_layout", "shard_rank", "world_size")}
+    return arrays, base_man.get("meta", {}), extras
 
 
 def _read_legacy(path: str) -> Tuple[Dict[str, np.ndarray], dict, dict]:

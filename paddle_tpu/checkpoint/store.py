@@ -43,7 +43,7 @@ from ..resilience import chaos
 
 __all__ = [
     "CheckpointCorruptError", "write_store", "read_store", "read_manifest",
-    "is_complete", "fsync_dir", "fsync_file",
+    "read_array", "is_complete", "fsync_dir", "fsync_file",
 ]
 
 FORMAT = "paddle-tpu-ckpt"
@@ -216,6 +216,27 @@ def read_manifest(path: str, verify: bool = True) -> dict:
     return manifest
 
 
+def _read_entry(path: str, name: str, ent: dict,
+                verify: bool = True) -> np.ndarray:
+    """Verified read of one manifest entry's blob."""
+    bpath = os.path.join(path, ent["file"])
+    if not os.path.isfile(bpath):
+        raise CheckpointCorruptError(path, "blob_missing",
+                                     f"{name}: {ent['file']}")
+    size = os.path.getsize(bpath)
+    if size != int(ent["nbytes"]):
+        raise CheckpointCorruptError(
+            path, "truncated",
+            f"{name}: {size} bytes on disk, manifest says "
+            f"{ent['nbytes']}")
+    if verify and _sha256_file(bpath) != ent["sha256"]:
+        raise CheckpointCorruptError(path, "checksum", name)
+    dtype = _resolve_dtype(ent["dtype"])
+    with open(bpath, "rb") as f:
+        data = f.read()
+    return np.frombuffer(data, dtype=dtype).reshape(ent["shape"]).copy()
+
+
 def read_store(path: str, verify: bool = True
                ) -> Tuple[Dict[str, np.ndarray], dict, dict]:
     """Verified load: returns (arrays, meta, extras) or raises
@@ -223,21 +244,21 @@ def read_store(path: str, verify: bool = True
     manifest = read_manifest(path, verify=verify)
     arrays: Dict[str, np.ndarray] = {}
     for name, ent in manifest.get("arrays", {}).items():
-        bpath = os.path.join(path, ent["file"])
-        if not os.path.isfile(bpath):
-            raise CheckpointCorruptError(path, "blob_missing",
-                                         f"{name}: {ent['file']}")
-        size = os.path.getsize(bpath)
-        if size != int(ent["nbytes"]):
-            raise CheckpointCorruptError(
-                path, "truncated",
-                f"{name}: {size} bytes on disk, manifest says "
-                f"{ent['nbytes']}")
-        if verify and _sha256_file(bpath) != ent["sha256"]:
-            raise CheckpointCorruptError(path, "checksum", name)
-        dtype = _resolve_dtype(ent["dtype"])
-        with open(bpath, "rb") as f:
-            data = f.read()
-        arr = np.frombuffer(data, dtype=dtype).reshape(ent["shape"]).copy()
-        arrays[name] = arr
+        arrays[name] = _read_entry(path, name, ent, verify=verify)
     return arrays, manifest.get("meta", {}), manifest.get("extras", {})
+
+
+def read_array(path: str, name: str, verify: bool = True,
+               manifest: Optional[dict] = None) -> np.ndarray:
+    """Verified read of ONE array from a store — the memory-efficient
+    primitive behind restore-with-reshard (checkpoint/engine.py
+    `_load_assembled`): only the named blob is resident, never the whole
+    store. Pass `manifest` (from read_manifest) to amortize the manifest
+    hash check over many per-array reads."""
+    if manifest is None:
+        manifest = read_manifest(path, verify=verify)
+    ent = manifest.get("arrays", {}).get(name)
+    if ent is None:
+        raise CheckpointCorruptError(path, "blob_missing",
+                                     f"{name}: not in manifest")
+    return _read_entry(path, name, ent, verify=verify)

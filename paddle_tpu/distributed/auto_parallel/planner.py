@@ -33,7 +33,32 @@ from ...framework.tensor import Tensor
 from .cost_model import (ClusterSpec, ConfigCost, estimate_jaxpr_cost,
                          search_hybrid_config)
 
-__all__ = ["Planner", "ShardingPlan"]
+__all__ = ["Planner", "ShardingPlan", "largest_feasible_world"]
+
+
+def largest_feasible_world(n_max: int, mesh_axes=None) -> int:
+    """Largest world size <= n_max the mesh factorization accepts — the
+    shrink-to-fit target the launcher re-spawns at after quarantining a
+    dead rank (distributed/launch.py, docs/RESILIENCE.md "Elastic topology
+    changes").
+
+    With no recorded mesh axes any W >= 1 factorizes as pure dp, so the
+    answer is n_max itself. With recorded axes (("dp", d), ("mp", m),
+    ("pp", p)) the non-dp degrees are STRUCTURAL — they partition the
+    model, not the batch — and must survive the shrink intact: the world
+    stays a multiple of m*p and dp absorbs the loss. Returns 0 when no
+    world <= n_max can host the structural axes (the job cannot shrink
+    below one full model replica)."""
+    n_max = int(n_max)
+    if n_max < 1:
+        return 0
+    structural = 1
+    for axis, deg in (mesh_axes or ()):
+        if axis != "dp":
+            structural *= int(deg)
+    if structural > n_max:
+        return 0
+    return (n_max // structural) * structural
 
 
 @dataclass

@@ -13,7 +13,9 @@ the dist-attr bookkeeping and the guard rails (cross-mesh inside one traced
 program is not expressible — XLA programs own one device set)."""
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 import jax
 from jax.sharding import NamedSharding
@@ -21,7 +23,84 @@ from jax.sharding import NamedSharding
 from ...framework.tensor import Tensor
 from . import ProcessMesh, _spec_from, get_default_mesh
 
-__all__ = ["reshard", "reshard_state_dict"]
+__all__ = ["reshard", "reshard_state_dict", "shard_bounds",
+           "shard_for_rank", "assemble_shards"]
+
+
+# ---------------------------------------------------------------------------
+# host-side shard math for topology-aware checkpoint resharding
+#
+# Pure numpy: these are the slicing/reassembly primitives behind the
+# checkpoint engine's restore-with-reshard (docs/CHECKPOINT.md "Elastic
+# topology changes"). Saves at world W slice every array along axis 0 with
+# the bounds below; a restore at ANY world reassembles from the recorded
+# per-shard bounds — convention-free on the read side, so a format change
+# here can never silently corrupt old checkpoints (the bounds travel in
+# each shard's manifest extras, arxiv 2112.01075).
+# ---------------------------------------------------------------------------
+
+def shard_bounds(dim0: int, world: int) -> List[Tuple[int, int]]:
+    """Per-rank (start, stop) bounds along axis 0 — the np.array_split
+    convention: the first dim0 % world ranks get one extra row, so any
+    dim0 (including 0 and dim0 < world) yields exactly `world` contiguous,
+    disjoint, covering slices."""
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    base, extra = divmod(int(dim0), world)
+    bounds = []
+    start = 0
+    for r in range(world):
+        stop = start + base + (1 if r < extra else 0)
+        bounds.append((start, stop))
+        start = stop
+    return bounds
+
+
+def shard_for_rank(arr: np.ndarray, rank: int, world: int
+                   ) -> Tuple[np.ndarray, Dict]:
+    """Slice `arr` for `rank` of `world`; returns (shard, layout). 0-d
+    arrays cannot be split and are replicated on every rank (layout
+    {"replicated": True}); everything else slices along axis 0. The
+    layout dict is what the save records per array in the shard's
+    manifest extras and what assemble_shards consumes."""
+    arr = np.asarray(arr)
+    if arr.ndim == 0:
+        return arr, {"replicated": True, "global_shape": []}
+    start, stop = shard_bounds(arr.shape[0], world)[rank]
+    return arr[start:stop], {"axis": 0, "start": int(start),
+                             "stop": int(stop),
+                             "global_shape": [int(d) for d in arr.shape]}
+
+
+def assemble_shards(global_shape: Sequence[int], dtype,
+                    shards: Iterable[Tuple[Dict, np.ndarray]]) -> np.ndarray:
+    """Memory-efficient chunked reassembly: allocate the full array once,
+    then paste each (layout, shard) as the caller streams it in — one full
+    array plus one shard resident at a time (arxiv 2112.01075). `shards`
+    yields verified per-rank pieces in any order; the recorded bounds must
+    tile axis 0 exactly or the reassembly refuses (a silent gap would
+    restore uninitialized memory as parameters)."""
+    global_shape = tuple(int(d) for d in global_shape)
+    out = np.empty(global_shape, dtype=dtype)
+    covered = 0
+    for layout, shard in shards:
+        shard = np.asarray(shard)
+        if layout.get("replicated"):
+            return shard.reshape(global_shape).astype(dtype, copy=True)
+        start, stop = int(layout["start"]), int(layout["stop"])
+        if shard.shape != (stop - start,) + global_shape[1:]:
+            raise ValueError(
+                f"shard shape {shard.shape} does not match recorded bounds "
+                f"[{start}:{stop}] of global shape {global_shape}")
+        out[start:stop] = shard
+        covered += stop - start
+    if not global_shape:
+        raise ValueError("0-d array reassembly needs a replicated shard")
+    if covered != global_shape[0]:
+        raise ValueError(
+            f"shards cover {covered} of {global_shape[0]} rows along axis "
+            f"0 — refusing a partial reassembly")
+    return out
 
 
 def _dst_sharding(process_mesh, shard_spec, ndim):

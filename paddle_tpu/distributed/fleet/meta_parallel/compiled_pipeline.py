@@ -163,9 +163,11 @@ class CompiledPipeline1F1B:
             return (y, loss_acc), None
 
         ticks = G * v * pp + pp - 1
-        init = (jnp.zeros_like(micro_x[0]), jnp.float32(0.0))
+        # (1,)-shaped loss carry: see the same pattern in _pipeline — a 0-d
+        # scan residual cannot carry a mesh-axis name under value_and_grad
+        init = (jnp.zeros_like(micro_x[0]), jnp.zeros((1,), jnp.float32))
         (_, loss_acc), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
-        loss = jax.lax.psum(loss_acc, "pp") / n_micro
+        loss = jnp.reshape(jax.lax.psum(loss_acc, "pp"), ()) / n_micro
         if self.dp > 1:
             loss = jax.lax.pmean(loss, "dp")
         return loss
@@ -224,11 +226,16 @@ class CompiledPipeline1F1B:
             init_act = jnp.zeros(a0.shape, a0.dtype)
         else:
             init_act = jnp.zeros_like(micro_x[0])
-        init = (init_act, jnp.float32(0.0))
+        # the loss accumulator rides the scan carry as shape (1,), not a
+        # scalar: under value_and_grad, shard_map forwards scan residuals
+        # with a mesh-axis name attached, and a 0-d residual has no axis
+        # to carry it (jax 0.4.x _check_names rejects the program). The
+        # reshape back to () happens after the psum, outside the carry.
+        init = (init_act, jnp.zeros((1,), jnp.float32))
         (_, loss_acc), _ = jax.lax.scan(
             tick, init, jnp.arange(n_micro + pp - 1))
         # only the last stage accumulated loss; share it with everyone
-        loss = jax.lax.psum(loss_acc, "pp") / n_micro
+        loss = jnp.reshape(jax.lax.psum(loss_acc, "pp"), ()) / n_micro
         if self.dp > 1:
             loss = jax.lax.pmean(loss, "dp")
         return loss

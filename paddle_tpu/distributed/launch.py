@@ -46,6 +46,44 @@ def _aggregate(log_dir: str, cause: str) -> None:
         logger.warning("telemetry aggregation failed: %s", e)
 
 
+def _parse_mesh_axes(spec):
+    """PADDLE_TPU_MESH_AXES="dp:2,mp:2" -> (("dp", 2), ("mp", 2)). The
+    launcher has no sharding plan of its own; a hybrid job exports its
+    structural degrees here so shrink-to-fit never lands on a world size
+    the mesh cannot factorize. Malformed specs return None (pure-dp)."""
+    axes = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, deg = part.replace("=", ":").partition(":")
+        try:
+            axes.append((name.strip(), int(deg)))
+        except ValueError:
+            return None
+    return tuple(axes) or None
+
+
+def _shrink_target(cur_world: int) -> int:
+    """Largest feasible world <= cur_world - 1 for a shrink-to-fit gang
+    restart (planner.largest_feasible_world; non-dp mesh axes from
+    PADDLE_TPU_MESH_AXES must survive intact). Returns 0 when the job
+    cannot shrink — below one full model replica, or already world 1."""
+    mesh_axes = _parse_mesh_axes(os.environ.get("PADDLE_TPU_MESH_AXES"))
+    try:
+        from .auto_parallel.planner import largest_feasible_world
+    except Exception:
+        # the planner pulls in jax; the supervisor can live without it
+        structural = 1
+        for name, deg in (mesh_axes or ()):
+            if name != "dp":
+                structural *= int(deg)
+        n_max = cur_world - 1
+        return (n_max // structural) * structural \
+            if 0 < structural <= n_max else 0
+    return largest_feasible_world(cur_world - 1, mesh_axes)
+
+
 class _Worker:
     """One spawned worker process and its bookkeeping."""
 
@@ -113,6 +151,9 @@ def _parse_args(argv=None):
 def launch_collective(args) -> int:
     nprocs = args.nproc_per_node
     world = args.nnodes * nprocs
+    # sticky: a shrink can drop nprocs to 1 but the survivors still share
+    # this host with the launcher and must keep their virtual CPU devices
+    multiproc = nprocs > 1
     master = args.master or f"127.0.0.1:{_free_port()}"
     endpoints = ",".join(
         f"127.0.0.1:{_free_port()}" for _ in range(world))
@@ -177,7 +218,7 @@ def launch_collective(args) -> int:
                 os.unlink(health.heartbeat_path(log_dir, rank))
             except OSError:
                 pass
-        if nprocs > 1:
+        if multiproc:
             # Several controllers on one host: give each a CPU device set.
             # JAX_PLATFORMS alone is overridden by sitecustomize's axon
             # plugin registration, so also set PADDLE_TPU_FORCE_PLATFORM,
@@ -257,7 +298,20 @@ def launch_collective(args) -> int:
     # graceful teardown of every local worker, stale-checkpoint sweep,
     # full respawn; workers auto-resume from last-good (docs/CHECKPOINT.md)
     max_restarts = max(0, args.max_restarts)
-    restarts = 0
+    restarts = 0    # budget-charged same-size respawn cycles
+    rounds = 0      # ALL respawn cycles (restarts + shrinks) — what
+                    # PADDLE_TPU_RESTART_ROUND and log separators count
+    shrinks = 0
+    # per-rank crash attribution: a streak of consecutive failures of the
+    # SAME rank is the shrink-to-fit trigger (a healthy gang restart gives
+    # every rank a fresh chance; a rank that dies again immediately is
+    # gone for good — docs/RESILIENCE.md "Elastic topology changes")
+    last_failed_rank = None
+    streak = 0
+    try:
+        shrink_after = int(os.environ.get("PADDLE_TPU_SHRINK_AFTER", "2"))
+    except ValueError:
+        shrink_after = 2
     backoff = None
     if max_restarts:
         from ..resilience import RetryPolicy
@@ -306,6 +360,48 @@ def launch_collective(args) -> int:
                 continue
 
             w, cause, code = failed
+            streak = streak + 1 if w.rank == last_failed_rank else 1
+            last_failed_rank = w.rank
+
+            # shrink-to-fit sits BEFORE the budget check and does not
+            # charge it: abandoning a permanently-dead rank is progress,
+            # not another spin of the same failure. Single-node only —
+            # multi-node membership changes need a coordinator-side
+            # re-form this launcher cannot drive alone.
+            new_world = 0
+            if (world > 1 and args.nnodes == 1 and shrink_after > 0
+                    and streak >= shrink_after):
+                new_world = _shrink_target(world)
+            if new_world >= 1:
+                shrinks += 1
+                rounds += 1
+                logger.warning(
+                    "worker rank %d %s %d times in a row — SHRINKING "
+                    "world %d -> %d (gang respawn without the dead rank)",
+                    w.rank, cause, streak, world, new_world)
+                metrics.counter(
+                    "pt_gang_shrinks_total",
+                    "Shrink-to-fit gang restarts at a smaller world "
+                    "size").inc()
+                run_journal.emit("gang_shrink", failed_rank=w.rank,
+                                 cause=cause, code=code, streak=streak,
+                                 from_world=world, to_world=new_world,
+                                 round=rounds)
+                kill_with_grace(procs)
+                close_logs()
+                if log_dir:
+                    _aggregate(log_dir, "gang_shrink")
+                world = new_world
+                nprocs = world      # single-node: every rank is local
+                endpoints = ",".join(
+                    f"127.0.0.1:{_free_port()}" for _ in range(world))
+                if world > 1:
+                    master = f"127.0.0.1:{_free_port()}"
+                last_failed_rank, streak = None, 0
+                procs = [spawn(lr, respawn=True, restart_round=rounds)
+                         for lr in range(nprocs)]
+                continue
+
             if restarts >= max_restarts:
                 rc = code if code else 1
                 raise RuntimeError(
@@ -313,6 +409,7 @@ def launch_collective(args) -> int:
                     f"{'hung' if cause == 'hang' else f'exited with code {code}'}"
                     f" — restart budget ({max_restarts}) exhausted")
             restarts += 1
+            rounds += 1
             delay = backoff.backoff(restarts)
             if world > 1:
                 logger.warning(
@@ -323,14 +420,14 @@ def launch_collective(args) -> int:
                     "Whole-gang teardown+respawn cycles").inc()
                 run_journal.emit("gang_restart", failed_rank=w.rank,
                                  cause=cause, code=code, restart=restarts,
-                                 max_restarts=max_restarts,
-                                 delay_s=round(delay, 3))
+                                 max_restarts=max_restarts, world=world,
+                                 round=rounds, delay_s=round(delay, 3))
                 kill_with_grace(procs)
                 close_logs()
                 if log_dir:
                     _aggregate(log_dir, "gang_restart")
                 time.sleep(delay)
-                procs = [spawn(lr, respawn=True, restart_round=restarts)
+                procs = [spawn(lr, respawn=True, restart_round=rounds)
                          for lr in range(nprocs)]
             else:
                 logger.warning(
@@ -349,7 +446,7 @@ def launch_collective(args) -> int:
                 if w.out:
                     w.out.close()
                 procs[w.local_rank] = spawn(w.local_rank, respawn=True,
-                                            restart_round=restarts)
+                                            restart_round=rounds)
     except (RuntimeError, KeyboardInterrupt) as e:
         kill_with_grace(procs)
         if isinstance(e, RuntimeError):
@@ -360,7 +457,8 @@ def launch_collective(args) -> int:
         if journal_obj is not None:
             # per-line flush puts launch_end on disk before aggregation
             # reads the journal files back
-            journal_obj.emit("launch_end", rc=rc, restarts=restarts)
+            journal_obj.emit("launch_end", rc=rc, restarts=restarts,
+                             shrinks=shrinks, world=world)
         if log_dir:
             try:  # the gate and operators read the counters back from here
                 metrics.REGISTRY.write_json(

@@ -17,6 +17,54 @@ import os
 import re
 
 
+def ensure_shard_map_alias() -> None:
+    """Version-gated `jax.shard_map` alias shim.
+
+    jax 0.4.37 ships shard_map only as `jax.experimental.shard_map
+    .shard_map`; the top-level `jax.shard_map` alias landed in a later
+    release, and on 0.4.37 the attribute access raises AttributeError via
+    jax's deprecation `__getattr__`. Setting the real module attribute
+    shadows that hook, so every call site (compiled pipeline schedules,
+    sequence parallelism, the traced collective battery) can use the
+    forward-compatible `jax.shard_map` spelling on either version.
+
+    The experimental signature also predates the `check_vma` keyword (its
+    0.4.x spelling is `check_rep`), so the alias translates that one kwarg
+    — call sites write the current jax API and run on either version.
+
+    Idempotent and a no-op on jax versions that already export the alias.
+    Called from `paddle_tpu/__init__` right after the jax import."""
+    import inspect
+
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:
+        return  # neither spelling exists: leave the AttributeError honest
+    try:
+        params = inspect.signature(shard_map).parameters
+    except (TypeError, ValueError):
+        params = {}
+    if "check_vma" in params:
+        jax.shard_map = shard_map
+        return
+
+    def _shard_map(f, *args, **kw):
+        # check_vma=False disables the newer varying-manifest check; its
+        # 0.4.x counterpart check_rep must stay ON (default) — an unmapped
+        # out_spec (P()) is only accepted when the rep tracker can prove
+        # the output replicated, so check_rep=False would reject programs
+        # the modern API admits.
+        kw.pop("check_vma", None)
+        return shard_map(f, *args, **kw)
+
+    _shard_map.__wrapped__ = shard_map
+    jax.shard_map = _shard_map
+
+
 def with_host_device_count(flags: str, n_devices: int) -> str:
     """Return `flags` with --xla_force_host_platform_device_count set to
     exactly `n_devices`, replacing any existing value."""

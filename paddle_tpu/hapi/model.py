@@ -352,10 +352,23 @@ class Model:
                 journal_obj.close()
 
     def _save_preempt(self, path, epoch, step, it_count):
-        """Atomic preemption checkpoint: state + exact loop position."""
+        """Atomic preemption checkpoint: state + exact loop position.
+
+        World > 1: rank 0 writes alone — N ranks racing the same path
+        would interleave the aside/rename commit dance — and every rank
+        loads the result on resume, even across a topology change (the
+        engine reshards a world-mismatched store on read, emitting
+        checkpoint_reshard; docs/CHECKPOINT.md "Elastic topology
+        changes")."""
         from ..checkpoint import wait_pending
         from ..framework.random import get_rng_state
         from ..incubate.checkpoint import save_checkpoint
+        try:
+            from ..distributed.env import get_rank, get_world_size
+            if int(get_world_size()) > 1 and int(get_rank()) != 0:
+                return None
+        except Exception:
+            pass
         try:
             wait_pending()  # any async save must commit before the final one
         except Exception as e:

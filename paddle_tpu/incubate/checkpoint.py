@@ -163,12 +163,16 @@ class TrainEpochRange:
                 self._guard = None
 
     def save(self, layer=None, optimizer=None, meta=None,
-             async_: bool = False):
+             async_: bool = False, **kw):
+        """Checkpoint the pending epoch. Extra keywords pass through to
+        engine.save_checkpoint — e.g. `shard_arrays=True, barrier_fn=...`
+        for a topology-aware distributed save that restores at any world
+        size (docs/CHECKPOINT.md "Elastic topology changes")."""
         e = self._pending
         if e is None:
             raise RuntimeError("TrainEpochRange.save() outside get() loop")
         if (e + 1) % self.inter == 0 or e == self.max_epoch_num - 1:
             save_checkpoint(self._ckpt_path(e), layer, optimizer,
-                            dict(meta or {}, epoch=e), async_=async_)
+                            dict(meta or {}, epoch=e), async_=async_, **kw)
             self._epoch = e
             self.retention.apply(self.dir)

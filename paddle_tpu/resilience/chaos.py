@@ -32,10 +32,19 @@ Spec grammar: `;`-separated `name[:int[:float]]` entries —
                           2): a host-side sleep of S seconds (default
                           3600) with the heartbeat stopped, so the hang
                           detector must notice, kill it, and gang-restart
+    dead_rank:R[:K]       rank R SIGKILLs itself at step K (default 2) in
+                          EVERY restart round — a permanently-lost host
+                          that never comes back, so the launcher's
+                          shrink-to-fit must abandon it and respawn the
+                          gang at a smaller world (docs/RESILIENCE.md
+                          "Elastic topology changes")
 
 kill_rank / hang_rank fire only in restart round 0 (the launcher exports
 PADDLE_TPU_RESTART_ROUND to respawned workers), so a gang-restarted job
 resumes instead of re-killing itself into an infinite restart loop.
+dead_rank deliberately BYPASSES that gate — permanence is the fault being
+injected — and relies on the launcher's shrink respawning a world that no
+longer contains rank R.
 
 Injection sites poll this module; with the env var unset every hook is a
 cheap no-op. Counters are in-process (each injected fault fires its exact
@@ -191,8 +200,12 @@ def rank_fault_hook(rank: int, step: int) -> None:
     """Per-train-step host hook for rank-targeted gang faults
     (kill_rank:R[:K], hang_rank:R[:K[:S]]). Call with this process's rank
     and the global step BEFORE the heartbeat tick, so a hung rank's last
-    heartbeat is strictly older than its surviving peers'. No-op outside
-    restart round 0 — see the module docstring."""
+    heartbeat is strictly older than its surviving peers'. kill_rank /
+    hang_rank are no-ops outside restart round 0; dead_rank fires in
+    every round — see the module docstring."""
+    if _rank_fault("dead_rank", rank, step) is not None:
+        _flight_dump("chaos_dead", step)
+        os.kill(os.getpid(), signal.SIGKILL)
     try:
         if int(os.environ.get("PADDLE_TPU_RESTART_ROUND", "0") or 0) > 0:
             return

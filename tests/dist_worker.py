@@ -317,6 +317,71 @@ def run_gang():
     print("GANG_OK", rank)
 
 
+def run_degraded():
+    """Degraded-mode survival drill: topology-aware sharded checkpoints +
+    a permanently dead rank. Every rank trains on the SAME full batch
+    (params stay replicated), so the `shard_arrays=True` epoch save is a
+    true distributed checkpoint: each rank commits only its axis-0 slice
+    of every array. A chaos dead_rank fault fells one rank at epoch 2 in
+    EVERY round; after the streak the launcher shrinks the world and the
+    surviving gang must resume from the last-good checkpoint saved at the
+    LARGER world — the engine reassembles full arrays from the recorded
+    shard bounds (checkpoint_reshard). $PT_DIST_OUT.<rank> records the
+    world, resume epoch, and reshard counter of the final incarnation."""
+    from paddle_tpu.framework.platform import pin_host_platform
+    pin_host_platform(1, verify=False)
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.incubate.checkpoint import TrainEpochRange
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience import chaos, health
+
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    rnd = int(os.environ.get("PADDLE_TPU_RESTART_ROUND", "0") or 0)
+    ckpt_root = os.environ["PT_GANG_CKPT"]
+    bdir = os.path.join(ckpt_root, "barrier")
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.Tanh(),
+                               paddle.nn.Linear(16, 1))
+    tr = TrainEpochRange(4, "degraded", checkpoint_dir=ckpt_root)
+    tr.restore(net)
+    start = tr.restored_epoch + 1
+    resharded = metrics.counter("pt_ckpt_reshards_total").value
+
+    rs = np.random.RandomState(42)
+    X = rs.randn(8, 8).astype(np.float32)
+    Y = rs.randn(8, 1).astype(np.float32)
+    losses = []
+    for e in tr.get():
+        chaos.rank_fault_hook(rank, e)   # dead_rank fires EVERY round
+        health.tick(e, force=True)
+        _file_barrier(bdir, f"{rnd}-{e}", rank, world)
+        # full batch on every rank: the params stay bitwise replicated,
+        # which is what entitles each rank to save only its slice below
+        x, y = paddle.to_tensor(X), paddle.to_tensor(Y)
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        losses.append(float(loss.numpy()))
+        for p in net.parameters():
+            p.set_value(p.numpy() - 0.1 * p.grad.numpy())
+            p.clear_gradient()
+        tr.save(layer=net, shard_arrays=True, rank=rank, world_size=world,
+                barrier_fn=lambda: _file_barrier(
+                    bdir, f"save-{rnd}-{e}", rank, world))
+
+    out = os.environ.get("PT_DIST_OUT")
+    if out:
+        with open(f"{out}.{rank}", "w") as f:
+            json.dump({"rank": rank, "world": world, "start": start,
+                       "losses": losses, "round": rnd,
+                       "resharded": resharded}, f)
+    print("DEGRADED_OK", rank)
+
+
 def spawn_entry():
     """Entry for the paddle.distributed.spawn path (module-level so the
     mp 'spawn' start method can pickle it by reference)."""
@@ -336,6 +401,8 @@ def main():
         run_elastic()
     elif mode == "gang":
         run_gang()
+    elif mode == "degraded":
+        run_degraded()
     else:
         run_rank()
 

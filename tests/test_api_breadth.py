@@ -434,6 +434,10 @@ def test_qat_quantize_model_trains():
 
 # -------------------------------------------------------------------- text
 def test_text_datasets():
+    # restore (not delete) on exit: other modules set this at import time
+    # (test_e2e_train's 512-sample MNIST); unconditionally deleting it made
+    # every later dataset test fall back to full-size synthetic data
+    _old_synth = os.environ.get("PADDLE_TPU_SYNTH_SAMPLES")
     os.environ["PADDLE_TPU_SYNTH_SAMPLES"] = "64"
     try:
         imdb = paddle.text.Imdb(mode="train")
@@ -447,7 +451,10 @@ def test_text_datasets():
         assert trg[0] == paddle.text.WMT14.BOS and nxt[-1] == \
             paddle.text.WMT14.EOS
     finally:
-        del os.environ["PADDLE_TPU_SYNTH_SAMPLES"]
+        if _old_synth is None:
+            del os.environ["PADDLE_TPU_SYNTH_SAMPLES"]
+        else:
+            os.environ["PADDLE_TPU_SYNTH_SAMPLES"] = _old_synth
 
 
 # ---------------------------------------------------- linalg / flops / misc

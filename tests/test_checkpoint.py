@@ -289,6 +289,146 @@ class TestEngine:
 
 
 # ---------------------------------------------------------------------------
+# restore-with-reshard (topology-aware shard_arrays stores)
+# ---------------------------------------------------------------------------
+
+class TestReshardRestore:
+    """`shard_arrays=True` stores restore at ANY world size, bitwise
+    identical to a gathered restore (docs/CHECKPOINT.md "Elastic topology
+    changes"). Ranks are played sequentially in one process — rank 0 last,
+    so the global manifest commits only once every shard exists, which is
+    exactly what the real cross-rank barrier guarantees."""
+
+    def _save_world(self, path, net, opt, world, meta=None):
+        for r in reversed(range(world)):
+            engine.save_checkpoint(path, net, opt,
+                                   dict(meta or {"epoch": 1}),
+                                   shard_arrays=True, rank=r,
+                                   world_size=world, barrier_fn=lambda: None,
+                                   mesh_axes=["dp"])
+
+    def _pin_world(self, monkeypatch, world, rank=0):
+        from paddle_tpu.distributed import env as dist_env
+        monkeypatch.setattr(dist_env, "get_world_size",
+                            lambda group=None: world)
+        monkeypatch.setattr(dist_env, "get_rank", lambda group=None: rank)
+
+    @pytest.mark.parametrize("save_world,load_world",
+                             [(4, 2), (4, 1), (2, 4), (2, 3), (2, 2)])
+    def test_round_trip_across_world_sizes(self, tmp_path, monkeypatch,
+                                           save_world, load_world):
+        net, opt = _make_net(seed=11)
+        ref = engine.snapshot(net, opt, {"epoch": 1})["arrays"]
+        p = str(tmp_path / "ck")
+        self._save_world(p, net, opt, save_world)
+        man = store.read_manifest(p)
+        assert man["extras"] == {"sharded": True, "shard_arrays": True,
+                                 "world_size": save_world,
+                                 "mesh_axes": ["dp"]}
+
+        self._pin_world(monkeypatch, load_world)
+        before = _counter_value("pt_ckpt_reshards_total")
+        net2, opt2 = _make_net(seed=99)
+        meta = load_checkpoint(p, net2, opt2)
+        assert meta == {"epoch": 1}
+        delta = _counter_value("pt_ckpt_reshards_total") - before
+        assert delta == (1 if load_world != save_world else 0)
+        # the loaded params are the saved params, bitwise
+        np.testing.assert_array_equal(net2.weight.numpy(),
+                                      net.weight.numpy())
+        np.testing.assert_array_equal(net2.bias.numpy(), net.bias.numpy())
+        # and the reassembled store equals the gathered snapshot — params
+        # AND optimizer accumulators
+        got, _, _ = engine._read_verified(p)
+        assert set(got) == set(ref)
+        for k in ref:
+            assert got[k].dtype == ref[k].dtype, k
+            np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+    def test_special_arrays_survive_reshard(self, tmp_path, monkeypatch):
+        """bf16, 0-d (replicated), empty, and unevenly-divisible arrays all
+        reassemble bitwise when the world changes 2 -> 3."""
+        import ml_dtypes
+        rs = np.random.RandomState(3)
+        arrays = {
+            "bf16": rs.randn(5, 2).astype(ml_dtypes.bfloat16),
+            "scalar": np.array(2.5, np.float32),
+            "empty": np.zeros((0, 4), np.int32),
+            "odd": rs.randn(7, 3).astype(np.float32),
+        }
+        snap = {"arrays": arrays, "meta": {"epoch": 0}, "extras": {}}
+        p = str(tmp_path / "ck")
+        for r in reversed(range(2)):
+            engine._save_sharded(p, snap, r, 2, lambda: None,
+                                 shard_arrays=True)
+        self._pin_world(monkeypatch, 3)
+        out, meta, extras = engine._read_verified(p)
+        assert meta == {"epoch": 0}
+        assert set(out) == set(arrays)
+        for k in arrays:
+            assert out[k].dtype == arrays[k].dtype, k
+            assert out[k].shape == arrays[k].shape, k
+        np.testing.assert_array_equal(out["bf16"].view(np.uint16),
+                                      arrays["bf16"].view(np.uint16))
+        np.testing.assert_array_equal(out["scalar"], arrays["scalar"])
+        np.testing.assert_array_equal(out["odd"], arrays["odd"])
+        # per-array extras (layout bookkeeping) must not leak to callers
+        assert "shard_layout" not in extras
+
+    def test_corrupt_shard_quarantined_during_reshard(self, tmp_path,
+                                                      monkeypatch):
+        """Bit rot inside ONE rank's shard fails the sha256 check during
+        reassembly; the whole store is quarantined, not half-restored."""
+        net, opt = _make_net(seed=5)
+        p = str(tmp_path / "ck")
+        self._save_world(p, net, opt, 2)
+        blob = os.path.join(p, "rank_1", "blobs", "0.bin")
+        assert os.path.isfile(blob)
+        _flip_byte(blob)
+        self._pin_world(monkeypatch, 1)
+        before = _counter_value("pt_ckpt_corrupt_total")
+        with pytest.raises(CheckpointCorruptError):
+            load_checkpoint(p, nn.Linear(4, 3))
+        assert not os.path.exists(p)
+        assert os.path.isdir(p + ".corrupt")
+        assert _counter_value("pt_ckpt_corrupt_total") == before + 1
+
+    def test_fit_resumes_across_topology_change(self, tmp_path, monkeypatch):
+        """Model.fit auto-resume transparently loads a preemption ckpt
+        saved shard_arrays at world=2 while relaunched at world=1 (the
+        shrink-to-fit path)."""
+        paddle.seed(21)
+        rs = np.random.RandomState(9)
+        ds = [(rs.randn(4).astype(np.float32),
+               rs.randn(2).astype(np.float32)) for _ in range(8)]
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+        ref = net.weight.numpy().copy()
+        ckpt = os.path.join(str(tmp_path), "preempt_ckpt")
+        # a world-2 preemption checkpoint: epoch 0 fully consumed, so the
+        # resumed fit has nothing left to train and the weights must come
+        # out of the reassembled restore untouched
+        self._save_world(ckpt, net, opt, 2,
+                         meta={"epoch": 0, "step": 999, "it_count": 2})
+
+        self._pin_world(monkeypatch, 1)
+        before = _counter_value("pt_ckpt_reshards_total")
+        paddle.seed(33)
+        net2 = nn.Linear(4, 2)             # different init: resume must win
+        opt2 = paddle.optimizer.SGD(learning_rate=0.05,
+                                    parameters=net2.parameters())
+        m = paddle.Model(net2)
+        m.prepare(opt2, nn.MSELoss(), jit=True)
+        m.fit(ds, batch_size=4, epochs=1, shuffle=False, verbose=0,
+              auto_checkpoint_dir=str(tmp_path), exit_on_preempt=False)
+        assert not m.preempted
+        assert _counter_value("pt_ckpt_reshards_total") == before + 1
+        assert not os.path.isdir(ckpt + ".corrupt")
+        np.testing.assert_array_equal(net2.weight.numpy(), ref)
+
+
+# ---------------------------------------------------------------------------
 # async snapshots
 # ---------------------------------------------------------------------------
 

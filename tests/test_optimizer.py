@@ -28,7 +28,10 @@ def _quadratic_converges(opt_cls, lr=0.1, steps=60, tol=0.15, **kw):
     (optimizer.RMSProp, {}),
     (optimizer.Adagrad, {"lr": 0.9}),
     (optimizer.Adamax, {"lr": 0.3}),
-    (optimizer.Lamb, {"lr": 0.1, "lamb_weight_decay": 0.0, "steps": 300,
+    # Lamb's trust ratio keeps the late-phase step at ~lr*|w|/|r| (|r| is
+    # Adam-unit-scale even for tiny grads), so the oscillation floor around
+    # the optimum scales with lr: 0.1 stalls at ~0.2 err, 0.03 reaches 0.045
+    (optimizer.Lamb, {"lr": 0.03, "lamb_weight_decay": 0.0, "steps": 300,
                       "tol": 0.1}),
     (optimizer.Adadelta, {"lr": 8.0, "steps": 300, "tol": 0.5}),
 ])

@@ -8,6 +8,9 @@ import paddle_tpu as paddle
 from paddle_tpu.distributed.auto_parallel import (ProcessMesh, reshard,
                                                   reshard_state_dict,
                                                   shard_tensor)
+from paddle_tpu.distributed.auto_parallel.reshard import (assemble_shards,
+                                                          shard_bounds,
+                                                          shard_for_rank)
 
 
 def _dev_ids(arr):
@@ -94,3 +97,74 @@ class TestReshard:
         with pytest.raises(ValueError, match="cross-mesh|enclosing"):
             with state.mesh_guard(mesh_a.jax_mesh):
                 jax.jit(f)(np.ones((8, 4), np.float32))
+
+
+class TestHostShardMath:
+    """The pure-numpy slicing/reassembly primitives behind the checkpoint
+    engine's restore-with-reshard (docs/CHECKPOINT.md "Elastic topology
+    changes")."""
+
+    @pytest.mark.parametrize("dim0,world", [
+        (8, 2), (8, 3), (7, 3), (2, 4), (0, 2), (1, 1), (5, 5)])
+    def test_bounds_tile_axis0_exactly(self, dim0, world):
+        bounds = shard_bounds(dim0, world)
+        assert len(bounds) == world
+        assert bounds[0][0] == 0 and bounds[-1][1] == dim0
+        for (s0, e0), (s1, e1) in zip(bounds, bounds[1:]):
+            assert e0 == s1 and s0 <= e0   # contiguous, non-negative
+        # np.array_split convention, bitwise
+        sizes = [e - s for s, e in bounds]
+        assert sizes == [len(c) for c in
+                         np.array_split(np.arange(dim0), world)]
+
+    def test_bounds_reject_bad_world(self):
+        with pytest.raises(ValueError, match="world"):
+            shard_bounds(8, 0)
+
+    @pytest.mark.parametrize("shape,world", [
+        ((8, 3), 2), ((7, 2), 3), ((2,), 4), ((0, 5), 2), ((6, 2, 2), 3)])
+    def test_slice_assemble_round_trip(self, shape, world):
+        rs = np.random.RandomState(0)
+        arr = rs.randn(*shape).astype(np.float32)
+        pieces = [shard_for_rank(arr, r, world) for r in range(world)]
+        out = assemble_shards(arr.shape, arr.dtype,
+                              ((lay, sh) for sh, lay in pieces))
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == arr.dtype
+
+    def test_zero_d_is_replicated(self):
+        arr = np.float32(3.25)
+        for r in range(3):
+            sh, lay = shard_for_rank(arr, r, 3)
+            assert lay == {"replicated": True, "global_shape": []}
+            assert sh == np.float32(3.25)
+        out = assemble_shards([], np.float32, [(lay, sh)])
+        assert out.shape == () and out == np.float32(3.25)
+
+    def test_bf16_survives_round_trip(self):
+        import ml_dtypes
+        arr = np.arange(10, dtype=np.float32).astype(ml_dtypes.bfloat16
+                                                     ).reshape(5, 2)
+        pieces = [shard_for_rank(arr, r, 2) for r in range(2)]
+        out = assemble_shards(arr.shape, arr.dtype,
+                              ((lay, sh) for sh, lay in pieces))
+        assert out.dtype == arr.dtype
+        np.testing.assert_array_equal(out.view(np.uint16),
+                                      arr.view(np.uint16))
+
+    def test_partial_coverage_refused(self):
+        arr = np.ones((6, 2), np.float32)
+        pieces = [shard_for_rank(arr, r, 3) for r in range(3)]
+        with pytest.raises(ValueError, match="refusing"):
+            assemble_shards(arr.shape, arr.dtype,
+                            [(lay, sh) for sh, lay in pieces[:2]])
+
+    def test_shape_mismatch_refused(self):
+        arr = np.ones((4, 2), np.float32)
+        sh, lay = shard_for_rank(arr, 0, 2)
+        with pytest.raises(ValueError, match="bounds"):
+            assemble_shards(arr.shape, arr.dtype, [(lay, sh[:1])])
+
+    def test_zero_d_without_replicated_shard_refused(self):
+        with pytest.raises(ValueError, match="0-d"):
+            assemble_shards([], np.float32, [])

@@ -215,4 +215,64 @@ EOF
         [ "$smoke_rc" -ne 0 ] && rc=$smoke_rc || rc=1
     fi
 fi
+
+# Elastic smoke (docs/RESILIENCE.md "Elastic topology changes"): rank 1
+# dies in EVERY round (dead_rank chaos), so after one budgeted gang
+# restart the launcher must shrink-to-fit to world=1 WITHOUT exhausting
+# the budget; the survivor resumes from the last-good sharded checkpoint
+# saved at world=2 (restore-with-reshard) and finishes rc=0; ptdoctor
+# must report the topology change.
+if [ "$rc" -eq 0 ]; then
+    EL_DIR="$(mktemp -d /tmp/pt_elastic_smoke_XXXXXX)"
+    timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        PADDLE_TPU_CHAOS="dead_rank:1" \
+        PADDLE_TPU_GANG_GRACE_S=2 \
+        PT_GANG_CKPT="$EL_DIR/ckpt" \
+        PT_DIST_OUT="$EL_DIR/out.json" \
+        python -m paddle_tpu.distributed.launch \
+            --nproc_per_node 2 --max_restarts 1 \
+            --log_dir "$EL_DIR/logs" \
+            tests/dist_worker.py degraded > "$EL_DIR/launch.log" 2>&1
+    smoke_rc=$?
+    shrinks=$(python - "$EL_DIR/logs/metrics-launch.json" <<'EOF'
+import json, sys
+try:
+    data = json.load(open(sys.argv[1]))
+    print(int(data["metrics"]["pt_gang_shrinks_total"]["series"][0]["value"]))
+except Exception:
+    print(-1)
+EOF
+)
+    final=$(python - "$EL_DIR/out.json.0" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+    # the survivor finished at world=1 having resumed past the restored
+    # epoch: start>0 proves the world-2 checkpoint fed the world-1 run
+    ok = d["world"] == 1 and d["start"] > 0 and d["resharded"] >= 1
+    print("ok" if ok else d)
+except Exception as e:
+    print("err:%s" % e)
+EOF
+)
+    doctor_topo=1
+    if [ -d "$EL_DIR/logs" ]; then
+        python tools/ptdoctor.py summary "$EL_DIR/logs" \
+            > "$EL_DIR/ptdoctor.log" 2>&1 \
+            && grep -qi "shrink" "$EL_DIR/ptdoctor.log" \
+            && grep -q "2 -> 1" "$EL_DIR/ptdoctor.log"
+        doctor_topo=$?
+    fi
+    if [ "$smoke_rc" -eq 0 ] && [ "$shrinks" = "1" ] \
+            && [ "$final" = "ok" ] && [ "$doctor_topo" -eq 0 ]; then
+        echo "ELASTIC_SMOKE=ok (dead rank 1, gang_shrinks=1, resumed at world=1 from resharded ckpt, ptdoctor topology ok)"
+        rm -rf "$EL_DIR"
+    else
+        echo "ELASTIC_SMOKE=FAILED (rc=$smoke_rc gang_shrinks=$shrinks final=$final ptdoctor_topo=$doctor_topo, logs in $EL_DIR)"
+        tail -20 "$EL_DIR/launch.log"
+        [ -f "$EL_DIR/ptdoctor.log" ] && tail -20 "$EL_DIR/ptdoctor.log"
+        [ "$smoke_rc" -ne 0 ] && rc=$smoke_rc || rc=1
+    fi
+fi
 exit $rc

@@ -173,6 +173,36 @@ def cmd_summary(agg, directory) -> int:
           (len(events), span, sorted(ranks) or "none"))
     print("  restarts=%d  hangs=%d  torn_lines=%d" %
           (restarts, hangs, stats.get("skipped", 0)))
+    # topology: world size per restart round — launch_start opens round 0;
+    # a gang_shrink moves the run to a smaller world and any
+    # checkpoint_reshard shows the restore crossing the topology change
+    # (docs/RESILIENCE.md "Elastic topology changes")
+    worlds = []
+    for e in events:
+        ev = e.get("event")
+        if ev == "launch_start" and e.get("world") is not None:
+            worlds.append((0, int(e["world"])))
+        elif ev == "gang_restart" and e.get("world") is not None:
+            worlds.append((int(e.get("round", len(worlds))),
+                           int(e["world"])))
+        elif ev == "gang_shrink" and e.get("to_world") is not None:
+            worlds.append((int(e.get("round", len(worlds))),
+                           int(e["to_world"])))
+    shrink_evs = [e for e in events if e.get("event") == "gang_shrink"]
+    reshard_evs = [e for e in events
+                   if e.get("event") == "checkpoint_reshard"]
+    if len(worlds) > 1 or shrink_evs or reshard_evs:
+        print("  topology: " + "  ".join(
+            "round%d=world%d" % (rnd, w) for rnd, w in worlds))
+        for e in shrink_evs:
+            print("    shrink: world %s -> %s (rank %s %s x%s, round %s)"
+                  % (e.get("from_world"), e.get("to_world"),
+                     e.get("failed_rank"), e.get("cause"),
+                     e.get("streak"), e.get("round")))
+        for e in reshard_evs:
+            print("    reshard: world %s -> %s (%s) %s" %
+                  (e.get("from_world"), e.get("to_world"), e.get("mode"),
+                   e.get("path", "")))
     if retraces:
         print("  retraces: " + "  ".join(
             "%s=%d" % kv for kv in sorted(retraces.items())))
