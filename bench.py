@@ -77,10 +77,14 @@ def _child_main():
             "unit": "tokens/sec/chip",
             "vs_baseline": 0.0,
             "platform": platform if on_tpu else platform + " (smoke shapes)",
+            "config": res.get("config"),
             "mfu": res["mfu"],
             "step_ms": res["step_ms"],
             "step_ms_wall": res.get("step_ms_wall"),
             "compile_s": res.get("compile_s"),
+            "retraces": res.get("retraces"),
+            "feed_stall_ms": res.get("feed_stall_ms"),
+            "compile_cache": res.get("compile_cache"),
             "batch": res["batch"],
             "seq_len": res["seq_len"],
             "attn_paths": res.get("attn_paths"),
@@ -225,6 +229,47 @@ def _emit_bench_event(event, **fields):
         pass
 
 
+# Per-config compile-time / retrace budgets (ROADMAP item 5: compile time
+# as a measured contract). Ceilings are deliberately generous — they catch
+# pathological regressions (a recompile per step, a compile-time blowup),
+# not run-to-run noise. `retraces` counts executable-cache misses across
+# the whole bench (warmup included), so a cold run legitimately spends 1;
+# a warm persistent-cache run spends 0.
+BENCH_BUDGETS = {
+    # TPU configs
+    "gpt2_small_train": {"compile_s": 120.0, "retraces": 2},
+    "gpt2_long8k_train": {"compile_s": 240.0, "retraces": 2},
+    "ernie_base_amp_o2_train": {"compile_s": 120.0, "retraces": 2},
+    "resnet50_static_train": {"compile_s": 240.0, "retraces": 4},
+    # CPU smoke shapes (fallback mode): far smaller graphs
+    "gpt_tiny_train": {"compile_s": 60.0, "retraces": 2},
+    "gpt_tiny_long_train": {"compile_s": 60.0, "retraces": 2},
+    "bert_tiny_amp_o2_train": {"compile_s": 60.0, "retraces": 2},
+}
+
+
+def _budget_gates(row):
+    """compile_s / retraces vs the row's config budget. Returns {} when the
+    config has no budget or the row lacks the field (old banked captures)."""
+    budget = BENCH_BUDGETS.get(str(row.get("config") or ""), {})
+    gates = {}
+    if "compile_s" in budget and isinstance(row.get("compile_s"),
+                                            (int, float)):
+        gates["compile_budget_%.0fs" % budget["compile_s"]] = \
+            row["compile_s"] <= budget["compile_s"]
+    if "retraces" in budget and isinstance(row.get("retraces"),
+                                           (int, float)):
+        gates["retrace_budget_%d" % budget["retraces"]] = \
+            row["retraces"] <= budget["retraces"]
+    if not all(gates.values()):
+        _emit_bench_event(
+            "bench_gate_failed", config=row.get("config"),
+            gates=gates, compile_s=row.get("compile_s"),
+            retraces=row.get("retraces"),
+            compile_cache=row.get("compile_cache"))
+    return gates
+
+
 def _eval_gates(res):
     """ROADMAP item-1 acceptance gates, computed in the PARENT from the
     result JSON (the parent never imports paddle_tpu/jax): the flash path
@@ -243,6 +288,7 @@ def _eval_gates(res):
         "mfu_ge_0.35": isinstance(res.get("mfu"), (int, float))
         and res["mfu"] >= 0.35,
     }
+    gates.update(_budget_gates(res))
     gates["pass"] = all(gates.values())
     if not gates["pass"]:
         _emit_bench_event(
@@ -344,10 +390,14 @@ def main():
             "unit": "tokens/sec/chip", "vs_baseline": 0.0,
             "mode": "tpu-banked",
             "platform": "tpu (in-round capture %s)" % cap["timestamp"],
+            "config": banked_gpt2.get("config"),
             "mfu": banked_gpt2.get("mfu"),
             "step_ms": banked_gpt2.get("step_ms"),
             "step_ms_wall": banked_gpt2.get("step_ms_wall"),
             "compile_s": banked_gpt2.get("compile_s"),
+            "retraces": banked_gpt2.get("retraces"),
+            "feed_stall_ms": banked_gpt2.get("feed_stall_ms"),
+            "compile_cache": banked_gpt2.get("compile_cache"),
             "batch": banked_gpt2.get("batch"),
             "seq_len": banked_gpt2.get("seq_len"),
             "attn_paths": banked_gpt2.get("attn_paths"),
@@ -376,6 +426,11 @@ def main():
         "vs_baseline": 0.0, "error": f"{last_err}; cpu fallback: {err}"})
     out["mode"] = "cpu-fallback"
     out["tail"] = last_err
+    # throughput gates are TPU-only (CPU numbers are shapes), but the
+    # compile/retrace budget is a contract the smoke shapes must honor too
+    budget = _budget_gates(out)
+    if budget:
+        out["budget_gates"] = budget
     if cap is not None:  # capture exists but had no gpt2 row: still attach
         out["last_tpu_capture"] = {"file": cap_name, **cap}
     print(json.dumps(out))

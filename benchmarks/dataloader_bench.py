@@ -1,16 +1,32 @@
 """DataLoader throughput: thread prefetch vs multiprocess shared-memory
-workers on a decode-heavy (CPU-bound) pipeline.
+workers on a decode-heavy (CPU-bound) pipeline, plus the async device-feed
+comparison (io/prefetch.py).
 
 The thread path is GIL-bound during decode; process workers are the
 reference's answer (fluid/dataloader/dataloader_iter.py:320) and this
-framework's io/multiprocess.py. Run: python benchmarks/dataloader_bench.py
-Prints one JSON line per configuration."""
+framework's io/multiprocess.py. The device-feed arm measures what
+`prefetch_to_device` buys a training loop: per-batch feed stall
+(`pt_feed_stall_ms`) with and without the background device_put feeder
+overlapping a simulated compute step.
+
+Run: python benchmarks/dataloader_bench.py
+Prints one JSON line per configuration and ends with ONE machine-readable
+headline line (bench.py conventions: metric/value/unit/vs_baseline) so
+feed-throughput regressions are trackable like BENCH_*."""
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+# standalone `python benchmarks/dataloader_bench.py` runs put benchmarks/
+# (not the repo root) on sys.path[0]
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_METRIC = "dataloader_feed_stall_ms"
 
 
 class DecodeHeavy:
@@ -38,9 +54,8 @@ class DecodeHeavy:
         return x.transpose(2, 0, 1), np.int64(i % 10)
 
 
-def run(num_workers, batch_size=32, steps=12):
-    import paddle_tpu  # noqa: F401  (Dataset protocol)
-    from paddle_tpu.io import DataLoader
+def _make_ds():
+    import paddle_tpu
 
     class DS(paddle_tpu.io.Dataset):
         inner = DecodeHeavy()
@@ -51,27 +66,79 @@ def run(num_workers, batch_size=32, steps=12):
         def __getitem__(self, i):
             return self.inner[i]
 
-    loader = DataLoader(DS(), batch_size=batch_size,
+    return DS()
+
+
+def run(num_workers, batch_size=32, steps=12):
+    import paddle_tpu  # noqa: F401  (Dataset protocol)
+    from paddle_tpu.io import DataLoader
+
+    loader = DataLoader(_make_ds(), batch_size=batch_size,
                         num_workers=num_workers, shuffle=False)
     it = iter(loader)
     next(it)  # warm up workers
     t0 = time.perf_counter()
     n = 0
+    fetch_s = 0.0
     for _ in range(steps):
+        tb = time.perf_counter()
         batch = next(it)
+        fetch_s += time.perf_counter() - tb
         n += batch_size
     dt = time.perf_counter() - t0
+    it.close()
     return {"num_workers": num_workers,
             "samples_per_sec": round(n / dt, 1),
+            "feed_stall_ms": round(fetch_s / steps * 1e3, 3),
             "batch_size": batch_size}
 
 
+def run_device_feed(prefetch, batch_size=32, steps=10, compute_ms=60.0):
+    """One arm of the with/without-prefetch comparison: a consumer that
+    'computes' for compute_ms per batch (stand-in for a device step the
+    feeder can overlap). Both arms disable the DataLoader's own
+    buffer-reader thread so the ONLY difference is the async device feed:
+    without it the full decode+collate+device-convert cost lands in the
+    consumer's wait; with it the feeder does that work during the compute
+    window and the stall collapses toward the non-overlappable remainder."""
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.observability import tracing
+
+    loader = DataLoader(_make_ds(), batch_size=batch_size, num_workers=0,
+                        shuffle=False, use_buffer_reader=False,
+                        prefetch_to_device=2 if prefetch else 0)
+    h = tracing.FEED_STALL
+    it = iter(loader)
+    next(it)  # warm up (feeder spin-up / first decode excluded)
+    s0, c0 = h.sum, h.count
+    wait_s = 0.0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tb = time.perf_counter()
+        next(it)
+        wait_s += time.perf_counter() - tb
+        time.sleep(compute_ms / 1e3)
+    dt = time.perf_counter() - t0
+    it.close()
+    if prefetch:  # the pt_feed_stall_ms series the training loop reports
+        d = h.count - c0
+        stall_ms = (h.sum - s0) / d if d else 0.0
+    else:  # no feeder: the consumer's own fetch wait IS the stall
+        stall_ms = wait_s / steps * 1e3
+    return {"config": "device_feed_prefetch" if prefetch
+            else "device_feed_sync",
+            "prefetch_to_device": 2 if prefetch else 0,
+            "feed_stall_ms": round(stall_ms, 3),
+            "samples_per_sec": round(steps * batch_size / dt, 1),
+            "compute_ms": compute_ms, "batch_size": batch_size}
+
+
 def main():
-    import os
     print(json.dumps({"cpus": os.cpu_count(),
                       "note": "process workers need >1 core to beat the "
                               "thread path; single-core hosts measure "
                               "pure IPC overhead"}), flush=True)
+    rows = []
     base = None
     for workers in (0, 2, 4):
         try:
@@ -81,11 +148,40 @@ def main():
             elif base:
                 r["speedup_vs_thread"] = round(
                     r["samples_per_sec"] / base, 2)
+            rows.append(r)
             print(json.dumps(r), flush=True)
         except Exception as e:
             print(json.dumps({"num_workers": workers,
                               "error": f"{type(e).__name__}: {e}"}),
                   flush=True)
+    # device-feed comparison: the PR-9 contract is with < without
+    sync_arm = prefetch_arm = None
+    for prefetch in (False, True):
+        try:
+            r = run_device_feed(prefetch)
+            rows.append(r)
+            if prefetch:
+                prefetch_arm = r
+            else:
+                sync_arm = r
+            print(json.dumps(r), flush=True)
+        except Exception as e:
+            print(json.dumps({"config": "device_feed",
+                              "prefetch": prefetch,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+    # headline: ONE machine-readable line, bench.py conventions
+    out = {"metric": _METRIC,
+           "value": (prefetch_arm or {}).get("feed_stall_ms"),
+           "unit": "ms/batch", "vs_baseline": 0.0,
+           "feed_stall_ms": {
+               "with_prefetch": (prefetch_arm or {}).get("feed_stall_ms"),
+               "without_prefetch": (sync_arm or {}).get("feed_stall_ms")},
+           "results": rows}
+    if prefetch_arm and sync_arm and prefetch_arm["feed_stall_ms"] > 0:
+        out["stall_reduction_x"] = round(
+            sync_arm["feed_stall_ms"] / prefetch_arm["feed_stall_ms"], 2)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

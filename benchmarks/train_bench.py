@@ -80,7 +80,12 @@ def _gpt_train_bench(net, B, T, steps, warmup, on_tpu, config, next_batch):
     from paddle_tpu.observability import tracing
     comp = tracing.COMPILE_SECONDS.labels("jit_train")
     ihist = tracing.STEP_INTERVAL.labels("jit_train")
-    comp0 = comp.value
+    retr = tracing.RETRACES.labels("jit_train")
+    comp0, retr0 = comp.value, retr.value
+    # persistent-cache deltas: a warm PADDLE_TPU_COMPILE_CACHE_DIR run
+    # must show hits>0 / retraces==0 (the PR-9 warm-cache contract)
+    from paddle_tpu.jit import compile_cache
+    cc0 = compile_cache.totals()
 
     # attn paths from the metrics registry (pt_attn_path_total deltas) —
     # the same series ptdoctor summary reads, so a BENCH row and a
@@ -94,6 +99,7 @@ def _gpt_train_bench(net, B, T, steps, warmup, on_tpu, config, next_batch):
     attn_paths = {k: v - attn0.get(k, 0)
                   for k, v in attention_path_totals().items()}
     sum0, count0 = ihist.sum, ihist.count
+    fs_sum0, fs_count0 = tracing.FEED_STALL.sum, tracing.FEED_STALL.count
     t0 = time.perf_counter()
     for _ in range(steps):
         loss, _ = step(*next_batch())
@@ -101,6 +107,10 @@ def _gpt_train_bench(net, B, T, steps, warmup, on_tpu, config, next_batch):
     dt_wall = (time.perf_counter() - t0) / steps
     d_count = ihist.count - count0
     dt = (ihist.sum - sum0) / d_count if d_count else dt_wall
+    d_fs = tracing.FEED_STALL.count - fs_count0
+    feed_stall_ms = (round((tracing.FEED_STALL.sum - fs_sum0) / d_fs, 3)
+                     if d_fs else None)
+    cc1 = compile_cache.totals()
 
     # gpt2_small()/gpt_tiny() return GPTForPretraining wrapping .gpt
     core = getattr(net, "gpt", net)
@@ -118,6 +128,10 @@ def _gpt_train_bench(net, B, T, steps, warmup, on_tpu, config, next_batch):
             "step_ms": round(dt * 1e3, 2),
             "step_ms_wall": round(dt_wall * 1e3, 2),
             "compile_s": round(compile_s, 3),
+            "retraces": int(retr.value - retr0),
+            "feed_stall_ms": feed_stall_ms,
+            "compile_cache": {"hits": cc1[0] - cc0[0],
+                              "misses": cc1[1] - cc0[1]},
             "batch": B, "seq_len": T, "params": n_params,
             "attn_paths": attn_paths,
             "mfu": _mfu(flops, dt)}
@@ -157,9 +171,11 @@ def bench_gpt2(on_tpu):
 
     # thread prefetch path: forking workers AFTER TPU backend init is
     # unsafe (libtpu threads); the mp loader has its own benchmark
-    # (benchmarks/dataloader_bench.py)
+    # (benchmarks/dataloader_bench.py). prefetch_to_device overlaps the
+    # host->device copy with compute and makes per-batch feed starvation
+    # measurable (feed_stall_ms rides next to step_ms in the bench row)
     loader = DataLoader(TokenStream(), batch_size=B, num_workers=0,
-                        shuffle=False)
+                        shuffle=False, prefetch_to_device=2)
     it = iter(loader)
 
     def next_batch():
@@ -167,9 +183,12 @@ def bench_gpt2(on_tpu):
         ids = batch if not isinstance(batch, (list, tuple)) else batch[0]
         return [ids[:, :-1]], [ids[:, 1:]]
 
-    return _gpt_train_bench(
-        net, B, T, steps, warmup, on_tpu,
-        "gpt2_small_train" if on_tpu else "gpt_tiny_train", next_batch)
+    try:
+        return _gpt_train_bench(
+            net, B, T, steps, warmup, on_tpu,
+            "gpt2_small_train" if on_tpu else "gpt_tiny_train", next_batch)
+    finally:
+        it.close()
 
 
 def bench_gpt2_long(on_tpu):

@@ -29,6 +29,20 @@ if _forced:
 from .framework.platform import ensure_shard_map_alias as _ensure_shard_map
 _ensure_shard_map()
 
+# Persistent compilation cache: point jax at $PADDLE_TPU_COMPILE_CACHE_DIR
+# before the first compile of the process (compilation_cache.is_cache_used
+# latches its verdict then). Only the raw config flags here — jit.engine
+# is not importable this early; the hit/miss listener and telemetry probe
+# are installed by jit.compile_cache.configure() at first compile entry.
+_ccdir = _os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR")
+if _ccdir:
+    _jax.config.update("jax_compilation_cache_dir", _ccdir)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
 # dtypes
 from .framework.dtype import (bool_ as bool, uint8, int8, int16, int32,  # noqa: A004
                               int64, float16, bfloat16, float32, float64,

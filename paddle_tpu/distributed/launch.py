@@ -214,6 +214,13 @@ def launch_collective(args) -> int:
             # an operator-set telemetry home wins over the launcher's)
             env.setdefault("PADDLE_TPU_TELEMETRY_DIR", log_dir)
             env.setdefault("PADDLE_TPU_FLIGHT_DIR", log_dir)
+            # one persistent compilation cache for every rank and every
+            # restart round: a respawned gang reloads still-valid
+            # executables off disk instead of paying the compile tax
+            # again (jit/compile_cache.py). setdefault: an operator
+            # cache on faster/shared storage wins; export "" to disable.
+            env.setdefault("PADDLE_TPU_COMPILE_CACHE_DIR",
+                           os.path.join(log_dir, "compile_cache"))
             try:  # a dead incarnation's heartbeat must not damn the new one
                 os.unlink(health.heartbeat_path(log_dir, rank))
             except OSError:

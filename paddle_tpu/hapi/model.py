@@ -174,7 +174,7 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None,
             auto_checkpoint_dir=None, exit_on_preempt=True,
-            telemetry_dir=None):
+            telemetry_dir=None, device_prefetch=None):
         """Train. With `auto_checkpoint_dir` set, fit is PREEMPTION-SAFE:
         SIGTERM/SIGINT is deferred to the next batch boundary, an atomic
         checkpoint (params + optimizer + position + RNG) is written there,
@@ -188,9 +188,29 @@ class Model:
         see docs/OBSERVABILITY.md) that resilience and the jit engine emit
         into for the duration of the fit, plus a final `metrics.json`
         registry snapshot; a TelemetryCallback sampling loss/throughput/
-        device memory is installed automatically."""
+        device memory is installed automatically.
+
+        `device_prefetch` (default $PADDLE_TPU_DEVICE_PREFETCH, 2) is the
+        queue depth of the async device feed (io.prefetch): batches are
+        device_put from a background thread so host→device copies overlap
+        compute; per-batch wait shows up as `pt_feed_stall_ms`. 0 feeds
+        synchronously; sharded nets feed pre-sharded over the data axes."""
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
+        if device_prefetch is None:
+            device_prefetch = int(
+                os.environ.get("PADDLE_TPU_DEVICE_PREFETCH", "2") or 0)
+        if getattr(train_loader, "prefetch_to_device", 0):
+            device_prefetch = 0  # the DataLoader already feeds the device
+        feed_place = None
+        if device_prefetch > 0:
+            mesh = getattr(self.network, "_pt_mesh", None)
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+
+                from ..jit.engine import _batch_spec
+                feed_place = lambda arr: NamedSharding(  # noqa: E731
+                    mesh, _batch_spec(mesh, arr.ndim))
         eval_loader = self._to_loader(eval_data, batch_size, False, False,
                                       num_workers) if eval_data is not None else None
 
@@ -269,27 +289,38 @@ class Model:
                     for m in self._metrics:
                         m.reset()
                     logs = {}
-                    for step, batch in enumerate(train_loader):
-                        if epoch == resume_epoch and step <= resume_step:
-                            continue  # consumed before the preemption ckpt
-                        chaos.step_hook(it_count)
-                        health.tick(it_count)
-                        cbk.on_train_batch_begin(step)
-                        inputs, labels = self._split_batch(batch)
-                        logs = self.train_batch(inputs, labels)
-                        cbk.on_train_batch_end(step, logs)
-                        it_count += 1
-                        if anomaly is not None:
-                            anomaly.observe(logs["loss"],
-                                            skipped=self.last_step_skipped)
-                        if guard is not None and guard.triggered:
-                            self._save_preempt(ckpt_path, epoch, step,
-                                               it_count)
-                            self.preempted = True
-                            self.stop_training = True
-                            break
-                        if num_iters is not None and it_count >= num_iters:
-                            break
+                    feed = iter(train_loader)
+                    if device_prefetch > 0:
+                        from ..io.prefetch import DevicePrefetcher
+                        feed = DevicePrefetcher(feed, size=device_prefetch,
+                                                placement=feed_place)
+                    try:
+                        for step, batch in enumerate(feed):
+                            if epoch == resume_epoch and step <= resume_step:
+                                continue  # consumed before preemption ckpt
+                            chaos.step_hook(it_count)
+                            health.tick(it_count)
+                            cbk.on_train_batch_begin(step)
+                            inputs, labels = self._split_batch(batch)
+                            logs = self.train_batch(inputs, labels)
+                            cbk.on_train_batch_end(step, logs)
+                            it_count += 1
+                            if anomaly is not None:
+                                anomaly.observe(
+                                    logs["loss"],
+                                    skipped=self.last_step_skipped)
+                            if guard is not None and guard.triggered:
+                                self._save_preempt(ckpt_path, epoch, step,
+                                                   it_count)
+                                self.preempted = True
+                                self.stop_training = True
+                                break
+                            if num_iters is not None and \
+                                    it_count >= num_iters:
+                                break
+                    finally:
+                        if device_prefetch > 0:
+                            feed.close()
                     if self.preempted:
                         break
                     # epoch metrics

@@ -121,6 +121,8 @@ class Predictor:
         if sig in self._exec_cache:
             return self._exec_cache[sig]
         from ..ops.pallas_kernels import preprobe_pallas_health
+        from ..jit import compile_cache
+        compile_cache.configure()
         preprobe_pallas_health(needs_prng=False)  # eval: no dropout PRNG
         prog = self._program
         bf16 = self._config._bf16

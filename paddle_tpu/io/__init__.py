@@ -21,9 +21,11 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "DataLoaderWorkerError", "get_worker_info"]
+           "DataLoaderWorkerError", "get_worker_info",
+           "prefetch_to_device", "DevicePrefetcher"]
 
 from .multiprocess import DataLoaderWorkerError  # noqa: E402,F401
+from .prefetch import DevicePrefetcher, prefetch_to_device  # noqa: E402,F401
 
 
 class Dataset:
@@ -302,12 +304,18 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, prefetch_to_device=0,
+                 device_placement=None):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch = use_buffer_reader
         self.prefetch_factor = max(2, prefetch_factor)
+        # >0: wrap iteration in io.prefetch.DevicePrefetcher with that
+        # queue depth (async device_put feed); device_placement is its
+        # sharding (Sharding or arr->sharding callable) for world>1
+        self.prefetch_to_device = max(0, int(prefetch_to_device or 0))
+        self.device_placement = device_placement
         self.num_workers = max(0, int(num_workers))
         self.use_shared_memory = use_shared_memory
         self.worker_init_fn = worker_init_fn
@@ -387,6 +395,18 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        if self.prefetch_to_device > 0:
+            feed = DevicePrefetcher(self._host_iter(),
+                                    size=self.prefetch_to_device,
+                                    placement=self.device_placement)
+            try:
+                yield from feed
+            finally:
+                feed.close()
+        else:
+            yield from self._host_iter()
+
+    def _host_iter(self):
         # process workers + shared-memory transport (reference:
         # fluid/dataloader/dataloader_iter.py:320 multiprocess path +
         # memory/allocation/mmap_allocator.cc). GIL-free decode; iterable

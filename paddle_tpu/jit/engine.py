@@ -125,6 +125,8 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
     (the compiled replacement for the reference's Reducer
     imperative/reducer.h:130 and mp_layers' hand-inserted c_* ops)."""
     from ..ops.pallas_kernels import preprobe_pallas_health
+    from . import compile_cache
+    compile_cache.configure()
     preprobe_pallas_health()
     if mesh is None:
         mesh = getattr(network, "_pt_mesh", None)
@@ -437,6 +439,8 @@ def train_jaxpr(network, inputs):
 def make_eval_step(network, loss_fn=None, mesh=None):
     """Compile forward (+loss) for evaluation."""
     from ..ops.pallas_kernels import preprobe_pallas_health
+    from . import compile_cache
+    compile_cache.configure()
     preprobe_pallas_health(needs_prng=False)
     if mesh is None:
         mesh = getattr(network, "_pt_mesh", None)
@@ -506,6 +510,8 @@ class TracedLayer:
     """
 
     def __init__(self, fn, layer=None):
+        from . import compile_cache
+        compile_cache.configure()
         self._fn = fn
         self._layer = layer
         self._cache = {}

@@ -46,7 +46,7 @@ except ImportError:                     # standalone load by file path
 read_journal = _journal.read_journal
 
 __all__ = ["load_events", "merge_timeline", "rollup_metrics",
-           "aggregate_run", "percentile"]
+           "aggregate_run", "percentile", "restart_to_first_step"]
 
 TIMELINE = "timeline.jsonl"
 ROLLUP = "metrics-rollup.json"
@@ -109,11 +109,65 @@ def load_events(directory: str, stats: Optional[dict] = None) -> List[dict]:
     return events
 
 
+def restart_to_first_step(events: List[dict]) -> List[dict]:
+    """Per gang round: seconds from the round's first `worker_start` to
+    its first `step` event — the compile-tax number the persistent
+    compilation cache (jit/compile_cache.py) exists to shrink. Returns
+    ordered [{round, worker_start_ts, first_step_ts?, seconds?}]; a round
+    that died before stepping has no first_step_ts. Each round's step
+    window is bounded by the next round's start, so a long-lived round 0
+    can never donate steps to a round that never trained."""
+    rounds: dict = {}
+    for ev in events:
+        if ev.get("event") != "worker_start":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        try:
+            rnd = int(ev.get("restart_round") or 0)
+        except (TypeError, ValueError):
+            rnd = 0
+        entry = rounds.setdefault(rnd, {"round": rnd, "worker_start_ts": ts})
+        entry["worker_start_ts"] = min(entry["worker_start_ts"], ts)
+    ordered = [rounds[r] for r in sorted(rounds)]
+    for i, entry in enumerate(ordered):
+        lo = entry["worker_start_ts"]
+        hi = (ordered[i + 1]["worker_start_ts"]
+              if i + 1 < len(ordered) else None)
+        for ev in events:
+            if ev.get("event") != "step":
+                continue
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) or ts < lo:
+                continue
+            if hi is not None and ts >= hi:
+                continue
+            entry["first_step_ts"] = ts
+            entry["seconds"] = round(ts - lo, 6)
+            break
+    return ordered
+
+
 def merge_timeline(directory: str,
                    out_path: Optional[str] = None) -> Tuple[str, int]:
     """Write the merged monotonic timeline; returns (path, n_events).
-    Atomic tmp+rename so a reader never sees a half-written timeline."""
+    Atomic tmp+rename so a reader never sees a half-written timeline.
+    Per-round restart-to-first-step latencies are appended as synthetic
+    `restart_to_first_step` events (src=aggregate) at their first-step
+    timestamps."""
     events = load_events(directory)
+    for entry in restart_to_first_step(events):
+        if "seconds" not in entry:
+            continue
+        events.append({"ts": entry["first_step_ts"],
+                       "event": "restart_to_first_step",
+                       "round": entry["round"],
+                       "seconds": entry["seconds"],
+                       "src": "aggregate"})
+    events.sort(key=lambda r: (r.get("ts") is None,
+                               r.get("ts") if isinstance(
+                                   r.get("ts"), (int, float)) else 0.0))
     path = out_path or os.path.join(directory, TIMELINE)
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "w") as f:

@@ -35,9 +35,21 @@ from typing import Optional
 from . import flight, journal, metrics
 
 __all__ = ["enabled", "enable", "StepTelemetry", "record_sync",
-           "SYNC_SECONDS", "TRAIN_STEPS"]
+           "record_feed_stall", "set_compile_cache_probe",
+           "SYNC_SECONDS", "TRAIN_STEPS", "FEED_STALL"]
 
 _enabled = os.environ.get("PADDLE_TPU_TELEMETRY", "1") != "0"
+
+# () -> (hits, misses) of the persistent compilation cache, installed by
+# jit.compile_cache.configure(). Kept as an injected callable so this
+# module stays stdlib-pure: tracing never imports jax, the jax side
+# pushes its probe in. None == no persistent cache configured.
+_cache_probe = None
+
+
+def set_compile_cache_probe(fn) -> None:
+    global _cache_probe
+    _cache_probe = fn
 
 
 def enabled() -> bool:
@@ -71,17 +83,22 @@ SYNC_SECONDS = metrics.counter(
     "Wall time blocked on device sync (host reads of device values)")
 TRAIN_STEPS = metrics.counter(
     "pt_train_steps_total", "Train steps dispatched")
+FEED_STALL = metrics.histogram(
+    "pt_feed_stall_ms",
+    "Per-batch milliseconds the consumer waited on the input feed; mean "
+    "~0 when prefetch keeps the device fed, ~decode time when starved")
 
 
 class _Span:
     """One dispatch measurement; hand back via StepTelemetry.step()."""
 
-    __slots__ = ("tel", "miss", "t0", "_ev")
+    __slots__ = ("tel", "miss", "t0", "_ev", "cache0")
 
     def __init__(self, tel: "StepTelemetry", miss: bool):
         self.tel = tel
         self.miss = miss
         self._ev = None
+        self.cache0 = None
 
     def __enter__(self):
         if self.tel is not None:
@@ -89,6 +106,11 @@ class _Span:
                 ("compile:" if self.miss else "step:") + self.tel.engine)
             if self._ev is not None:
                 self._ev.begin()
+            if self.miss and _cache_probe is not None:
+                try:
+                    self.cache0 = _cache_probe()
+                except Exception:
+                    self.cache0 = None
             self.t0 = time.perf_counter()
         return self
 
@@ -152,14 +174,32 @@ class StepTelemetry:
 
     def _finish(self, span: _Span, dt: float):
         if span.miss:
-            self._retraces.inc()
-            self._compile_s.inc(dt)
-            # a recompile breaks the steady-state run; restart the
-            # interval chain so compile stalls don't pollute step time
+            cache_hits = cache_misses = 0
+            if span.cache0 is not None and _cache_probe is not None:
+                try:
+                    h1, m1 = _cache_probe()
+                    cache_hits = h1 - span.cache0[0]
+                    cache_misses = m1 - span.cache0[1]
+                except Exception:
+                    pass
+            # either way the stall breaks the steady-state run; restart
+            # the interval chain so it doesn't pollute step time
             self._last_hit_entry = None
-            journal.emit("retrace", engine=self.engine,
-                         compile_s=round(dt, 6),
-                         total=int(self._retraces.value))
+            self._compile_s.inc(dt)
+            if cache_hits > 0 and cache_misses == 0:
+                # every executable this dispatch needed came off the
+                # persistent cache: XLA compiled nothing, so this is a
+                # warm reload, not a retrace — the restart-tax number
+                # the cache exists to drive to zero
+                journal.emit("compile_cache", engine=self.engine,
+                             hits=cache_hits, compile_s=round(dt, 6))
+            else:
+                self._retraces.inc()
+                ev = dict(engine=self.engine, compile_s=round(dt, 6),
+                          total=int(self._retraces.value))
+                if cache_misses:
+                    ev["cache_misses"] = cache_misses
+                journal.emit("retrace", **ev)
         else:
             self._latency.observe(dt)
         flight.step_finished(self.engine, dt, span.miss)
@@ -194,3 +234,10 @@ def record_sync(seconds: float):
     """Bank wall time a host thread spent blocked on device results."""
     if _enabled:
         SYNC_SECONDS.inc(seconds)
+
+
+def record_feed_stall(ms: float):
+    """Bank milliseconds a consumer waited on the input feed (io.prefetch
+    observes every batch, 0 included, so the mean is per-batch stall)."""
+    if _enabled:
+        FEED_STALL.observe(ms)

@@ -29,12 +29,30 @@ def affine_channel(x, scale, bias, *, data_layout="NCHW"):
     return x * scale.reshape(shape) + bias.reshape(shape)
 
 
+@jax.custom_jvp
+def _frexp_with_grad(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(x.dtype)
+
+
+@_frexp_with_grad.defjvp
+def _frexp_jvp(primals, tangents):
+    # jnp.frexp has no JVP rule (its int exponent output kills autodiff);
+    # within a binade the decomposition is linear: m = x * 2^-e, so
+    # dm/dx = 2^-e, and e is piecewise constant, so de/dx = 0 — matching
+    # the finite-difference slope everywhere except the (measure-zero)
+    # binade boundaries.
+    (x,), (dx,) = primals, tangents
+    m, e = jnp.frexp(x)
+    e = e.astype(x.dtype)
+    return (m, e), (dx * jnp.exp2(-e), jnp.zeros_like(e))
+
+
 @primitive("frexp_op")
 def frexp(x):
     """x = mantissa * 2**exponent with |mantissa| in [0.5, 1) (reference:
     python/paddle/tensor/math.py frexp — both outputs in x's dtype)."""
-    m, e = jnp.frexp(x)
-    return m, e.astype(x.dtype)
+    return _frexp_with_grad(x)
 
 
 @primitive("ctc_align_op", nondiff=True)

@@ -15,7 +15,7 @@ import random as _random
 import threading
 
 __all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
-           "buffered", "firstn", "xmap_readers"]
+           "buffered", "firstn", "xmap_readers", "prefetch_to_device"]
 
 
 def cache(reader):
@@ -123,6 +123,23 @@ def buffered(reader, size):
 def firstn(reader, n):
     def r():
         return itertools.islice(reader(), n)
+
+    return r
+
+
+def prefetch_to_device(reader, size=2, placement=None):
+    """`buffered` with an async device feed: items are `jax.device_put`
+    from the feeder thread (io/prefetch.py — stall time lands in
+    `pt_feed_stall_ms`), so legacy reader pipelines get the same
+    double-buffered device feed as DataLoader(prefetch_to_device=...)."""
+
+    def r():
+        from ..io.prefetch import DevicePrefetcher
+        feed = DevicePrefetcher(reader(), size=size, placement=placement)
+        try:
+            yield from feed
+        finally:
+            feed.close()
 
     return r
 

@@ -98,6 +98,8 @@ class _CompiledProgram:
                 if getattr(opt, "_asp_decorated", False)
                 and getattr(p, "_asp_mask", None) is not None)
         from ..ops.pallas_kernels import preprobe_pallas_health
+        from ..jit import compile_cache
+        compile_cache.configure()
         preprobe_pallas_health()
         # train step: params (2) and accumulators (3) are donated — they
         # are replaced wholesale by run() after the call, so XLA may

@@ -1,0 +1,172 @@
+"""Persistent compilation cache (jit/compile_cache.py): the warm-restart
+contract (second process over the same cache dir reloads instead of
+recompiling), the retrace-vs-warm-reload reclassification inside
+StepTelemetry, and configure() plumbing.
+
+The contract test is the CI teeth of PR 9's tentpole: run the SAME tiny
+fit twice in fresh subprocesses sharing one PADDLE_TPU_COMPILE_CACHE_DIR;
+the second run must see cache hits, zero retraces and strictly less
+compile wall time — and its journal must say `compile_cache`, not
+`retrace`."""
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import paddle_tpu  # noqa: F401  (conftest pins the cpu platform)
+from paddle_tpu.jit import compile_cache
+from paddle_tpu.observability import journal as run_journal
+from paddle_tpu.observability import tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# fresh interpreter: the is_cache_used latch and executable caches are
+# per-process, so only a subprocess can model a gang restart
+CHILD = """
+import json, sys
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import compile_cache
+from paddle_tpu.observability import tracing
+
+paddle.seed(0)
+net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=net.parameters())
+m = paddle.Model(net)
+m.prepare(opt, nn.CrossEntropyLoss())
+X = np.random.RandomState(0).rand(16, 8).astype("float32")
+Y = np.zeros((16, 1), np.int64)
+ds = [(X[i], Y[i]) for i in range(16)]
+m.fit(ds, batch_size=8, epochs=1, verbose=0, telemetry_dir=sys.argv[1])
+hits, misses = compile_cache.totals()
+print(json.dumps({
+    "enabled": compile_cache.enabled(),
+    "hits": hits, "misses": misses,
+    "retraces": tracing.RETRACES.labels("jit_train").value,
+    "compile_s": tracing.COMPILE_SECONDS.labels("jit_train").value,
+}))
+"""
+
+
+def _events(tdir):
+    evs = []
+    for path in sorted(glob.glob(os.path.join(tdir, "journal-*.jsonl"))):
+        evs.extend(run_journal.read_journal(path))
+    return evs
+
+
+class TestWarmCacheContract:
+    def _fit_child(self, tmp_path, tag, cache_dir):
+        script = tmp_path / "child.py"
+        script.write_text(CHILD)
+        tdir = str(tmp_path / ("telemetry_" + tag))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   PADDLE_TPU_COMPILE_CACHE_DIR=str(cache_dir))
+        r = subprocess.run([sys.executable, str(script), tdir],
+                           capture_output=True, text=True, timeout=240,
+                           env=env, cwd=REPO)
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = [ln for ln in r.stdout.splitlines()
+                 if ln.strip().startswith("{")]
+        return json.loads(lines[-1]), tdir
+
+    def test_second_process_reloads_instead_of_recompiling(self, tmp_path):
+        cache = tmp_path / "xla_cache"
+        cold, cold_dir = self._fit_child(tmp_path, "cold", cache)
+        assert cold["enabled"]
+        assert cold["hits"] == 0
+        assert cold["misses"] >= 1          # populated the cache
+        assert cold["retraces"] >= 1        # first compile is a retrace
+        assert os.listdir(cache)            # entries actually on disk
+        cold_evs = _events(cold_dir)
+        assert any(e["event"] == "retrace" for e in cold_evs)
+
+        warm, warm_dir = self._fit_child(tmp_path, "warm", cache)
+        assert warm["hits"] >= 1            # the contract
+        assert warm["misses"] == 0
+        assert warm["retraces"] == 0        # reclassified, not counted
+        assert warm["compile_s"] < cold["compile_s"]
+        warm_evs = _events(warm_dir)
+        cc = [e for e in warm_evs if e["event"] == "compile_cache"]
+        assert cc and cc[0]["hits"] >= 1 and cc[0]["engine"] == "jit_train"
+        assert not any(e["event"] == "retrace" for e in warm_evs)
+
+
+class TestReclassification:
+    """StepTelemetry must journal a miss-span as `compile_cache` exactly
+    when the persistent cache served everything (hits>0, misses==0) —
+    and keep byte-identical retrace accounting otherwise."""
+
+    def _miss_span(self, tmp_path, engine, probe_seq):
+        j = run_journal.RunJournal(str(tmp_path))
+        prev_j = run_journal.set_journal(j)
+        seq = iter(probe_seq) if probe_seq is not None else None
+        tracing.set_compile_cache_probe(
+            (lambda: next(seq)) if seq is not None else None)
+        try:
+            tel = tracing.StepTelemetry(engine)
+            r0 = tel.retraces
+            with tel.step(("sig", 0)):
+                pass
+            return run_journal.read_journal(j.path), tel.retraces - r0
+        finally:
+            tracing.set_compile_cache_probe(
+                compile_cache.totals if compile_cache.enabled() else None)
+            run_journal.set_journal(prev_j)
+
+    def test_warm_reload_is_not_a_retrace(self, tmp_path):
+        # probe read at span entry then at finish: 2 hits, 0 misses
+        evs, dr = self._miss_span(tmp_path, "eng_warm", [(0, 0), (2, 0)])
+        assert dr == 0
+        assert [e["event"] for e in evs] == ["compile_cache"]
+        assert evs[0]["hits"] == 2 and evs[0]["engine"] == "eng_warm"
+        assert evs[0]["compile_s"] >= 0
+
+    def test_cache_miss_stays_a_retrace(self, tmp_path):
+        evs, dr = self._miss_span(tmp_path, "eng_miss", [(0, 0), (0, 1)])
+        assert dr == 1
+        assert [e["event"] for e in evs] == ["retrace"]
+        assert evs[0]["cache_misses"] == 1
+
+    def test_partial_hit_stays_a_retrace(self, tmp_path):
+        # some executables reloaded, one still compiled: that dispatch
+        # paid real XLA time, so it counts
+        evs, dr = self._miss_span(tmp_path, "eng_part", [(0, 0), (3, 1)])
+        assert dr == 1
+        assert evs[0]["event"] == "retrace"
+
+    def test_no_probe_keeps_legacy_accounting(self, tmp_path):
+        evs, dr = self._miss_span(tmp_path, "eng_nop", None)
+        assert dr == 1
+        assert evs[0]["event"] == "retrace"
+        assert "cache_misses" not in evs[0]
+
+
+class TestConfigure:
+    def test_no_env_is_noop(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_COMPILE_CACHE_DIR", raising=False)
+        was = compile_cache.enabled()
+        assert compile_cache.configure() == was
+
+    def test_configure_points_jax_at_dir(self, tmp_path):
+        import jax
+
+        prev_dir = compile_cache._configured_dir
+        prev_cfg = jax.config.jax_compilation_cache_dir
+        target = str(tmp_path / "cache")
+        try:
+            assert compile_cache.configure(target) is True
+            assert compile_cache.enabled()
+            assert compile_cache.cache_dir() == target
+            assert os.path.isdir(target)
+            assert jax.config.jax_compilation_cache_dir == target
+            # sub-second CPU compiles must be cacheable (CI contract)
+            assert jax.config.jax_persistent_cache_min_compile_time_secs == 0
+            assert compile_cache.configure(target) is True   # idempotent
+        finally:
+            compile_cache._configured_dir = prev_dir
+            jax.config.update("jax_compilation_cache_dir", prev_cfg)
+            tracing.set_compile_cache_probe(
+                compile_cache.totals if prev_dir else None)

@@ -69,6 +69,75 @@ EOF
     fi
 fi
 
+# Compile-cache smoke (docs/PERFORMANCE.md "Compile cache & input
+# pipeline"): the SAME 2-step gpt-tiny fit twice, fresh process each
+# time, sharing one PADDLE_TPU_COMPILE_CACHE_DIR. The warm run must
+# reload executables from disk: journal says compile_cache (hits >= 1),
+# retraces == 0, and compile wall time drops vs the cold run. (The
+# observability smoke above keeps the no-cache contract honest:
+# retraces == 1 when no cache dir is set.)
+if [ "$rc" -eq 0 ]; then
+    CC_DIR="$(mktemp -d /tmp/pt_cc_smoke_XXXXXX)"
+    cc_smoke_run() {
+        timeout -k 10 180 env JAX_PLATFORMS=cpu \
+            PADDLE_TPU_COMPILE_CACHE_DIR="$CC_DIR/cache" \
+            PT_CC_SMOKE_DIR="$CC_DIR" \
+            PT_CC_SMOKE_ROLE="$1" \
+            python - <<'EOF'
+import glob, json, os
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTPretrainingCriterion, gpt_tiny
+from paddle_tpu.jit import compile_cache
+from paddle_tpu.observability import read_journal, tracing
+
+role = os.environ["PT_CC_SMOKE_ROLE"]
+root = os.environ["PT_CC_SMOKE_DIR"]
+paddle.seed(0)
+m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+             intermediate_size=64, max_position_embeddings=32)
+model = paddle.Model(m)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=m.parameters()),
+              GPTPretrainingCriterion())
+ids = np.random.RandomState(0).randint(0, 64, (4, 17)).astype(np.int64)
+tdir = os.path.join(root, "telemetry_" + role)
+model.fit([(ids[i, :-1], ids[i, 1:]) for i in range(4)], batch_size=2,
+          epochs=1, verbose=0, telemetry_dir=tdir)
+
+hits, misses = compile_cache.totals()
+retraces = tracing.RETRACES.labels("jit_train").value
+compile_s = tracing.COMPILE_SECONDS.labels("jit_train").value
+evs = []
+for p in sorted(glob.glob(os.path.join(tdir, "journal-*.jsonl"))):
+    evs.extend(read_journal(p))
+assert compile_cache.enabled(), "cache not configured"
+if role == "cold":
+    assert misses >= 1 and retraces >= 1, (hits, misses, retraces)
+    with open(os.path.join(root, "cold.json"), "w") as f:
+        json.dump({"compile_s": compile_s}, f)
+else:
+    cold = json.load(open(os.path.join(root, "cold.json")))
+    cc_evs = [e for e in evs if e["event"] == "compile_cache"]
+    assert hits >= 1 and misses == 0, (hits, misses)
+    assert retraces == 0, retraces
+    assert cc_evs and cc_evs[0]["hits"] >= 1, cc_evs
+    assert not any(e["event"] == "retrace" for e in evs), evs
+    assert compile_s < cold["compile_s"], (compile_s, cold)
+    print("COMPILE_CACHE_SMOKE=ok (warm restart: hits=%d retraces=0 "
+          "compile %.2fs -> %.2fs)" % (hits, cold["compile_s"], compile_s))
+EOF
+    }
+    cc_smoke_run cold && cc_smoke_run warm
+    smoke_rc=$?
+    if [ "$smoke_rc" -ne 0 ]; then
+        echo "COMPILE_CACHE_SMOKE=FAILED (rc=$smoke_rc, logs in $CC_DIR)"
+        rc=$smoke_rc
+    else
+        rm -rf "$CC_DIR"
+    fi
+fi
+
 # Flash-attention smoke (docs/PERFORMANCE.md): a 2-step GPT-2-tiny fit
 # with interpret-mode flash dropout enabled must trace the Pallas path
 # (attn_paths.flash_dropout > 0, nothing on xla_sdpa), keep grads/loss

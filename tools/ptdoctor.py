@@ -147,6 +147,28 @@ def _counter_by_label(agg, directory, name, label):
     return totals
 
 
+def _counter_total(agg, directory, name):
+    """Sum an unlabelled counter across every metrics*.json snapshot
+    (same contract as _counter_by_label, for label-free series)."""
+    total = 0.0
+    seen = False
+    for path in agg._snapshot_files(directory):
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        meta = (snap.get("metrics") or {}).get(name) \
+            if isinstance(snap, dict) else None
+        if not isinstance(meta, dict):
+            continue
+        for s in meta.get("series", []):
+            if isinstance(s.get("value"), (int, float)):
+                total += s["value"]
+                seen = True
+    return total if seen else None
+
+
 def cmd_summary(agg, directory) -> int:
     stats = {}
     events = agg.load_events(directory, stats=stats)
@@ -206,6 +228,47 @@ def cmd_summary(agg, directory) -> int:
     if retraces:
         print("  retraces: " + "  ".join(
             "%s=%d" % kv for kv in sorted(retraces.items())))
+    # compile section: persistent-cache effectiveness + the restart tax.
+    # Counters from rank snapshots when present, else the compile_cache /
+    # retrace journal events (a journal-only dir still gets an answer).
+    cc_hits = _counter_total(agg, directory, "pt_compile_cache_hits_total")
+    cc_miss = _counter_total(agg, directory, "pt_compile_cache_misses_total")
+    if cc_hits is None and cc_miss is None:
+        ev_hits = sum(int(e.get("hits", 0) or 0) for e in events
+                      if e.get("event") == "compile_cache")
+        ev_miss = sum(int(e.get("cache_misses", 0) or 0) for e in events
+                      if e.get("event") == "retrace")
+        if ev_hits or ev_miss:
+            cc_hits, cc_miss = ev_hits, ev_miss
+    compile_s = _counter_by_label(agg, directory,
+                                  "pt_jit_compile_seconds_total", "engine")
+    if cc_hits is not None or cc_miss is not None or compile_s:
+        line = "  compile:"
+        if cc_hits is not None or cc_miss is not None:
+            line += "  cache hits=%d misses=%d" % (int(cc_hits or 0),
+                                                   int(cc_miss or 0))
+        if compile_s:
+            line += "  compile_s " + "  ".join(
+                "%s=%.2f" % (k, v) for k, v in sorted(compile_s.items()))
+        print(line)
+    # restart-to-first-step per gang round: did the warm compile cache
+    # actually shrink the restart tax? Flag rounds slower than round 0.
+    r2fs = agg.restart_to_first_step(events)
+    if len(r2fs) > 1 or (r2fs and restarts):
+        parts = []
+        base = next((e.get("seconds") for e in r2fs
+                     if e["round"] == 0 and "seconds" in e), None)
+        for entry in r2fs:
+            if "seconds" not in entry:
+                parts.append("round%d=never-stepped" % entry["round"])
+                continue
+            part = "round%d=%.1fs" % (entry["round"], entry["seconds"])
+            if (base is not None and entry["round"] != 0
+                    and entry["seconds"] > base):
+                part += " REGRESSED(+%.1fs vs round0)" % (
+                    entry["seconds"] - base)
+            parts.append(part)
+        print("  restart-to-first-step: " + "  ".join(parts))
     # attention / conv lowering mix — "is the fast path actually on?" from
     # the same counters bench.py reports (pt_attn_path_total etc.)
     attn = _counter_by_label(agg, directory, "pt_attn_path_total", "path")
