@@ -270,6 +270,34 @@ def _budget_gates(row):
     return gates
 
 
+def serving_gates(row):
+    """ISSUE 10 serving acceptance gates, computed on the
+    `inference_bench.py gpt2_generate` row (which imports this helper —
+    bench.py has no paddle_tpu/jax imports at module level, so the
+    child importing it is safe): the compile-once contract (decode
+    compiles == 1, prefill compiles <= configured buckets) and the
+    continuous-batching arm beating static sequential batching on
+    throughput. Same contract as the budget gates: a miss emits a
+    `bench_gate_failed` journal event but never breaks the one-JSON-
+    line rc-0 contract."""
+    gates = {}
+    if isinstance(row.get("decode_compiles"), (int, float)):
+        gates["decode_compile_once"] = row["decode_compiles"] == 1
+    if isinstance(row.get("prefill_compiles"), (int, float)) and \
+            isinstance(row.get("n_buckets"), (int, float)):
+        gates["prefill_le_buckets"] = \
+            row["prefill_compiles"] <= row["n_buckets"]
+    if isinstance(row.get("speedup_x"), (int, float)):
+        gates["continuous_beats_static"] = row["speedup_x"] > 1.0
+    if len(gates) < 3 or not all(gates.values()):
+        _emit_bench_event(
+            "bench_gate_failed", config=row.get("config"), gates=gates,
+            decode_compiles=row.get("decode_compiles"),
+            prefill_compiles=row.get("prefill_compiles"),
+            speedup_x=row.get("speedup_x"))
+    return gates
+
+
 def _eval_gates(res):
     """ROADMAP item-1 acceptance gates, computed in the PARENT from the
     result JSON (the parent never imports paddle_tpu/jax): the flash path

@@ -144,6 +144,98 @@ def bench_bert(on_tpu):
              "unit": "tokens/sec/chip"}]
 
 
+def bench_gpt2_generate(on_tpu):
+    """Generation serving engine (inference/serving/ — docs/SERVING.md)
+    under a synthetic open-loop arrival process: Poisson arrivals of
+    mixed-length prompts with mixed generation lengths. Three timed arms
+    over the SAME workload and engine (so compiled executables are
+    shared): continuous batching under open-loop load (the headline
+    tokens/sec + TTFT + per-request latency percentiles), then the
+    continuous-vs-static sequential batching comparison — identical
+    arrivals, the only difference being mid-flight slot admission."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (ContinuousBatcher,
+                                              GenerationEngine, Request,
+                                              run_open_loop)
+    from paddle_tpu.models import gpt2_small, gpt_tiny
+    from bench import serving_gates
+
+    if on_tpu:
+        model, mname = gpt2_small(), "gpt2-small"
+        B, max_seq, buckets = 8, 512, (32, 128, 256)
+        n_req, mean_gap, vocab = 32, 0.005, 50304
+        new_lo, new_hi = 16, 64
+    else:
+        model, mname = gpt_tiny(), "gpt-tiny"
+        B, max_seq, buckets = 4, 64, (8, 16, 32)
+        n_req, mean_gap, vocab = 16, 0.0005, 128
+        new_lo, new_hi = 2, 24
+    paddle.seed(0)
+    model.eval()
+    eng = GenerationEngine(model, max_batch=B, max_seq_len=max_seq,
+                           prefill_buckets=buckets)
+
+    # one workload, re-instantiated per arm so the arms are comparable
+    rs = np.random.RandomState(0)
+    specs = []
+    for _ in range(n_req):
+        n = int(rs.randint(2, buckets[-1] + 1))
+        mn = max(1, min(int(rs.randint(new_lo, new_hi + 1)), max_seq - n))
+        specs.append((rs.randint(0, vocab, (n,)).astype(np.int64), mn))
+    offsets = np.cumsum(rs.exponential(mean_gap, n_req)).tolist()
+
+    def arrivals():
+        return [(off, Request(prompt=p.copy(), max_new_tokens=mn))
+                for off, (p, mn) in zip(offsets, specs)]
+
+    # warmup: compile every prefill bucket + the single decode executable
+    # outside the timed arms (a serving fleet pays this once per boot —
+    # or never, off the PR 9 persistent compile cache)
+    warm = ContinuousBatcher(eng)
+    for b in buckets:
+        warm.submit(Request(prompt=np.zeros(b, np.int64) + 1,
+                            max_new_tokens=2))
+    warm.run_until_idle()
+
+    def run_arm(mid_flight):
+        batcher = ContinuousBatcher(eng, admit_mid_flight=mid_flight)
+        t0 = time.perf_counter()
+        done = run_open_loop(batcher, arrivals())
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in done)
+        return {"tokens_per_s": toks / wall,
+                "ttft_ms": [r.ttft_s * 1e3 for r in done],
+                "latency_ms": [r.latency_s * 1e3 for r in done],
+                "occupancy_mean": batcher.occupancy_mean}
+
+    cont = run_arm(mid_flight=True)
+    static = run_arm(mid_flight=False)
+
+    row = {"config": "gpt2_generate", "infer": True, "model": mname,
+           "n_requests": n_req, "max_batch": B, "max_seq_len": max_seq,
+           "buckets": list(buckets), "n_buckets": len(buckets),
+           "tokens_per_s": round(cont["tokens_per_s"], 1),
+           "ttft_ms_p50": round(float(np.percentile(cont["ttft_ms"],
+                                                    50)), 2),
+           "ttft_ms_p95": round(float(np.percentile(cont["ttft_ms"],
+                                                    95)), 2),
+           "latency_ms_p50": round(float(np.percentile(
+               cont["latency_ms"], 50)), 2),
+           "latency_ms_p95": round(float(np.percentile(
+               cont["latency_ms"], 95)), 2),
+           "occupancy_mean": round(cont["occupancy_mean"], 3),
+           "decode_compiles": eng.decode_compiles,
+           "prefill_compiles": eng.prefill_compiles,
+           "bucket_hits": {str(k): v for k, v in eng.bucket_hits.items()},
+           "continuous_tokens_per_s": round(cont["tokens_per_s"], 1),
+           "static_tokens_per_s": round(static["tokens_per_s"], 1),
+           "speedup_x": round(cont["tokens_per_s"]
+                              / max(static["tokens_per_s"], 1e-9), 2),
+           "unit": "tokens/sec/chip"}
+    row["gates"] = serving_gates(row)
+    return [row]
+
+
 def main():
     import jax
     on_tpu = jax.default_backend() == "tpu"
@@ -151,14 +243,16 @@ def main():
     print(json.dumps({"backend": jax.default_backend(),
                       "device_kind": jax.devices()[0].device_kind}),
           flush=True)
-    for name, fn in (("resnet50", bench_resnet50), ("bert", bench_bert)):
+    for name, cfg, fn in (("resnet50", "resnet50_infer", bench_resnet50),
+                          ("bert", "bert_infer", bench_bert),
+                          ("gpt2", "gpt2_generate", bench_gpt2_generate)):
         if which not in ("all", name):
             continue
         try:
             for row in fn(on_tpu):
                 print(json.dumps(row), flush=True)
         except Exception as e:
-            print(json.dumps({"config": name + "_infer",
+            print(json.dumps({"config": cfg,
                               "error": f"{type(e).__name__}: {e}"}),
                   flush=True)
 

@@ -67,6 +67,11 @@ RULES = {
     "x64-pallas-wrap": (
         "error",
         "enable_x64-style config wrap around pallas_call"),
+    "concat-growth": (
+        "warning",
+        "shape-growing concat on a loop-carried value inside a "
+        "jit-staged scope (a fresh shape every iteration -> a "
+        "compile per step; preallocate + dynamic_update_slice)"),
 }
 
 # calls whose function-valued argument becomes a traced body
@@ -77,6 +82,10 @@ _STAGING_CALLS = {
 }
 _JIT_DECORATORS = {"jit", "pjit", "to_static"}
 _HOST_SYNC_METHODS = {"item", "numpy", "tolist"}
+# functions whose result's shape is the sum of its operands' — assigning
+# one back onto an operand inside a loop grows the value's shape per
+# iteration (the generate() KV-cache hazard)
+_CONCAT_FUNCS = {"concat", "concatenate", "hstack", "vstack", "append"}
 _NP_ROOTS = {"np", "numpy", "onp"}
 _NP_SYNC_FUNCS = {"asarray", "array"}
 # names whose access chain marks an expression as shape/meta (static
@@ -365,6 +374,23 @@ class _SourceLint(ast.NodeVisitor):
         if f is not None and len(node.targets) == 1 and \
                 isinstance(node.targets[0], ast.Name):
             f.assigns[node.targets[0].id] = node.value
+        if (self._staged() and self.loop_depth > 0
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _last(_dotted(node.value.func)) in _CONCAT_FUNCS):
+            tgt = node.targets[0].id
+            refs = {n.id for a in node.value.args
+                    for n in ast.walk(a) if isinstance(n, ast.Name)}
+            if tgt in refs:
+                self._add("concat-growth", node,
+                          "%r is rebuilt by %s from itself every loop "
+                          "iteration inside a jit-staged scope — its "
+                          "shape grows per step, so each iteration is a "
+                          "fresh executable (the generate() concat-cache "
+                          "hazard); preallocate the buffer and write "
+                          "with lax.dynamic_update_slice instead" %
+                          (tgt, _dotted(node.value.func)))
         self.generic_visit(node)
 
     def visit_AugAssign(self, node):

@@ -176,6 +176,23 @@ class Predictor:
                                  [np.asarray(o) for o in outs]))
         return [Tensor(o, _internal=True) for o in outs]
 
+    def _share_clone(self) -> "Predictor":
+        """Pool member sharing this predictor's loaded program, captured
+        weights and compiled-executable cache (all read-only at serve
+        time) — only the per-call feed/result dicts are private. A pool
+        of N costs one model load and one compile per signature instead
+        of N of each."""
+        clone = object.__new__(Predictor)
+        clone._config = self._config
+        clone._program = self._program
+        clone._feed_names = list(self._feed_names)
+        clone._fetch_names = list(self._fetch_names)
+        clone._feeds = {}
+        clone._results = {}
+        clone._exec_cache = self._exec_cache
+        clone._captures = self._captures
+        return clone
+
     def export_stablehlo(self, example_inputs: Sequence[np.ndarray]) -> str:
         """Serialize the compiled computation as StableHLO text — the
         deployable artifact (reference analogue: the optimized
@@ -194,10 +211,17 @@ def create_predictor(config: Config) -> Predictor:
 
 
 class PredictorPool:
-    """reference: inference/api/paddle_inference_api.h PredictorPool."""
+    """reference: inference/api/paddle_inference_api.h PredictorPool.
+
+    The first member loads the model; the rest are `_share_clone`s —
+    weights, program and the compiled-executable cache are shared
+    (read-only at serve time), feed/result state is per-member so the
+    members stay independently usable from different threads."""
 
     def __init__(self, config: Config, size: int = 1):
-        self._preds = [Predictor(config) for _ in range(size)]
+        first = Predictor(config)
+        self._preds = [first] + [first._share_clone()
+                                 for _ in range(size - 1)]
 
     def retrieve(self, idx: int) -> Predictor:
         return self._preds[idx]

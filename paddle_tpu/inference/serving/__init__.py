@@ -1,0 +1,23 @@
+"""TPU-native generation serving (ROADMAP item 4).
+
+Static-shape paged KV cache + jitted bucketed-prefill/decode engine +
+continuous-batching scheduler + a threaded multi-worker front-end:
+
+    from paddle_tpu.models import gpt2_small
+    from paddle_tpu.inference.serving import InferenceServer
+
+    model = gpt2_small(); model.eval()
+    with InferenceServer(model, max_batch=8, max_seq_len=512,
+                         prefill_buckets=(32, 128, 512)) as srv:
+        tokens = srv.submit(prompt_ids, max_new_tokens=64).result(60)
+
+See docs/SERVING.md for architecture, knobs, and metrics.
+"""
+from .cache import LayerCacheView, PagedKVCache, bucket_for
+from .engine import GenerationEngine
+from .scheduler import ContinuousBatcher, Request, run_open_loop
+from .server import InferenceServer, ServeHandle
+
+__all__ = ["LayerCacheView", "PagedKVCache", "bucket_for",
+           "GenerationEngine", "ContinuousBatcher", "Request",
+           "run_open_loop", "InferenceServer", "ServeHandle"]

@@ -1,0 +1,92 @@
+"""Static-shape paged KV cache for the generation serving engine.
+
+The training-era decode path (`GPTForPretraining.generate`) grows its
+KV cache by `concat` every token, so each step has a NEW shape — an
+un-jittable host loop that retraces per token. Here the cache is
+preallocated at engine construction:
+
+    k/v: [n_layers, max_batch, n_heads, max_seq_len, head_dim]
+    lens: int32 [max_batch]   (tokens already resident per slot)
+
+and every update is a `jax.lax.dynamic_update_slice` at a traced
+(slot, length) index — all dynamism lives in INDICES, never in shapes
+(the DeepCompile framing: the decode step is one fixed compiled
+program). A slot is "freed" by simply overwriting it on the next
+prefill; no deallocation, no shape change, no recompile.
+
+`LayerCacheView` is the per-layer window handed to `GPTAttention`
+inside a traced serving step: the attention layer writes the step's
+K/V at each slot's length index and REPLACES `.k`/`.v` on the view
+with the updated buffers, which the engine stacks back into the cache
+state it returns from the jitted function. The view is a plain python
+carrier of traced arrays scoped to one trace — nothing escapes it.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+__all__ = ["LayerCacheView", "PagedKVCache", "bucket_for"]
+
+
+class LayerCacheView:
+    """One layer's slice of the paged cache during a traced step.
+
+    k/v: [B, n_heads, max_seq_len, head_dim] (traced); lens: int32 [B].
+    `GPTAttention.forward` detects this type (duck-typed on `.lens`),
+    writes the incoming K/V at each slot's `lens` offset, attends over
+    positions `<= lens`, and stores the updated buffers back on the
+    view."""
+
+    __slots__ = ("k", "v", "lens")
+
+    def __init__(self, k, v, lens):
+        self.k = k
+        self.v = v
+        self.lens = lens
+
+
+def bucket_for(length: int, buckets: Sequence[int]) -> int:
+    """Smallest configured prefill bucket that fits `length` tokens.
+
+    Mixed request lengths collapse onto <= len(buckets) compiled prefill
+    executables; a prompt longer than the largest bucket is a caller
+    error (raise, don't silently truncate someone's context)."""
+    for b in buckets:
+        if length <= b:
+            return int(b)
+    raise ValueError(
+        "prompt of %d tokens exceeds the largest prefill bucket %d; "
+        "configure larger prefill_buckets (each must stay <= max_seq_len)"
+        % (length, max(buckets)))
+
+
+class PagedKVCache:
+    """Host-side handle on the preallocated cache state.
+
+    Owns the device buffers between steps; the engine threads them
+    through its jitted prefill/decode executables (donated, so XLA
+    updates them in place in HBM instead of double-buffering)."""
+
+    def __init__(self, n_layers: int, max_batch: int, n_heads: int,
+                 max_seq_len: int, head_dim: int, dtype="float32"):
+        import jax.numpy as jnp
+        self.n_layers = int(n_layers)
+        self.max_batch = int(max_batch)
+        self.n_heads = int(n_heads)
+        self.max_seq_len = int(max_seq_len)
+        self.head_dim = int(head_dim)
+        shape = (self.n_layers, self.max_batch, self.n_heads,
+                 self.max_seq_len, self.head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.lens = jnp.zeros((self.max_batch,), jnp.int32)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k.nbytes) + int(self.v.nbytes) + int(self.lens.nbytes)
+
+    def state(self) -> Tuple:
+        return self.k, self.v, self.lens
+
+    def set_state(self, k, v, lens) -> None:
+        self.k, self.v, self.lens = k, v, lens

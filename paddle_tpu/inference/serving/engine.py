@@ -1,0 +1,261 @@
+"""Jitted generation engine: bucketed prefill + compile-once decode.
+
+The serving-side replacement for `GPTForPretraining.generate()`'s eager
+loop. Two executables cover all of decoding:
+
+  * prefill(bucket): one compile per configured prompt-length bucket.
+    The prompt is right-padded to the bucket on the host (exact under
+    causal attention — pad columns sit to the right of every real
+    query position), runs through the legacy concat-cache path as a
+    single forward, and the resulting per-layer K/V is inserted into
+    the paged cache at the slot index INSIDE the same executable, so
+    admission costs one dispatch and no extra compiles.
+  * decode: ONE compile, ever. All requests, all tokens, all slots run
+    the same [max_batch, 1] program; per-slot progress lives in the
+    `lens` index vector (cache.py), never in shapes.
+
+Both are wrapped in `StepTelemetry` ("serve_prefill"/"serve_decode")
+so `pt_jit_retraces_total` accounts the compile-once contract, and the
+engine additionally counts REAL jax traces (the python body runs once
+per trace) in `prefill_compiles`/`decode_compiles` — the number the
+tests and the SERVING_SMOKE gate assert on, immune to the telemetry
+kill-switch.
+
+Weights are functionalized exactly like jit/engine.py's eval step:
+parameter `_data` is swapped for traced inputs during the trace and
+restored in `finally`; at dispatch time weights pass as arguments, so
+many engines (server workers) can share one loaded model read-only.
+Cache buffers are donated — XLA updates the paged KV in place in HBM.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ...framework import state
+from ...framework.random import RNG
+from ...framework.tensor import Tensor
+from ...observability import metrics, tracing
+from . import cache as cache_mod
+
+__all__ = ["GenerationEngine"]
+
+PREFILL_BUCKET_HITS = metrics.counter(
+    "pt_serve_prefill_bucket_total",
+    "Prefills served per prompt-length bucket", labelnames=("bucket",))
+
+# Trace-time weight swapping mutates shared Layer state (`p._data`); one
+# process-wide lock serializes dispatches so server workers sharing a
+# model can never interleave a trace with another engine's dispatch.
+_DISPATCH_LOCK = threading.Lock()
+
+
+class GenerationEngine:
+    """Greedy decoding over a static-shape paged KV cache.
+
+    Host API (used by the scheduler):
+      prefill(slot, prompt) -> first generated token (admits a request)
+      decode() -> np.int32[max_batch], next token for every slot
+
+    Inactive slots keep decoding garbage into their (clamped) tail —
+    that is by design: masking slots out would put batch composition
+    into the compiled program's shape. The scheduler simply ignores
+    tokens from slots it has not admitted.
+    """
+
+    def __init__(self, model, max_batch=4, max_seq_len=128,
+                 prefill_buckets=(32, 64, 128), pad_id=0):
+        import jax
+        import jax.numpy as jnp
+        from ...jit import compile_cache
+        from ...ops.pallas_kernels import preprobe_pallas_health
+        compile_cache.configure()
+        preprobe_pallas_health(needs_prng=False)
+
+        gpt = getattr(model, "gpt", model)
+        if not hasattr(gpt, "layers") or not hasattr(gpt, "embeddings"):
+            raise TypeError(
+                "GenerationEngine expects a GPTForPretraining (or GPTModel);"
+                " got %r" % type(model).__name__)
+        model.eval()
+        self.model = model
+        self._gpt = gpt
+        self._n_layers = len(gpt.layers)
+        attn = gpt.layers[0].attn
+        self._n_heads = attn.num_heads
+        self._head_dim = attn.head_dim
+        self._hidden = gpt.hidden_size
+        self._max_pos = gpt.embeddings.position_embeddings.weight.shape[0]
+
+        buckets = sorted(set(int(b) for b in prefill_buckets))
+        if not buckets or buckets[0] < 1:
+            raise ValueError("prefill_buckets must be positive ints")
+        if max_seq_len > self._max_pos:
+            raise ValueError(
+                "max_seq_len %d exceeds the model's position table (%d)"
+                % (max_seq_len, self._max_pos))
+        if buckets[-1] > max_seq_len:
+            raise ValueError(
+                "largest prefill bucket %d exceeds max_seq_len %d"
+                % (buckets[-1], max_seq_len))
+        self.max_batch = int(max_batch)
+        self.max_seq_len = int(max_seq_len)
+        self.buckets = tuple(buckets)
+        self.pad_id = int(pad_id)
+        self.bucket_hits = {b: 0 for b in self.buckets}
+
+        from ...jit.engine import _collect_train_state
+        params, frozen, buffers, _ = _collect_train_state(model, None)
+        self._weights = params + frozen
+        self._buffers = buffers
+        self._mutable = self._weights + buffers
+
+        self.kv = cache_mod.PagedKVCache(
+            self._n_layers, self.max_batch, self._n_heads,
+            self.max_seq_len, self._head_dim)
+        self._last = jnp.zeros((self.max_batch, 1), jnp.int32)
+
+        self._traces = {"prefill": 0, "decode": 0}
+        self._prefill_tel = tracing.StepTelemetry("serve_prefill")
+        self._decode_tel = tracing.StepTelemetry("serve_decode")
+        self._jit_prefill = jax.jit(self._prefill_fn,
+                                    donate_argnums=(3, 4, 5, 6))
+        self._jit_decode = jax.jit(self._decode_fn,
+                                   donate_argnums=(3, 4, 5, 6))
+
+    # -- traced bodies ----------------------------------------------------
+
+    def _prefill_fn(self, arrs, buf_arrs, key, kc, vc, lens, last,
+                    ids, true_len, slot):
+        import jax
+        import jax.numpy as jnp
+        self._traces["prefill"] += 1
+        saved = [m._data for m in self._mutable]
+        saved_key = RNG.key
+        try:
+            for m, a in zip(self._weights, arrs):
+                m._data = a
+            for b, a in zip(self._buffers, buf_arrs):
+                b._data = a
+            RNG.key = key
+            gpt = self._gpt
+            zero = [(Tensor(jnp.zeros((1, self._n_heads, 0, self._head_dim),
+                                      jnp.float32), _internal=True),) * 2
+                    for _ in range(self._n_layers)]
+            with state.trace_guard(), state.no_grad_guard(), \
+                    state.mesh_guard(None):
+                hidden, kvs = gpt(Tensor(ids, _internal=True), None, zero)
+                from ...models.gpt import _lm_logits
+                tl = true_len.astype(jnp.int32)
+                h_last = jax.lax.dynamic_slice(
+                    hidden._data,
+                    (jnp.int32(0), tl - 1, jnp.int32(0)),
+                    (1, 1, self._hidden))
+                logits = _lm_logits(
+                    Tensor(h_last, _internal=True),
+                    gpt.embeddings.word_embeddings.weight)
+            tok = jnp.argmax(logits._data, axis=-1).astype(jnp.int32)
+            ks = jnp.stack([c[0]._data for c in kvs])   # [L,1,nh,Tb,hd]
+            vs = jnp.stack([c[1]._data for c in kvs])
+            s, z = slot.astype(jnp.int32), jnp.int32(0)
+            kc = jax.lax.dynamic_update_slice(kc, ks, (z, s, z, z, z))
+            vc = jax.lax.dynamic_update_slice(vc, vs, (z, s, z, z, z))
+            lens = jax.lax.dynamic_update_slice(
+                lens, jnp.reshape(tl, (1,)), (s,))
+            last = jax.lax.dynamic_update_slice(last, tok, (s, z))
+            return kc, vc, lens, last, tok, RNG.key
+        finally:
+            for m, a in zip(self._mutable, saved):
+                m._data = a
+            RNG.key = saved_key
+
+    def _decode_fn(self, arrs, buf_arrs, key, kc, vc, lens, last):
+        import jax.numpy as jnp
+        self._traces["decode"] += 1
+        saved = [m._data for m in self._mutable]
+        saved_key = RNG.key
+        try:
+            for m, a in zip(self._weights, arrs):
+                m._data = a
+            for b, a in zip(self._buffers, buf_arrs):
+                b._data = a
+            RNG.key = key
+            gpt = self._gpt
+            views = [cache_mod.LayerCacheView(kc[i], vc[i], lens)
+                     for i in range(self._n_layers)]
+            # new token's absolute position == tokens already resident;
+            # clamped so idle slots that hit the wall index a real row
+            pos = jnp.minimum(lens, self._max_pos - 1)[:, None]
+            with state.trace_guard(), state.no_grad_guard(), \
+                    state.mesh_guard(None):
+                hidden, _ = gpt(Tensor(last, _internal=True),
+                                Tensor(pos.astype(jnp.int32),
+                                       _internal=True), views)
+                from ...models.gpt import _lm_logits
+                logits = _lm_logits(
+                    hidden, gpt.embeddings.word_embeddings.weight)
+            tok = jnp.argmax(logits._data, axis=-1).astype(jnp.int32)
+            kc = jnp.stack([v.k for v in views])
+            vc = jnp.stack([v.v for v in views])
+            lens = jnp.minimum(lens + 1, jnp.int32(self.max_seq_len))
+            return kc, vc, lens, tok, RNG.key
+        finally:
+            for m, a in zip(self._mutable, saved):
+                m._data = a
+            RNG.key = saved_key
+
+    # -- host API ---------------------------------------------------------
+
+    def bucket_for(self, length: int) -> int:
+        return cache_mod.bucket_for(length, self.buckets)
+
+    def prefill(self, slot: int, prompt) -> int:
+        """Admit a prompt into `slot`; returns its first generated token."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = int(prompt.shape[0])
+        if n < 1:
+            raise ValueError("empty prompt")
+        if not 0 <= slot < self.max_batch:
+            raise ValueError("slot %d out of range" % slot)
+        b = self.bucket_for(n)
+        padded = np.full((1, b), self.pad_id, np.int32)
+        padded[0, :n] = prompt
+        self.bucket_hits[b] += 1
+        PREFILL_BUCKET_HITS.labels(str(b)).inc()
+        with _DISPATCH_LOCK:
+            with self._prefill_tel.step(("prefill", b)):
+                kc, vc, lens, last, tok, key = self._jit_prefill(
+                    [p._data for p in self._weights],
+                    [bf._data for bf in self._buffers], RNG.key,
+                    self.kv.k, self.kv.v, self.kv.lens, self._last,
+                    padded, np.int32(n), np.int32(slot))
+            RNG.key = key
+            self.kv.set_state(kc, vc, lens)
+            self._last = last
+        return int(np.asarray(tok)[0, 0])
+
+    def decode(self) -> np.ndarray:
+        """One decode step for the whole batch; next token per slot."""
+        with _DISPATCH_LOCK:
+            with self._decode_tel.step("decode"):
+                kc, vc, lens, tok, key = self._jit_decode(
+                    [p._data for p in self._weights],
+                    [bf._data for bf in self._buffers], RNG.key,
+                    self.kv.k, self.kv.v, self.kv.lens, self._last)
+            RNG.key = key
+            self.kv.set_state(kc, vc, lens)
+            self._last = tok
+        return np.asarray(tok).reshape(-1)
+
+    # -- compile-once contract accounting ---------------------------------
+
+    @property
+    def prefill_compiles(self) -> int:
+        """Actual jax traces of the prefill body (must stay <= n buckets)."""
+        return self._traces["prefill"]
+
+    @property
+    def decode_compiles(self) -> int:
+        """Actual jax traces of the decode body (must stay == 1)."""
+        return self._traces["decode"]

@@ -1,0 +1,223 @@
+"""Continuous-batching scheduler over the generation engine's slots.
+
+Orca-style iteration-level scheduling: the decode batch is a fixed set
+of `max_batch` slots; a finished sequence frees its slot at the end of
+the step and a queued request is admitted into it on the next step via
+one bucketed prefill — the batch stays full instead of draining to the
+slowest straggler. `admit_mid_flight=False` degrades to classic static
+batching (fill the batch, run it to empty, repeat), kept as the
+baseline arm of the bench comparison in benchmarks/inference_bench.py.
+
+All decode dispatches cost the same wall time regardless of how many
+slots are live (the compiled program is shape-fixed), so throughput is
+decided purely by how many useful tokens each step carries — which is
+exactly what `pt_serve_batch_occupancy` measures.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...observability import journal, metrics
+from .cache import bucket_for
+
+__all__ = ["Request", "ContinuousBatcher", "run_open_loop"]
+
+ADMITTED = metrics.counter(
+    "pt_serve_admitted_total", "Requests admitted into a decode slot")
+COMPLETED = metrics.counter(
+    "pt_serve_completed_total",
+    "Requests finished (max_new_tokens reached or eos emitted)")
+TOKENS = metrics.counter(
+    "pt_serve_tokens_total",
+    "Tokens generated for live requests (prefill first tokens included)")
+OCCUPANCY = metrics.gauge(
+    "pt_serve_batch_occupancy",
+    "Live slots in the decode batch after the latest scheduler step")
+TTFT = metrics.histogram(
+    "pt_serve_ttft_seconds", "Submit-to-first-token latency per request")
+REQ_SECONDS = metrics.histogram(
+    "pt_serve_request_seconds", "Submit-to-completion latency per request")
+
+_RID = itertools.count(1)
+
+
+@dataclass
+class Request:
+    """One generation request and its measured lifecycle."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+    rid: int = field(default_factory=lambda: next(_RID))
+    tokens: List[int] = field(default_factory=list)
+    submit_ts: Optional[float] = None     # set at batcher.submit()
+    ttft_s: Optional[float] = None        # submit -> first token
+    latency_s: Optional[float] = None     # submit -> completion
+    slot: Optional[int] = None
+    on_complete: Optional[Callable[["Request"], None]] = None
+
+    @property
+    def done(self) -> bool:
+        if len(self.tokens) >= self.max_new_tokens:
+            return True
+        return (self.eos_id is not None and len(self.tokens) > 0
+                and self.tokens[-1] == self.eos_id)
+
+
+class ContinuousBatcher:
+    """Slot scheduler driving one GenerationEngine.
+
+    step() == admit waiting requests into free slots (one prefill each,
+    which also yields the request's first token / TTFT), then one decode
+    dispatch for the whole batch, then harvest + free finished slots.
+    """
+
+    def __init__(self, engine, admit_mid_flight: bool = True,
+                 clock=time.perf_counter):
+        self.engine = engine
+        self.admit_mid_flight = admit_mid_flight
+        self._clock = clock
+        self.waiting: deque = deque()
+        self.slots: List[Optional[Request]] = [None] * engine.max_batch
+        self.steps = 0
+        self.live_slot_steps = 0
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        return sum(1 for r in self.slots if r is not None)
+
+    @property
+    def idle(self) -> bool:
+        return not self.waiting and self.active == 0
+
+    @property
+    def occupancy_mean(self) -> float:
+        if not self.steps:
+            return 0.0
+        return self.live_slot_steps / (self.steps * self.engine.max_batch)
+
+    def pending_requests(self) -> List[Request]:
+        return [r for r in self.slots if r is not None] + list(self.waiting)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        """Queue a request; validates it fits the engine's static shapes."""
+        prompt = np.asarray(req.prompt, np.int64).reshape(-1)
+        if prompt.shape[0] < 1:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        bucket_for(int(prompt.shape[0]), self.engine.buckets)
+        if prompt.shape[0] + req.max_new_tokens > self.engine.max_seq_len:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds max_seq_len %d"
+                % (prompt.shape[0], req.max_new_tokens,
+                   self.engine.max_seq_len))
+        if req.submit_ts is None:
+            req.submit_ts = self._clock()
+        self.waiting.append(req)
+        return req
+
+    def _complete(self, req: Request, completed: List[Request]) -> None:
+        req.latency_s = self._clock() - req.submit_ts
+        req.slot = None
+        COMPLETED.inc()
+        REQ_SECONDS.observe(req.latency_s)
+        journal.emit("serve_complete", rid=req.rid,
+                     tokens=len(req.tokens),
+                     ttft_s=round(req.ttft_s, 6),
+                     latency_s=round(req.latency_s, 6))
+        completed.append(req)
+        if req.on_complete is not None:
+            req.on_complete(req)
+
+    def _admit(self, completed: List[Request]) -> None:
+        # static batching only refills once the whole batch has drained
+        if not self.admit_mid_flight and self.active > 0:
+            return
+        for slot, r in enumerate(self.slots):
+            if not self.waiting:
+                return
+            if r is not None:
+                continue
+            req = self.waiting.popleft()
+            n = len(np.asarray(req.prompt).reshape(-1))
+            tok = self.engine.prefill(slot, req.prompt)
+            req.ttft_s = self._clock() - req.submit_ts
+            req.tokens.append(tok)
+            req.slot = slot
+            ADMITTED.inc()
+            TOKENS.inc()
+            TTFT.observe(req.ttft_s)
+            journal.emit("serve_admit", rid=req.rid, slot=slot,
+                         prompt_len=n,
+                         bucket=self.engine.bucket_for(n))
+            if req.done:          # max_new_tokens == 1 (or instant eos)
+                self._complete(req, completed)
+            else:
+                self.slots[slot] = req
+
+    def step(self) -> List[Request]:
+        """One scheduler iteration; returns requests completed by it."""
+        completed: List[Request] = []
+        self._admit(completed)
+        if self.active:
+            toks = self.engine.decode()
+            self.steps += 1
+            self.live_slot_steps += self.active
+            for slot, req in enumerate(self.slots):
+                if req is None:
+                    continue
+                req.tokens.append(int(toks[slot]))
+                TOKENS.inc()
+                if req.done:
+                    self.slots[slot] = None
+                    self._complete(req, completed)
+        OCCUPANCY.set(self.active)
+        return completed
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> List[Request]:
+        completed: List[Request] = []
+        for _ in range(max_steps):
+            if self.idle:
+                return completed
+            completed.extend(self.step())
+        raise RuntimeError("scheduler failed to drain in %d steps"
+                           % max_steps)
+
+
+def run_open_loop(batcher: ContinuousBatcher,
+                  arrivals: Sequence[Tuple[float, Request]],
+                  clock=time.perf_counter,
+                  sleep=time.sleep) -> List[Request]:
+    """Drive the batcher under an open-loop arrival process.
+
+    `arrivals` is [(offset_seconds, request)]: each request is submitted
+    once the wall clock passes its offset (independent of service rate —
+    the open-loop property), the batcher steps whenever there is live
+    work, and the call returns when everything has completed. TTFT and
+    per-request latency are measured from each request's actual submit
+    time, so queueing delay under load is included."""
+    pend = deque(sorted(arrivals, key=lambda p: p[0]))
+    completed: List[Request] = []
+    t0 = clock()
+    while pend or not batcher.idle:
+        now = clock() - t0
+        while pend and pend[0][0] <= now:
+            batcher.submit(pend.popleft()[1])
+        if batcher.idle and pend:
+            delay = pend[0][0] - (clock() - t0)
+            if delay > 0:
+                sleep(delay)
+            continue
+        completed.extend(batcher.step())
+    return completed

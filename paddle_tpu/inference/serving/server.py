@@ -1,0 +1,179 @@
+"""Minimal multi-worker serving front-end over the generation engine.
+
+A thread-per-worker serving loop fed by one shared request queue. Each
+worker owns a GenerationEngine (its own paged KV cache and slots) but
+all workers share the SAME loaded model — weights are read-only at
+serve time and pass into the jitted steps as arguments (engine.py), so
+N workers cost one copy of the weights plus N caches.
+
+Reuses the existing production machinery instead of growing parallel
+plumbing: every loop iteration calls `resilience.health.tick()` (the
+launcher's heartbeat/hang detector watches serving like it watches
+training), a crashed loop dumps a flight-recorder crash bundle before
+failing its in-flight requests, and queue depth is exported through
+the PR 2 metrics registry (`pt_serve_queue_depth`).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from ...observability import flight, metrics
+from ...resilience import health
+from .engine import GenerationEngine
+from .scheduler import ContinuousBatcher, Request
+
+__all__ = ["InferenceServer", "ServeHandle"]
+
+QUEUE_DEPTH = metrics.gauge(
+    "pt_serve_queue_depth",
+    "Requests waiting in the server queue (not yet in a decode slot)")
+
+
+class ServeHandle:
+    """Future-like handle on a submitted request."""
+
+    def __init__(self, request: Request):
+        self.request = request
+        self._event = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        self._error = error
+        self._event.set()
+
+    def _completed(self, _req) -> None:
+        self._finish()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block for the generated tokens (raises on server failure)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request %d not complete within %ss"
+                               % (self.request.rid, timeout))
+        if self._error is not None:
+            raise RuntimeError(
+                "serving loop failed while handling request %d"
+                % self.request.rid) from self._error
+        return list(self.request.tokens)
+
+
+class InferenceServer:
+    """Threaded continuous-batching server.
+
+        with InferenceServer(model, max_batch=4) as srv:
+            h = srv.submit([1, 2, 3], max_new_tokens=8)
+            tokens = h.result(timeout=60)
+    """
+
+    def __init__(self, model, max_batch: int = 4, max_seq_len: int = 128,
+                 prefill_buckets: Sequence[int] = (32, 64, 128),
+                 pad_id: int = 0, workers: int = 1,
+                 poll_s: float = 0.002):
+        if workers < 1:
+            raise ValueError("workers must be >= 1")
+        self._engines = [
+            GenerationEngine(model, max_batch=max_batch,
+                             max_seq_len=max_seq_len,
+                             prefill_buckets=prefill_buckets, pad_id=pad_id)
+            for _ in range(workers)]
+        self._queue: "queue.Queue[ServeHandle]" = queue.Queue()
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._started = False
+
+    @property
+    def engines(self) -> List[GenerationEngine]:
+        return list(self._engines)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        if self._started:
+            return self
+        self._started = True
+        for i, eng in enumerate(self._engines):
+            t = threading.Thread(target=self._loop, args=(eng,),
+                                 name="pt-serve-%d" % i, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 60.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout)
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    # -- request path -----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None) -> ServeHandle:
+        if not self._started:
+            raise RuntimeError("server not started (use start() or `with`)")
+        req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
+                      eos_id=eos_id, submit_ts=time.perf_counter())
+        handle = ServeHandle(req)
+        req.on_complete = handle._completed
+        self._queue.put(handle)
+        QUEUE_DEPTH.set(self._queue.qsize())
+        return handle
+
+    def _drain_into(self, batcher: ContinuousBatcher) -> None:
+        while True:
+            try:
+                handle = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._submit_or_fail(batcher, handle)
+        QUEUE_DEPTH.set(self._queue.qsize())
+
+    @staticmethod
+    def _submit_or_fail(batcher: ContinuousBatcher,
+                        handle: ServeHandle) -> None:
+        try:
+            batcher.submit(handle.request)
+        except Exception as exc:   # invalid request must not kill the loop
+            handle._finish(exc)
+
+    def _loop(self, engine: GenerationEngine) -> None:
+        batcher = ContinuousBatcher(engine)
+        try:
+            while True:
+                self._drain_into(batcher)
+                if batcher.idle:
+                    if self._stop.is_set():
+                        return
+                    try:
+                        handle = self._queue.get(timeout=self._poll_s)
+                    except queue.Empty:
+                        continue
+                    self._submit_or_fail(batcher, handle)
+                    continue
+                batcher.step()
+                health.tick()
+        except BaseException as exc:
+            flight.dump_crash_bundle("serve_loop", exc)
+            self._fail_pending(batcher, exc)
+            raise
+
+    @staticmethod
+    def _fail_pending(batcher: ContinuousBatcher,
+                      exc: BaseException) -> None:
+        # fail every handle this worker still owed an answer to; the
+        # completion callback is a bound ServeHandle method, so the
+        # handle is reachable from the request itself
+        for req in batcher.pending_requests():
+            handle = getattr(req.on_complete, "__self__", None)
+            if isinstance(handle, ServeHandle):
+                handle._finish(exc)

@@ -84,6 +84,41 @@ class GPTEmbeddings(Layer):
         return constrain(self.dropout(x), _seq_spec())
 
 
+def _paged_decode_attention(q, k, v, view):
+    """Single-token attention against a static-shape paged KV cache.
+
+    q/k/v: [B, nh, 1, hd]; view (inference/serving/cache.LayerCacheView)
+    carries k/v buffers [B, nh, T_max, hd] + per-slot lengths int32 [B].
+    The new K/V is written at each slot's length index with a vmapped
+    `dynamic_update_slice` (a scatter — indices are traced, shapes are
+    not), then scores over positions > lens are masked off. Replaces
+    the growing `concat` cache so the decode step compiles once.
+    """
+    import jax
+    import jax.numpy as jnp
+    qa, ka, va = q._data, k._data, v._data
+    lens = view.lens
+
+    def _write(buf, new, ln):
+        z = jnp.int32(0)
+        return jax.lax.dynamic_update_slice(
+            buf, new, (z, ln.astype(jnp.int32), z))
+
+    kb = jax.vmap(_write)(view.k, ka.astype(view.k.dtype), lens)
+    vb = jax.vmap(_write)(view.v, va.astype(view.v.dtype), lens)
+    view.k, view.v = kb, vb
+    scale = 1.0 / math.sqrt(qa.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qa.astype(jnp.float32),
+                        kb.astype(jnp.float32)) * scale
+    # the freshly written token sits AT index lens -> keep positions <= lens
+    valid = (jnp.arange(kb.shape[2])[None, None, None, :]
+             <= lens[:, None, None, None])
+    scores = jnp.where(valid, scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vb.astype(jnp.float32))
+    return Tensor(out.astype(qa.dtype), _internal=True)
+
+
 class GPTAttention(Layer):
     """Causal self-attention: fused QKV column-parallel, out row-parallel.
 
@@ -112,6 +147,14 @@ class GPTAttention(Layer):
         qkv = qkv.reshape((B, T, 3, self.num_heads, self.head_dim))
         qkv = qkv.transpose((2, 0, 3, 1, 4))        # [3, B, nh, T, hd]
         q, k, v = qkv[0], qkv[1], qkv[2]
+        if cache is not None and hasattr(cache, "lens"):
+            # serving path: static-shape paged KV cache (LayerCacheView,
+            # inference/serving/cache.py). T == 1; the write lands at each
+            # slot's length index, so the step's shapes never change.
+            out = _paged_decode_attention(q, k, v, cache)
+            out = out.transpose((0, 2, 1, 3)).reshape(
+                (B, T, self.hidden_size))
+            return self.out_proj(out), cache
         if cache is not None:
             k = mp.concat([cache[0], k], axis=2)
             v = mp.concat([cache[1], v], axis=2)

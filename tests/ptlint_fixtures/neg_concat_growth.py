@@ -1,0 +1,36 @@
+"""ptlint fixture: NEGATIVE concat-growth — concats that do NOT grow a
+loop-carried value in a staged scope: fresh operands each iteration,
+concat outside any loop, the eager host-loop decode (not jit-staged),
+and the preallocated dynamic_update_slice replacement."""
+import jax
+import jax.numpy as jnp
+
+
+def make_pack(step_fn):
+    def pack(xs, ys):
+        halves = jnp.concatenate([ys, ys], axis=0)     # no loop
+        outs = []
+        for x in xs:
+            outs.append(step_fn(x, halves))            # list append, not shape growth
+        merged = jnp.concatenate(outs, axis=0)         # operands are not the target
+        return merged
+    return jax.jit(pack)
+
+
+def eager_generate(step_fn, tokens):
+    # host-driven loop, never staged: retraces are the CALLEE's problem,
+    # flagged only when the concat itself sits in a jit-staged scope
+    for _ in range(4):
+        nxt = step_fn(tokens)
+        tokens = jnp.concatenate([tokens, nxt], axis=1)
+    return tokens
+
+
+def make_fixed_decode(step_fn, max_len):
+    def decode(tokens, cache, lens):
+        for _ in range(16):
+            nxt = step_fn(tokens, cache)
+            cache = jax.lax.dynamic_update_slice(cache, nxt, (0, lens, 0))
+            lens = lens + 1
+        return cache
+    return jax.jit(decode)

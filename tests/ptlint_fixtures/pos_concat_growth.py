@@ -1,0 +1,24 @@
+"""ptlint fixture: POSITIVE concat-growth — a loop-carried value rebuilt
+by concat inside a jit-staged scope (the generate() KV-cache hazard:
+the shape grows every iteration, so each step compiles fresh)."""
+import jax
+import jax.numpy as jnp
+
+
+def make_decode(step_fn):
+    def decode(tokens, cache):
+        for _ in range(16):
+            nxt = step_fn(tokens, cache)
+            tokens = jnp.concatenate([tokens, nxt], axis=1)    # PTLINT: concat-growth
+            cache = jnp.concatenate([cache, nxt], axis=2)      # PTLINT: concat-growth
+        return tokens
+    return jax.jit(decode)
+
+
+@jax.jit
+def rollout(state, steps):
+    trace = state[None]
+    for s in steps:
+        state = state + s
+        trace = jnp.concatenate([trace, state[None]])          # PTLINT: concat-growth
+    return trace

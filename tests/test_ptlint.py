@@ -53,10 +53,10 @@ class TestSourcePassFixtures:
         """One positive and one negative fixture exists per rule."""
         pos = " ".join(_fixture_files("pos_"))
         neg = " ".join(_fixture_files("neg_"))
-        assert len(_fixture_files("pos_")) >= 5
-        assert len(_fixture_files("neg_")) >= 5
+        assert len(_fixture_files("pos_")) >= 6
+        assert len(_fixture_files("neg_")) >= 6
         for part in ("host_sync", "tracer_leak", "hot_sync", "cache_key",
-                     "x64_wrap"):
+                     "x64_wrap", "concat_growth"):
             assert part in pos and part in neg
 
     @pytest.mark.parametrize("fname", _fixture_files("pos_"))

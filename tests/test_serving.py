@@ -1,0 +1,314 @@
+"""Generation serving engine (paddle_tpu/inference/serving/ —
+docs/SERVING.md, ROADMAP item 4).
+
+The three ISSUE 10 contracts:
+  * decode parity — the static-cache engine reproduces the legacy
+    concat-cache `generate()` token-for-token (greedy, seeded tiny GPT),
+    solo and while sharing a batch with other requests;
+  * compile-once — across a multi-request run with mixed prompt
+    lengths, the decode body traces exactly once and prefill at most
+    once per configured bucket (real jax trace counts AND the
+    pt_jit_retraces_total registry accounting);
+  * mid-flight admission — a request admitted into a half-busy batch
+    produces exactly the tokens it would have produced alone.
+
+Compiles dominate this file's runtime, so tests that do not assert
+compile counters share ONE module-cached engine (max_batch=4,
+max_seq_len=32, buckets (8, 16)) — which doubles as a standing
+slot-churn check: every test reuses slots the previous test dirtied.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import gpt_tiny
+from paddle_tpu.inference.serving import (ContinuousBatcher,
+                                          GenerationEngine,
+                                          InferenceServer, PagedKVCache,
+                                          Request, bucket_for,
+                                          run_open_loop)
+
+VOCAB = 64
+_CACHE = {}
+
+
+def _tiny():
+    if "model" not in _CACHE:
+        paddle.seed(0)
+        m = gpt_tiny(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                     num_heads=4, intermediate_size=64,
+                     max_position_embeddings=64)
+        m.eval()
+        _CACHE["model"] = m
+    return _CACHE["model"]
+
+
+def _shared_engine():
+    """One engine (3 executables) reused by every non-counter test."""
+    if "engine" not in _CACHE:
+        _CACHE["engine"] = GenerationEngine(
+            _tiny(), max_batch=4, max_seq_len=32, prefill_buckets=(8, 16))
+    return _CACHE["engine"]
+
+
+def _prompt(rs, n):
+    return rs.randint(0, VOCAB, (n,)).astype(np.int64)
+
+
+def _legacy(model, prompt, max_new):
+    """Reference output: the old eager concat-cache loop."""
+    out = model.generate(paddle.to_tensor(prompt[None]),
+                         max_new_tokens=max_new).numpy()[0]
+    return out[len(prompt):].tolist()
+
+
+class TestBuckets:
+    def test_bucket_selection_and_overflow(self):
+        assert bucket_for(3, (8, 16)) == 8
+        assert bucket_for(8, (8, 16)) == 8
+        assert bucket_for(9, (8, 16)) == 16
+        with pytest.raises(ValueError):
+            bucket_for(17, (8, 16))
+
+    def test_engine_validates_shapes(self):
+        m = _tiny()
+        with pytest.raises(ValueError):
+            GenerationEngine(m, max_seq_len=256)        # > position table
+        with pytest.raises(ValueError):
+            GenerationEngine(m, max_seq_len=16, prefill_buckets=(8, 32))
+
+    def test_scheduler_rejects_oversized_request(self):
+        b = ContinuousBatcher(_shared_engine())         # max_seq_len=32
+        with pytest.raises(ValueError):
+            b.submit(Request(prompt=[1] * 17, max_new_tokens=2))
+        with pytest.raises(ValueError):   # prompt + new tokens > max_seq
+            b.submit(Request(prompt=[1] * 16, max_new_tokens=17))
+
+    def test_paged_cache_layout(self):
+        kv = PagedKVCache(2, 3, 4, 16, 8)
+        assert kv.k.shape == (2, 3, 4, 16, 8)
+        assert kv.lens.shape == (3,)
+        assert kv.nbytes == 2 * (2 * 3 * 4 * 16 * 8) * 4 + 3 * 4
+
+
+class TestDecodeParity:
+    def test_single_request_matches_concat_cache_loop(self):
+        m = _tiny()
+        rs = np.random.RandomState(0)
+        prompt = _prompt(rs, 7)
+        want = _legacy(m, prompt, 6)
+        b = ContinuousBatcher(_shared_engine())
+        req = b.submit(Request(prompt=prompt, max_new_tokens=6))
+        b.run_until_idle()
+        assert req.tokens == want
+        assert req.ttft_s is not None and req.latency_s >= req.ttft_s
+
+    def test_batched_mixed_lengths_each_match_solo(self):
+        m = _tiny()
+        rs = np.random.RandomState(1)
+        specs = [(3, 4), (9, 3), (14, 4)]     # (prompt_len, max_new)
+        prompts = [_prompt(rs, n) for n, _ in specs]
+        want = [_legacy(m, p, mn) for p, (_, mn) in zip(prompts, specs)]
+        b = ContinuousBatcher(_shared_engine())
+        reqs = [b.submit(Request(prompt=p, max_new_tokens=mn))
+                for p, (_, mn) in zip(prompts, specs)]
+        b.run_until_idle()
+        for req, w in zip(reqs, want):
+            assert req.tokens == w
+
+
+class TestCompileOnce:
+    def test_decode_compiles_once_across_buckets_and_slot_churn(self):
+        from paddle_tpu.observability.tracing import RETRACES
+        m = _tiny()
+        rs = np.random.RandomState(2)
+        eng = GenerationEngine(m, max_batch=2, max_seq_len=48,
+                               prefill_buckets=(4, 8, 16))
+        d0 = RETRACES.labels("serve_decode").value
+        b = ContinuousBatcher(eng)
+        for n, mn in [(3, 5), (5, 3), (7, 4), (12, 6), (16, 2)]:
+            b.submit(Request(prompt=_prompt(rs, n), max_new_tokens=mn))
+        b.run_until_idle()
+        # real jax traces of the bodies: THE compile-once contract
+        assert eng.decode_compiles == 1
+        assert eng.prefill_compiles <= len(eng.buckets)
+        assert eng.prefill_compiles == 3      # buckets 4, 8 and 16 all hit
+        # registry-side accounting agrees (pt_jit_retraces_total)
+        assert RETRACES.labels("serve_decode").value - d0 == 1
+        assert eng.bucket_hits == {4: 1, 8: 2, 16: 2}
+        # three more waves through the now-dirty slots: still no retrace
+        for wave in range(3):
+            b.submit(Request(prompt=_prompt(rs, 4), max_new_tokens=3))
+            b.run_until_idle()
+        assert eng.decode_compiles == 1
+        assert eng.prefill_compiles == 3
+        assert RETRACES.labels("serve_decode").value - d0 == 1
+
+
+class TestMidFlightAdmission:
+    def test_late_request_output_unaffected_by_batch_sharing(self):
+        m = _tiny()
+        rs = np.random.RandomState(4)
+        early_p, late_p = _prompt(rs, 6), _prompt(rs, 9)
+        want_early = _legacy(m, early_p, 8)
+        want_late = _legacy(m, late_p, 4)
+
+        b = ContinuousBatcher(_shared_engine())
+        early = b.submit(Request(prompt=early_p, max_new_tokens=8))
+        for _ in range(3):            # early is mid-generation...
+            b.step()
+        assert not early.done
+        late = b.submit(Request(prompt=late_p, max_new_tokens=4))
+        b.run_until_idle()
+        # ...and neither side perturbed the other
+        assert late.tokens == want_late
+        assert early.tokens == want_early
+
+    def test_admission_waits_for_freed_slot(self):
+        eng = _shared_engine()                # 4 slots
+        rs = np.random.RandomState(5)
+        b = ContinuousBatcher(eng)
+        first = [b.submit(Request(prompt=_prompt(rs, 4), max_new_tokens=2))
+                 for _ in range(eng.max_batch)]
+        fifth = b.submit(Request(prompt=_prompt(rs, 6), max_new_tokens=2))
+        b.step()                              # batch full: fifth must wait
+        assert fifth.slot is None and len(b.pending_requests()) == 1
+        b.run_until_idle()                    # a slot frees -> admitted
+        assert all(r.done for r in first) and fifth.done
+        assert fifth.tokens == _legacy(_tiny(), np.asarray(fifth.prompt), 2)
+
+
+class TestSchedulerModes:
+    def test_static_mode_drains_before_refilling(self):
+        rs = np.random.RandomState(6)
+        eng = _shared_engine()
+        b = ContinuousBatcher(eng, admit_mid_flight=False)
+        short = b.submit(Request(prompt=_prompt(rs, 4), max_new_tokens=2))
+        long = b.submit(Request(prompt=_prompt(rs, 4), max_new_tokens=8))
+        for _ in range(eng.max_batch - 2):    # fill the first wave
+            b.submit(Request(prompt=_prompt(rs, 4), max_new_tokens=2))
+        third = b.submit(Request(prompt=_prompt(rs, 4), max_new_tokens=2))
+        b.step()
+        assert short.done is False or short.slot is None
+        while not (short.done and long.done):
+            b.step()
+            # static batching: the overflow request must NOT have started
+            # while the first wave was still draining
+            if not long.done:
+                assert third.ttft_s is None
+        b.run_until_idle()
+        assert third.done
+
+    def test_open_loop_arrivals_measure_ttft_from_arrival(self):
+        rs = np.random.RandomState(7)
+        b = ContinuousBatcher(_shared_engine())
+        arrivals = [(0.0, Request(prompt=_prompt(rs, 4),
+                                  max_new_tokens=3)) for _ in range(3)]
+        arrivals += [(0.05, Request(prompt=_prompt(rs, 5),
+                                    max_new_tokens=3))]
+        done = run_open_loop(b, arrivals)
+        assert len(done) == 4
+        assert all(r.done and r.ttft_s >= 0 for r in done)
+        assert b.occupancy_mean > 0
+
+
+class TestServer:
+    def test_staggered_requests_one_decode_compile_and_error_isolation(self):
+        m = _tiny()
+        rs = np.random.RandomState(8)
+        srv = InferenceServer(m, max_batch=2, max_seq_len=32,
+                              prefill_buckets=(8,), workers=1)
+        with srv:
+            handles = []
+            for i in range(4):
+                handles.append(srv.submit(_prompt(rs, 3 + i).tolist(),
+                                          max_new_tokens=3))
+                time.sleep(0.01)
+            results = [h.result(timeout=120) for h in handles]
+            # an invalid request fails ITS handle, not the serving loop
+            bad = srv.submit([1] * 30, max_new_tokens=8)   # over max_seq
+            good = srv.submit([1, 2, 3], max_new_tokens=2)
+            with pytest.raises(RuntimeError):
+                bad.result(timeout=60)
+            assert len(good.result(timeout=120)) == 2
+        assert all(len(r) == 3 for r in results)
+        eng = srv.engines[0]
+        assert eng.decode_compiles == 1
+        assert eng.prefill_compiles == 1
+        # parity through the whole threaded stack
+        want = _legacy(m, np.asarray(handles[0].request.prompt), 3)
+        assert results[0] == want
+
+    def test_submit_before_start_raises(self):
+        srv = InferenceServer(_tiny(), max_batch=1, max_seq_len=16,
+                              prefill_buckets=(8,))
+        with pytest.raises(RuntimeError):
+            srv.submit([1, 2], max_new_tokens=1)
+
+
+class TestServeMetrics:
+    def test_counters_and_journal_events(self, tmp_path):
+        from paddle_tpu.observability import read_journal
+        from paddle_tpu.observability import journal as journal_mod
+        from paddle_tpu.inference.serving import scheduler as sched
+        rs = np.random.RandomState(9)
+        adm0 = sched.ADMITTED.value
+        comp0 = sched.COMPLETED.value
+        tok0 = sched.TOKENS.value
+        j = journal_mod.RunJournal(str(tmp_path), filename="j.jsonl")
+        prev = journal_mod.set_journal(j)
+        try:
+            b = ContinuousBatcher(_shared_engine())
+            for _ in range(2):
+                b.submit(Request(prompt=_prompt(rs, 4),
+                                 max_new_tokens=3))
+            b.run_until_idle()
+        finally:
+            journal_mod.set_journal(prev)
+            j.close()
+        assert sched.ADMITTED.value - adm0 == 2
+        assert sched.COMPLETED.value - comp0 == 2
+        assert sched.TOKENS.value - tok0 == 6
+        evs = read_journal(str(tmp_path / "j.jsonl"))
+        kinds = [e["event"] for e in evs]
+        assert kinds.count("serve_admit") == 2
+        assert kinds.count("serve_complete") == 2
+        adm = next(e for e in evs if e["event"] == "serve_admit")
+        assert adm["prompt_len"] == 4 and adm["bucket"] == 8
+        done = next(e for e in evs if e["event"] == "serve_complete")
+        assert done["tokens"] == 3 and done["latency_s"] >= 0
+
+
+class TestPredictorPoolSharing:
+    def test_pool_members_share_program_and_executables(self, tmp_path):
+        import paddle_tpu.inference as infer
+        from paddle_tpu import nn, static
+        paddle.enable_static()
+        static.reset_default_programs()
+        try:
+            paddle.seed(0)
+            x = static.data("x", [-1, 4], "float32")
+            y = nn.Linear(4, 2)(x)
+            exe = static.Executor()
+            exe.run(static.default_startup_program())
+            prefix = str(tmp_path / "m")
+            static.save_inference_model(prefix, [x], [y], exe)
+        finally:
+            paddle.disable_static()
+        pool = infer.PredictorPool(infer.Config(prefix), size=3)
+        a, b, c = (pool.retrieve(i) for i in range(3))
+        # one model load: captured weights + program shared by identity
+        assert a._captures is b._captures is c._captures
+        assert a._program is b._program is c._program
+        # one compile serves the whole pool
+        arr = np.ones((2, 4), np.float32)
+        out_a = a.run([arr])[0].numpy()
+        assert len(a._exec_cache) == 1
+        out_b = b.run([arr])[0].numpy()
+        assert b._exec_cache is a._exec_cache
+        assert len(a._exec_cache) == 1     # member b hit a's executable
+        np.testing.assert_allclose(out_a, out_b)
+        # per-member feed/result state stays private
+        assert a._feeds is not b._feeds and a._results is not b._results

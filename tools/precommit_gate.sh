@@ -344,4 +344,81 @@ EOF
         [ "$smoke_rc" -ne 0 ] && rc=$smoke_rc || rc=1
     fi
 fi
+
+# Serving smoke (docs/SERVING.md): 4 staggered requests through the
+# threaded InferenceServer must all complete with their full token
+# budget, the decode step must compile exactly ONCE (a second trace in
+# the fixed-shape decode loop is a retrace bug), and the gpt2_generate
+# bench must emit a valid gated JSON row where continuous batching
+# beats static sequential batching on the same open-loop workload.
+if [ "$rc" -eq 0 ]; then
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import time
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import InferenceServer
+from paddle_tpu.models import gpt_tiny
+from paddle_tpu.observability.tracing import RETRACES
+
+paddle.seed(0)
+m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+             intermediate_size=64, max_position_embeddings=64)
+m.eval()
+rs = np.random.RandomState(0)
+with InferenceServer(m, max_batch=4, max_seq_len=64,
+                     prefill_buckets=(8, 16)) as srv:
+    handles = []
+    for n in (3, 6, 9, 12):   # staggered -> mid-flight slot admission
+        handles.append(srv.submit(rs.randint(0, 64, (n,)), max_new_tokens=5))
+        time.sleep(0.02)
+    toks = [h.result(timeout=120) for h in handles]
+    eng = srv.engines[0]
+assert all(len(t) == 5 for t in toks), [len(t) for t in toks]
+assert eng.decode_compiles == 1, eng.decode_compiles
+assert eng.prefill_compiles <= 2, eng.prefill_compiles   # <= n_buckets
+# retraces==0 after the first compile: the counter holds ONLY that one
+assert RETRACES.labels("serve_decode").value == 1.0, \
+    RETRACES.labels("serve_decode").value
+print("SERVING_SMOKE=ok (4 staggered requests complete, decode compiled "
+      "once, prefill compiles=%d/2 buckets)" % eng.prefill_compiles)
+EOF
+    smoke_rc=$?
+    if [ "$smoke_rc" -ne 0 ]; then
+        echo "SERVING_SMOKE=FAILED (rc=$smoke_rc)"
+        rc=$smoke_rc
+    fi
+fi
+
+# Serving bench gate: the capture artifact row must parse and its gates
+# must hold (decode_compile_once, prefill_le_buckets,
+# continuous_beats_static) — bench.py emits bench_gate_failed otherwise.
+if [ "$rc" -eq 0 ]; then
+    SERVE_LOG="$(mktemp /tmp/pt_serve_bench_XXXXXX.json)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python benchmarks/inference_bench.py gpt2 > "$SERVE_LOG" 2>&1
+    bench_rc=$?
+    if [ "$bench_rc" -eq 0 ]; then
+        python - "$SERVE_LOG" <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+row = next(r for r in rows if r.get("config") == "gpt2_generate")
+assert "error" not in row, row
+for k in ("tokens_per_s", "ttft_ms_p50", "ttft_ms_p95", "latency_ms_p50",
+          "latency_ms_p95", "speedup_x", "gates"):
+    assert k in row, (k, sorted(row))
+assert row["gates"] and all(row["gates"].values()), row["gates"]
+print("SERVING_BENCH=ok (%.0f tok/s, ttft p50=%.0fms, "
+      "continuous/static=%.2fx)" % (row["tokens_per_s"],
+                                    row["ttft_ms_p50"], row["speedup_x"]))
+EOF
+        bench_rc=$?
+    fi
+    if [ "$bench_rc" -ne 0 ]; then
+        echo "SERVING_BENCH=FAILED (rc=$bench_rc, log in $SERVE_LOG)"
+        tail -5 "$SERVE_LOG"
+        rc=$bench_rc
+    else
+        rm -f "$SERVE_LOG"
+    fi
+fi
 exit $rc

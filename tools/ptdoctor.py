@@ -296,6 +296,20 @@ def cmd_summary(agg, directory) -> int:
             or "(reasons only)"))
         for tier in sorted(reasons):
             print("    %s: %s" % (tier, reasons[tier]))
+    # serving: request/token counters + the prefill bucket mix from the
+    # generation engine's pt_serve_* series (docs/SERVING.md)
+    admitted = _counter_total(agg, directory, "pt_serve_admitted_total")
+    completed = _counter_total(agg, directory, "pt_serve_completed_total")
+    serve_toks = _counter_total(agg, directory, "pt_serve_tokens_total")
+    serve_buckets = _counter_by_label(
+        agg, directory, "pt_serve_prefill_bucket_total", "bucket")
+    if admitted is not None or completed is not None or serve_buckets:
+        print("  serving: admitted=%d  completed=%d  tokens=%d" % (
+            int(admitted or 0), int(completed or 0), int(serve_toks or 0)))
+        if serve_buckets:
+            print("    prefill buckets: " + "  ".join(
+                "%s=%d" % (k, int(v)) for k, v in sorted(
+                    serve_buckets.items(), key=lambda kv: int(kv[0]))))
     # static-analysis findings recorded into this run dir (ptlint
     # --telemetry-dir, or emit_findings from a test harness)
     lint = _counter_by_label(agg, directory, "pt_lint_findings_total",
