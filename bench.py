@@ -85,6 +85,7 @@ def _child_main():
             "retraces": res.get("retraces"),
             "feed_stall_ms": res.get("feed_stall_ms"),
             "compile_cache": res.get("compile_cache"),
+            "span_breakdown": res.get("span_breakdown"),
             "batch": res["batch"],
             "seq_len": res["seq_len"],
             "attn_paths": res.get("attn_paths"),
@@ -426,6 +427,7 @@ def main():
             "retraces": banked_gpt2.get("retraces"),
             "feed_stall_ms": banked_gpt2.get("feed_stall_ms"),
             "compile_cache": banked_gpt2.get("compile_cache"),
+            "span_breakdown": banked_gpt2.get("span_breakdown"),
             "batch": banked_gpt2.get("batch"),
             "seq_len": banked_gpt2.get("seq_len"),
             "attn_paths": banked_gpt2.get("attn_paths"),

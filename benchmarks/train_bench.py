@@ -90,6 +90,19 @@ def _gpt_train_bench(net, B, T, steps, warmup, on_tpu, config, next_batch):
     # attn paths from the metrics registry (pt_attn_path_total deltas) —
     # the same series ptdoctor summary reads, so a BENCH row and a
     # post-mortem can never disagree about which attention impl traced
+    # span breakdown: pt_span_ms deltas across the whole bench, so the
+    # BENCH row carries the same "where did the time go" decomposition
+    # ptdoctor profile renders (compile/dispatch/feed_wait/... ms + n)
+    from paddle_tpu.observability import spans as obs_spans
+
+    def _span_totals():
+        out = {}
+        for lbls, child in obs_spans.SPAN_MS._series():
+            out[lbls.get("name", "")] = (child.sum, child.count)
+        return out
+
+    sp0 = _span_totals()
+
     from paddle_tpu.ops.pallas_kernels import attention_path_totals
     attn0 = attention_path_totals()
     for _ in range(warmup):
@@ -111,6 +124,11 @@ def _gpt_train_bench(net, B, T, steps, warmup, on_tpu, config, next_batch):
     feed_stall_ms = (round((tracing.FEED_STALL.sum - fs_sum0) / d_fs, 3)
                      if d_fs else None)
     cc1 = compile_cache.totals()
+    span_breakdown = {}
+    for name, (s1, c1) in _span_totals().items():
+        s0, c0 = sp0.get(name, (0.0, 0))
+        if c1 > c0:
+            span_breakdown[name] = {"ms": round(s1 - s0, 3), "n": c1 - c0}
 
     # gpt2_small()/gpt_tiny() return GPTForPretraining wrapping .gpt
     core = getattr(net, "gpt", net)
@@ -132,6 +150,7 @@ def _gpt_train_bench(net, B, T, steps, warmup, on_tpu, config, next_batch):
             "feed_stall_ms": feed_stall_ms,
             "compile_cache": {"hits": cc1[0] - cc0[0],
                               "misses": cc1[1] - cc0[1]},
+            "span_breakdown": span_breakdown or None,
             "batch": B, "seq_len": T, "params": n_params,
             "attn_paths": attn_paths,
             "mfu": _mfu(flops, dt)}

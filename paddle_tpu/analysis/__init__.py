@@ -9,7 +9,10 @@ Two halves (docs/STATIC_ANALYSIS.md):
   * jaxpr_pass — imports jax; walks a traced train step's ClosedJaxpr
     and lowering metadata for compiler-visible performance hazards:
     missing buffer donation, step-boundary sharding mismatches, silent
-    bf16 upcasts, uncancelled transpose pairs.
+    bf16 upcasts, uncancelled transpose pairs, exposed collectives.
+  * cost_pass — the step-cost profiler: per-step "step card" (FLOPs,
+    HBM bytes, collective inventory, dominant-eqn ranking) plus the
+    exposed-collective detector the jaxpr rules report through.
 
 `findings` is the shared record/baseline/emission layer. The CLI is
 tools/ptlint.py; tools/precommit_gate.sh gates on unsuppressed
@@ -28,14 +31,21 @@ __all__ = [
     "assign_indices", "load_baseline", "apply_baseline",
     "baseline_entries", "write_baseline", "findings_to_json",
     "emit_findings",
+    "step_card", "step_card_from_jaxpr", "write_step_card",
+    "exposed_collective_findings",
 ]
 
 
 def __getattr__(name):
-    # jaxpr_pass imports jax; keep the package importable (and the
-    # source pass usable) on boxes without it
+    # jaxpr_pass/cost_pass import jax; keep the package importable (and
+    # the source pass usable) on boxes without it
     if name in ("JAXPR_RULES", "analyze_fn", "analyze_train_step",
                 "train_step_layout"):
         from . import jaxpr_pass
         return getattr(jaxpr_pass, name)
+    if name in ("step_card", "step_card_from_jaxpr", "write_step_card",
+                "exposed_collective_findings", "COLLECTIVE_PRIMITIVES",
+                "OVERLAPPABLE_PRIMITIVES"):
+        from . import cost_pass
+        return getattr(cost_pass, name)
     raise AttributeError(name)

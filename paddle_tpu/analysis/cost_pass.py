@@ -1,0 +1,283 @@
+"""Jaxpr step-cost pass: the static half of the profiling subsystem.
+
+Walks a lowered train/serve step and produces a **step card** — what the
+program costs before it ever runs: estimated FLOPs, HBM bytes touched,
+the collective inventory with operand sizes, and a dominant-equation
+ranking (with XLA's own cost analysis attached when the backend exposes
+it). `tools/ptdoctor.py profile` renders the card next to the runtime
+span breakdown so "where SHOULD the time go" and "where DID it go" sit
+in one report.
+
+Also home of the ROADMAP-item-5 **exposed-collective** ptlint rule
+(DeepCompile, arxiv 2504.09983): a collective (psum / all_gather /
+reduce_scatter / all_to_all / ppermute) with no *independent*
+overlappable compute (dot_general / conv / scan) adjacent to it in the
+jaxpr's dataflow order. Such a collective serializes against the
+program around it — the static precondition every comm/compute overlap
+optimization needs to find its targets. Findings report through the
+existing findings/baseline machinery (suppressible, fingerprinted).
+
+FLOP estimates are the standard static counts (2·prod(out)·K for
+contractions, 2·prod(out)·K_window for convs, prod(out) for elementwise
+arithmetic); they rank equations and size MFU expectations — they are
+not a bench.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, List, Optional, Sequence
+
+from .findings import Finding
+from .jaxpr_pass import JAXPR_RULES, _nbytes, _walk_jaxprs
+
+__all__ = [
+    "COLLECTIVE_PRIMITIVES", "OVERLAPPABLE_PRIMITIVES",
+    "exposed_collective_findings", "step_card", "step_card_from_jaxpr",
+    "write_step_card",
+]
+
+#: primitives that move data across devices (jax lax.parallel lowerings;
+#: psum2 is the check_rep=True shard_map spelling of psum)
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "psum2", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "reduce_scatter", "psum_scatter",
+})
+
+#: compute heavy enough for a scheduler to hide a collective behind
+OVERLAPPABLE_PRIMITIVES = frozenset({
+    "dot_general", "conv_general_dilated", "scan",
+})
+
+# elementwise arithmetic counted at 1 FLOP per output element for the
+# dominant-eqn ranking; movement/layout prims count 0
+_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "max", "min", "exp", "log", "tanh",
+    "logistic", "rsqrt", "sqrt", "pow", "integer_pow", "neg", "abs",
+    "erf", "cos", "sin",
+})
+
+
+def _aval(v):
+    return getattr(v, "aval", None)
+
+
+def _out_elems(eqn) -> int:
+    n = 0
+    for ov in eqn.outvars:
+        a = _aval(ov)
+        if a is not None and getattr(a, "shape", None) is not None:
+            n += int(math.prod(a.shape or (1,)))
+    return n
+
+
+def _eqn_flops(eqn) -> int:
+    """Static FLOP estimate for one equation (0 for pure data movement)."""
+    name = eqn.primitive.name
+    if name == "dot_general":
+        out = _aval(eqn.outvars[0])
+        lhs = _aval(eqn.invars[0])
+        if out is None or lhs is None:
+            return 0
+        (lhs_c, _rhs_c), _batch = eqn.params.get(
+            "dimension_numbers", (((), ()), ((), ())))
+        k = 1
+        for d in lhs_c:
+            k *= int(lhs.shape[d])
+        return 2 * int(math.prod(out.shape or (1,))) * k
+    if name == "conv_general_dilated":
+        out = _aval(eqn.outvars[0])
+        rhs = _aval(eqn.invars[1])
+        if out is None or rhs is None:
+            return 0
+        dn = eqn.params.get("dimension_numbers")
+        o_feat = getattr(dn, "rhs_spec", None)
+        # rhs_spec[0] is the out-feature dim of the kernel; per output
+        # element the window costs prod(rhs.shape) / out_features MACs
+        out_feats = int(rhs.shape[o_feat[0]]) if o_feat else 1
+        per_out = int(math.prod(rhs.shape or (1,))) // max(out_feats, 1)
+        return 2 * int(math.prod(out.shape or (1,))) * per_out
+    if name in _ELEMENTWISE:
+        return _out_elems(eqn)
+    return 0
+
+
+def _eqn_bytes(eqn) -> int:
+    """Upper-bound HBM traffic: every operand read + every result
+    written once (what the program costs UNFUSED; XLA fusion only
+    improves on it)."""
+    n = 0
+    for v in list(eqn.invars) + list(eqn.outvars):
+        a = _aval(v)
+        if a is not None and getattr(a, "shape", None) is not None:
+            n += _nbytes(a.shape, a.dtype)
+    return n
+
+
+def _collective_record(eqn) -> dict:
+    a = _aval(eqn.invars[0]) if eqn.invars else None
+    shape = list(a.shape) if a is not None else []
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    return {
+        "primitive": eqn.primitive.name,
+        "shape": shape,
+        "dtype": str(a.dtype) if a is not None else "?",
+        "bytes": _nbytes(tuple(shape), a.dtype) if a is not None else 0,
+        "axes": str(axes),
+    }
+
+
+# -- exposed-collective rule ----------------------------------------------
+
+def _independent(c_eqn, k_eqn) -> bool:
+    """No direct dataflow edge between the two eqns (either direction):
+    the pair COULD be scheduled concurrently."""
+    c_out = {id(v) for v in c_eqn.outvars}
+    k_out = {id(v) for v in k_eqn.outvars}
+    if any(id(v) in c_out for v in k_eqn.invars):
+        return False
+    if any(id(v) in k_out for v in c_eqn.invars):
+        return False
+    return True
+
+
+def exposed_collective_findings(closed_jaxpr, label: str, *,
+                                window: int = 3,
+                                min_bytes: int = 1 << 16
+                                ) -> List[Finding]:
+    """Collectives with nothing to hide behind.
+
+    For each collective eqn moving >= `min_bytes` (small psums — loss
+    scalars, norm terms — are latency noise, not bandwidth), look
+    `window` equations to each side in the jaxpr's dataflow order for an
+    overlappable compute eqn with NO direct dependence on the
+    collective. Found one -> a scheduler could overlap them; found none
+    -> the collective is exposed and serializes the program."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    findings: List[Finding] = []
+    for jx in _walk_jaxprs(jaxpr):
+        eqns = jx.eqns
+        for i, eqn in enumerate(eqns):
+            if eqn.primitive.name not in COLLECTIVE_PRIMITIVES:
+                continue
+            rec = _collective_record(eqn)
+            if rec["bytes"] < min_bytes:
+                continue
+            lo, hi = max(0, i - window), min(len(eqns), i + window + 1)
+            overlappable = any(
+                k != i
+                and eqns[k].primitive.name in OVERLAPPABLE_PRIMITIVES
+                and _independent(eqn, eqns[k])
+                for k in range(lo, hi))
+            if overlappable:
+                continue
+            sev = JAXPR_RULES["exposed-collective"][0]
+            findings.append(Finding(
+                rule="exposed-collective", severity=sev, path=label,
+                line=0,
+                message="%s over %s %s (%d bytes, axes %s) has no "
+                        "independent overlappable compute within %d "
+                        "eqns — it serializes the step; bucket it "
+                        "against backward compute or prefetch the next "
+                        "microbatch across it"
+                        % (rec["primitive"], rec["dtype"], rec["shape"],
+                           rec["bytes"], rec["axes"], window),
+                snippet="%s:%s%s" % (rec["primitive"], rec["dtype"],
+                                     rec["shape"])))
+    return findings
+
+
+# -- step card -------------------------------------------------------------
+
+def step_card_from_jaxpr(closed_jaxpr, label: str = "<step>", *,
+                         top_n: int = 10) -> dict:
+    """Static cost accounting of one traced step (see module doc)."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    total_flops = 0
+    total_bytes = 0
+    n_eqns = 0
+    collectives: List[dict] = []
+    ranked: List[dict] = []
+    for jx in _walk_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            n_eqns += 1
+            fl = _eqn_flops(eqn)
+            by = _eqn_bytes(eqn)
+            total_flops += fl
+            total_bytes += by
+            if eqn.primitive.name in COLLECTIVE_PRIMITIVES:
+                collectives.append(_collective_record(eqn))
+            if fl or by:
+                out = _aval(eqn.outvars[0]) if eqn.outvars else None
+                ranked.append({
+                    "primitive": eqn.primitive.name,
+                    "out_shape": list(out.shape) if out is not None
+                    else [],
+                    "flops": fl,
+                    "bytes": by,
+                })
+    ranked.sort(key=lambda r: (r["flops"], r["bytes"]), reverse=True)
+    card = {
+        "label": label,
+        "eqns": n_eqns,
+        "flops": total_flops,
+        "hbm_bytes": total_bytes,
+        # bytes/flop: > ~1 means the step is bandwidth-shaped even
+        # before fusion; the MFU ceiling is memory, not the MXU
+        "arithmetic_intensity": round(total_flops / total_bytes, 3)
+        if total_bytes else None,
+        "collectives": {
+            "count": len(collectives),
+            "bytes": sum(c["bytes"] for c in collectives),
+            "inventory": collectives,
+        },
+        "dominant_eqns": ranked[:top_n],
+    }
+    return card
+
+
+def step_card(step_call, inputs, labels, *, label: str = "<train_step>",
+              top_n: int = 10, with_xla: bool = True) -> dict:
+    """Step card for a compiled train step via its `analysis_handle`
+    (jit/engine.py:make_train_step). When the backend exposes
+    `compiled.cost_analysis()`, XLA's own totals ride along under
+    `xla_cost` for calibration of the static estimate."""
+    handle = getattr(step_call, "analysis_handle", None)
+    if handle is None:
+        raise ValueError(
+            "step has no analysis_handle — build it with "
+            "jit.engine.make_train_step")
+    args = handle["pack"](inputs, labels)
+    traced = handle["jitted"].trace(*args)
+    card = step_card_from_jaxpr(traced.jaxpr, label, top_n=top_n)
+    if with_xla:
+        card["xla_cost"] = _xla_cost(traced)
+    return card
+
+
+def _xla_cost(traced) -> Optional[dict]:
+    """XLA cost analysis of the compiled step, when the backend offers
+    it (dict of flops/bytes accessed/optimal seconds; None elsewhere)."""
+    try:
+        ca = traced.lower().compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return None
+        keep = {}
+        for k, v in ca.items():
+            # totals only — the per-operand "bytes accessedN{}" keys are
+            # noise at this granularity
+            if isinstance(v, (int, float)) and (
+                    k in ("flops", "bytes accessed", "transcendentals")
+                    or "optimal" in k):
+                keep[k] = v
+        return keep or None
+    except Exception:
+        return None
+
+
+def write_step_card(card: dict, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(card, f, indent=1)
+    return path

@@ -61,6 +61,11 @@ JAXPR_RULES = {
         "warning",
         "inverse transpose pair / per-conv relayout sandwich in the "
         "traced program"),
+    "exposed-collective": (
+        "warning",
+        "collective with no independent overlappable compute adjacent "
+        "in dataflow order (serializes the step; see "
+        "analysis/cost_pass.py)"),
 }
 
 
@@ -311,6 +316,9 @@ def analyze_fn(fn, args: Sequence, *, donate_argnums: Sequence[int] = (),
     findings += upcast_findings(traced.jaxpr, label,
                                 min_elems=min_upcast_elems)
     findings += transpose_findings(traced.jaxpr, label)
+    # local import: cost_pass imports this module's walk helpers
+    from .cost_pass import exposed_collective_findings
+    findings += exposed_collective_findings(traced.jaxpr, label)
     if state_pairs and check_shardings:
         compiled = lowered.compile()
         flat = jax.tree_util.tree_leaves(lowered.args_info)
@@ -389,6 +397,8 @@ def analyze_train_step(step_call, inputs, labels, *,
     findings += upcast_findings(traced.jaxpr, label,
                                 min_elems=min_upcast_elems)
     findings += transpose_findings(traced.jaxpr, label)
+    from .cost_pass import exposed_collective_findings
+    findings += exposed_collective_findings(traced.jaxpr, label)
     if check_shardings:
         compiled = lowered.compile()
         flat = jax.tree_util.tree_leaves(lowered.args_info)

@@ -22,6 +22,7 @@ import socket
 import subprocess
 import sys
 import time
+import uuid
 
 from ..observability import journal as run_journal
 from ..observability import metrics
@@ -187,6 +188,7 @@ def launch_collective(args) -> int:
             logger.warning("checkpoint sweep failed: %s", e)
 
     grace_s = float(os.environ.get("PADDLE_TPU_GANG_GRACE_S", "10") or 10)
+    _trace_id = uuid.uuid4().hex[:12]
 
     def spawn(local_rank, respawn=False, restart_round=0):
         rank = args.node_rank * nprocs + local_rank
@@ -214,6 +216,10 @@ def launch_collective(args) -> int:
             # an operator-set telemetry home wins over the launcher's)
             env.setdefault("PADDLE_TPU_TELEMETRY_DIR", log_dir)
             env.setdefault("PADDLE_TPU_FLIGHT_DIR", log_dir)
+            # one trace id for every rank and restart round, so the span
+            # events of a whole gang correlate (observability/spans.py);
+            # setdefault survives into respawns via os.environ copies
+            env.setdefault("PADDLE_TPU_TRACE_ID", _trace_id)
             # one persistent compilation cache for every rank and every
             # restart round: a respawned gang reloads still-valid
             # executables off disk instead of paying the compile tax

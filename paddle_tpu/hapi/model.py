@@ -20,7 +20,7 @@ from ..framework.tensor import Tensor
 from ..io import DataLoader
 from ..metric import Metric
 from ..observability import journal as run_journal
-from ..observability import tracing
+from ..observability import spans, tracing
 from ..resilience import AnomalyGuard, PreemptionGuard, chaos, health
 from .callbacks import (Callback, CallbackList, ProgBarLogger,
                         ModelCheckpoint, TelemetryCallback)
@@ -118,8 +118,12 @@ class Model:
         """Whole-train-step XLA compilation via the jit engine."""
         if self._train_step_fn is None:
             from ..jit.engine import make_train_step
-            self._train_step_fn = make_train_step(
-                self.network, self._loss, self._optimizer)
+            # engine construction is compile-side work (pallas health
+            # preprobe + step_fn build) — bill it to the first step's
+            # compile bucket so step 1 still decomposes
+            with spans.span("compile", engine="jit_train", setup=1):
+                self._train_step_fn = make_train_step(
+                    self.network, self._loss, self._optimizer)
         loss, outputs = self._train_step_fn(inputs, labels)
         self.last_step_skipped = getattr(
             self._train_step_fn, "last_step_skipped", False)
@@ -160,7 +164,8 @@ class Model:
             # the python thread spends blocked on the device here is the
             # per-step dispatch stall telemetry wants
             t0 = time.perf_counter()
-            loss_v = float(loss.numpy())
+            with spans.span("host"):
+                loss_v = float(loss.numpy())
             tracing.record_sync(time.perf_counter() - t0)
         else:
             loss_v = loss
@@ -294,21 +299,35 @@ class Model:
                         from ..io.prefetch import DevicePrefetcher
                         feed = DevicePrefetcher(feed, size=device_prefetch,
                                                 placement=feed_place)
+                    feed_it = enumerate(feed)
                     try:
-                        for step, batch in enumerate(feed):
-                            if epoch == resume_epoch and step <= resume_step:
-                                continue  # consumed before preemption ckpt
-                            chaos.step_hook(it_count)
-                            health.tick(it_count)
-                            cbk.on_train_batch_begin(step)
-                            inputs, labels = self._split_batch(batch)
-                            logs = self.train_batch(inputs, labels)
-                            cbk.on_train_batch_end(step, logs)
-                            it_count += 1
-                            if anomaly is not None:
-                                anomaly.observe(
-                                    logs["loss"],
-                                    skipped=self.last_step_skipped)
+                        while True:
+                            # root "step" span over the whole loop body:
+                            # its feed/compile/dispatch/host children are
+                            # the decomposition ptdoctor profile renders
+                            with spans.span("step") as step_sp:
+                                try:
+                                    with spans.span("feed"):
+                                        step, batch = next(feed_it)
+                                except StopIteration:
+                                    step_sp.cancel()
+                                    break
+                                if epoch == resume_epoch and \
+                                        step <= resume_step:
+                                    # consumed before preemption ckpt
+                                    step_sp.cancel()
+                                    continue
+                                chaos.step_hook(it_count)
+                                health.tick(it_count)
+                                cbk.on_train_batch_begin(step)
+                                inputs, labels = self._split_batch(batch)
+                                logs = self.train_batch(inputs, labels)
+                                cbk.on_train_batch_end(step, logs)
+                                it_count += 1
+                                if anomaly is not None:
+                                    anomaly.observe(
+                                        logs["loss"],
+                                        skipped=self.last_step_skipped)
                             if guard is not None and guard.triggered:
                                 self._save_preempt(ckpt_path, epoch, step,
                                                    it_count)

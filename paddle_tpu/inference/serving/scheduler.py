@@ -23,7 +23,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ...observability import journal, metrics
+from ...observability import journal, metrics, spans
 from .cache import bucket_for
 
 __all__ = ["Request", "ContinuousBatcher", "run_open_loop"]
@@ -61,6 +61,7 @@ class Request:
     latency_s: Optional[float] = None     # submit -> completion
     slot: Optional[int] = None
     on_complete: Optional[Callable[["Request"], None]] = None
+    span: Optional[object] = None         # serve_request spans.begin handle
 
     @property
     def done(self) -> bool:
@@ -124,6 +125,10 @@ class ContinuousBatcher:
                    self.engine.max_seq_len))
         if req.submit_ts is None:
             req.submit_ts = self._clock()
+        if req.span is None:
+            # direct-batcher callers get the root span here; the threaded
+            # server begins it earlier, in the submitter's own thread
+            req.span = spans.begin("serve_request", rid=req.rid)
         self.waiting.append(req)
         return req
 
@@ -132,6 +137,14 @@ class ContinuousBatcher:
         req.slot = None
         COMPLETED.inc()
         REQ_SECONDS.observe(req.latency_s)
+        if len(req.tokens) > 1:
+            # everything after the first token: latency - ttft by the
+            # scheduler's own clock, so the three children sum to latency
+            spans.record("decode_steps",
+                         (req.latency_s - req.ttft_s) * 1e3,
+                         parent="serve_request", rid=req.rid,
+                         steps=len(req.tokens) - 1)
+        spans.end(req.span, tokens=len(req.tokens))
         journal.emit("serve_complete", rid=req.rid,
                      tokens=len(req.tokens),
                      ttft_s=round(req.ttft_s, 6),
@@ -151,8 +164,17 @@ class ContinuousBatcher:
                 continue
             req = self.waiting.popleft()
             n = len(np.asarray(req.prompt).reshape(-1))
+            t_pre = self._clock()
             tok = self.engine.prefill(slot, req.prompt)
-            req.ttft_s = self._clock() - req.submit_ts
+            now = self._clock()
+            req.ttft_s = now - req.submit_ts
+            # queue_wait + prefill == ttft_s exactly: same clock, same
+            # instants — the TTFT decomposition SERVING.md documents
+            spans.record("queue_wait", (t_pre - req.submit_ts) * 1e3,
+                         parent="serve_request", rid=req.rid)
+            spans.record("prefill", (now - t_pre) * 1e3,
+                         parent="serve_request", rid=req.rid,
+                         bucket=self.engine.bucket_for(n))
             req.tokens.append(tok)
             req.slot = slot
             ADMITTED.inc()

@@ -20,7 +20,7 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
-from ...observability import flight, metrics
+from ...observability import flight, metrics, spans
 from ...resilience import health
 from .engine import GenerationEngine
 from .scheduler import ContinuousBatcher, Request
@@ -123,6 +123,10 @@ class InferenceServer:
             raise RuntimeError("server not started (use start() or `with`)")
         req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
                       eos_id=eos_id, submit_ts=time.perf_counter())
+        # root span begins on the SUBMITTER's thread (same instant as
+        # submit_ts) and ends in the worker loop at _complete — the
+        # begin/end cross-thread form exists for exactly this hand-off
+        req.span = spans.begin("serve_request", rid=req.rid)
         handle = ServeHandle(req)
         req.on_complete = handle._completed
         self._queue.put(handle)

@@ -40,7 +40,7 @@ import time
 from typing import Any, Callable, Iterator, Optional, Union
 
 from ..framework.tensor import Tensor
-from ..observability import tracing
+from ..observability import spans, tracing
 
 __all__ = ["prefetch_to_device", "DevicePrefetcher"]
 
@@ -116,7 +116,11 @@ class DevicePrefetcher:
         if kind == "item":
             # only waits that produced a batch: the terminal sentinel wait
             # is end-of-data, not feed starvation
-            tracing.record_feed_stall((time.perf_counter() - t0) * 1000.0)
+            wait_ms = (time.perf_counter() - t0) * 1000.0
+            tracing.record_feed_stall(wait_ms)
+            # the queue wait alone, as a child of the caller's "feed"
+            # span: separates feed starvation from batch unpack cost
+            spans.record("feed_wait", wait_ms, parent=spans.current())
             return payload
         self._done = True
         if kind == "exc":

@@ -15,6 +15,10 @@ use them):
   * `flight`    — bounded in-memory ring of recent events + HBM gauges,
                   dumped as a crash bundle (`crash/<rank>-<ts>/`) on
                   unhandled exception / watchdog fire / chaos kill;
+  * `spans`     — nested wall-time spans (`span`/`begin`/`end`/`record`)
+                  decomposing steps and serving requests into named
+                  children, emitted as `span` journal events and
+                  `pt_span_ms{name}` histograms;
   * `aggregate` — cross-rank merge of journals/heartbeats/crash bundles
                   into `timeline.jsonl` + `metrics-rollup.json`
                   (rendered by `tools/ptdoctor.py`).
@@ -22,7 +26,7 @@ use them):
 See docs/OBSERVABILITY.md for the metric name table, journal event
 schema, and the "Post-mortem & crash forensics" section.
 """
-from . import aggregate, flight, journal, metrics, tracing
+from . import aggregate, flight, journal, metrics, spans, tracing
 from .aggregate import aggregate_run
 from .flight import dump_crash_bundle
 from .journal import RunJournal, emit, get_journal, read_journal, set_journal
@@ -31,7 +35,7 @@ from .metrics import (REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
 from .tracing import StepTelemetry, enable, enabled, record_sync
 
 __all__ = [
-    "metrics", "journal", "tracing", "flight", "aggregate",
+    "metrics", "journal", "tracing", "flight", "aggregate", "spans",
     "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "exponential_buckets",
     "RunJournal", "set_journal", "get_journal", "emit", "read_journal",

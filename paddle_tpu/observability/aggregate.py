@@ -203,6 +203,31 @@ def _snapshot_files(directory: str) -> List[str]:
     return out
 
 
+def _serving_fold(src: str, name: str, series: List[dict],
+                  acc: dict) -> None:
+    """Fold one snapshot's `pt_serve_*` series into the serving block:
+    counters sum per source and across sources; histograms keep
+    (count, sum) so cross-rank means stay exact (a mean of per-rank
+    means would weight an idle replica equal to a loaded one)."""
+    per_src = acc["per_source"].setdefault(src, {})
+    totals = acc["totals"]
+    for s in series:
+        key = _series_key(name, s.get("labels") or {})
+        if "value" in s and isinstance(s["value"], (int, float)):
+            per_src[key] = per_src.get(key, 0) + s["value"]
+            totals.setdefault(key, {"value": 0})
+            totals[key]["value"] += s["value"]
+        elif isinstance(s.get("count"), int):
+            h = per_src.setdefault(key, {"count": 0, "sum": 0.0})
+            if not isinstance(h, dict):
+                continue
+            h["count"] += s["count"]
+            h["sum"] += float(s.get("sum", 0.0))
+            t = totals.setdefault(key, {"count": 0, "sum": 0.0})
+            t["count"] += s["count"]
+            t["sum"] += float(s.get("sum", 0.0))
+
+
 def rollup_metrics(directory: str,
                    out_path: Optional[str] = None) -> Tuple[str, int]:
     """Reduce every per-rank/launch metrics snapshot to run-level stats.
@@ -210,9 +235,14 @@ def rollup_metrics(directory: str,
     Counters and gauges contribute their value; histograms contribute
     their mean (empty ones are skipped) plus a summed `total_count`.
     Output: {"series": {"name{label=v}": {count,min,max,mean,p50,p95}}}.
+    `pt_serve_*` series additionally fold into a `serving` block —
+    per-source counter totals plus exact cross-rank histogram
+    (count, sum, mean) — so `ptdoctor summary` can show the fleet view
+    without re-reading every snapshot.
     """
     per_series: dict = {}
     hist_counts: dict = {}
+    serving = {"per_source": {}, "totals": {}}
     sources = []
     for path in _snapshot_files(directory):
         try:
@@ -225,6 +255,9 @@ def rollup_metrics(directory: str,
             continue
         sources.append(os.path.basename(path))
         for name, meta in metrics.items():
+            if name.startswith("pt_serve_"):
+                _serving_fold(os.path.basename(path), name,
+                              meta.get("series", []), serving)
             for s in meta.get("series", []):
                 key = _series_key(name, s.get("labels") or {})
                 if "value" in s:
@@ -236,6 +269,9 @@ def rollup_metrics(directory: str,
                     continue
                 if isinstance(val, (int, float)):
                     per_series.setdefault(key, []).append(float(val))
+    for t in serving["totals"].values():
+        if "count" in t and t["count"]:
+            t["mean"] = t["sum"] / t["count"]
     series = {}
     for key, vals in sorted(per_series.items()):
         entry = {"count": len(vals), "min": min(vals), "max": max(vals),
@@ -245,10 +281,12 @@ def rollup_metrics(directory: str,
             entry["total_count"] = hist_counts[key]
         series[key] = entry
     path = out_path or os.path.join(directory, ROLLUP)
+    out = {"ts": time.time(), "sources": sources, "series": series}
+    if serving["per_source"]:
+        out["serving"] = serving
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "w") as f:
-        json.dump({"ts": time.time(), "sources": sources,
-                   "series": series}, f, indent=1)
+        json.dump(out, f, indent=1)
     os.replace(tmp, path)
     return path, len(series)
 

@@ -1,0 +1,188 @@
+"""Span tracing: nested wall-time decomposition of steps and requests.
+
+PR 2's StepTelemetry says *that* a step was slow; spans say *where the
+time went*. A span is one named wall-clock interval with an optional
+parent, so a train step decomposes into `feed` / `compile` / `dispatch`
+/ `host` children and a serving request into `queue_wait` / `prefill` /
+`decode_steps` — the breakdown `ptdoctor profile` renders and bench rows
+carry as `span_breakdown`.
+
+Three entry points:
+
+  * ``span(name, **attrs)`` — context manager for same-thread nesting.
+    Parentage is a thread-local stack: a span opened inside another's
+    block records that span's name as its parent.
+  * ``begin(name, ...)`` / ``end(handle, ...)`` — explicit pair for
+    spans that START on one thread and FINISH on another (a serving
+    request begins in the caller's ``submit()`` and ends in the worker
+    loop). ``begin`` does NOT touch the thread-local stack — a handle is
+    meant to travel.
+  * ``record(name, dur_ms, ...)`` — bank an interval measured by the
+    caller's own clock (the scheduler computes queue_wait/prefill from
+    its injectable clock so children sum EXACTLY to ttft_s).
+
+Every recorded span observes ``pt_span_ms{name=...}`` and, when a run
+journal is active, emits a ``span`` journal event
+(`name/dur_ms/parent/trace/attrs`). Trace ids come from
+``PADDLE_TPU_TRACE_ID`` (exported per-run by the launcher) so one
+multi-process run correlates; standalone processes mint their own.
+
+Disabled-by-default-safe: with telemetry off (``PADDLE_TPU_TELEMETRY=0``
+/ ``tracing.enable(False)``) every entry point returns a shared no-op,
+and without an active journal (``PADDLE_TPU_TELEMETRY_DIR`` unset)
+nothing is written anywhere but the in-process metrics registry — the
+same contract metrics/journal already keep. Pure stdlib by contract.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from typing import Optional
+
+from . import journal, metrics, tracing
+
+__all__ = ["span", "begin", "end", "record", "trace_id", "current",
+           "Span", "SPAN_MS"]
+
+# millisecond scale: 10us .. ~84s upper edges
+SPAN_MS = metrics.histogram(
+    "pt_span_ms",
+    "Wall time of named trace spans, milliseconds",
+    labelnames=("name",),
+    buckets=metrics.exponential_buckets(0.01, 2.0, 24))
+
+_trace_id: Optional[str] = None
+_tls = threading.local()
+
+
+def trace_id() -> str:
+    """Run-scoped correlation id: launcher-exported env, else per-process."""
+    global _trace_id
+    if _trace_id is None:
+        _trace_id = (os.environ.get("PADDLE_TPU_TRACE_ID")
+                     or uuid.uuid4().hex[:12])
+    return _trace_id
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def current() -> Optional[str]:
+    """Name of the innermost open span on THIS thread (else None)."""
+    s = getattr(_tls, "stack", None)
+    return s[-1] if s else None
+
+
+def _emit(name: str, dur_ms: float, parent: Optional[str], attrs) -> None:
+    SPAN_MS.labels(name).observe(dur_ms)
+    # journal writes only when a run journal is live: journal.emit with no
+    # journal still taps the flight ring, and per-step span events would
+    # wash real dispatch history out of its 512 slots
+    if journal.get_journal() is not None:
+        ev = {"name": name, "dur_ms": round(dur_ms, 3), "trace": trace_id()}
+        if parent:
+            ev["parent"] = parent
+        if attrs:
+            ev["attrs"] = attrs
+        journal.emit("span", **ev)
+
+
+class Span:
+    """One open interval; context manager (stacked) or begin/end handle."""
+
+    __slots__ = ("name", "parent", "attrs", "t0", "_stacked", "_done")
+
+    def __init__(self, name: str, parent: Optional[str], attrs: dict,
+                 stacked: bool):
+        self.name = name
+        self.parent = parent
+        self.attrs = attrs
+        self._stacked = stacked
+        self._done = False
+        self.t0 = time.perf_counter()
+
+    def cancel(self) -> None:
+        """Abandon without recording (e.g. the feed-exhausted last step)."""
+        self._done = True
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._stacked:
+            s = _stack()
+            if s and s[-1] is self.name:
+                s.pop()
+        if not self._done:
+            self._done = True
+            # an exception unwinding through the block is not a measured
+            # interval (mirrors StepTelemetry's _Span)
+            if exc_type is None:
+                _emit(self.name, (time.perf_counter() - self.t0) * 1e3,
+                      self.parent, self.attrs)
+        return False
+
+
+class _NullSpan:
+    """Shared no-op for the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def cancel(self) -> None:
+        pass
+
+
+_NULL = _NullSpan()
+
+
+def span(name: str, **attrs):
+    """Open a nested span on this thread: ``with spans.span("step"): ...``"""
+    if not tracing.enabled():
+        return _NULL
+    s = _stack()
+    sp = Span(name, s[-1] if s else None, attrs, stacked=True)
+    s.append(name)
+    return sp
+
+
+def begin(name: str, parent: Optional[str] = None, **attrs
+          ) -> Optional[Span]:
+    """Start a cross-thread span; pair with ``end(handle)`` anywhere.
+
+    Does not join this thread's nesting stack — the handle carries its
+    own identity. Returns None when tracing is disabled (end(None) is a
+    no-op), so call sites need no enabled() check of their own."""
+    if not tracing.enabled():
+        return None
+    return Span(name, parent, attrs, stacked=False)
+
+
+def end(handle: Optional[Span], **attrs) -> None:
+    """Finish a begin() handle (any thread). Extra attrs merge in."""
+    if handle is None or handle._done:
+        return
+    handle._done = True
+    if attrs:
+        handle.attrs = {**handle.attrs, **attrs}
+    _emit(handle.name, (time.perf_counter() - handle.t0) * 1e3,
+          handle.parent, handle.attrs)
+
+
+def record(name: str, dur_ms: float, parent: Optional[str] = None,
+           **attrs) -> None:
+    """Bank a caller-measured interval as a span (no clock reads here)."""
+    if not tracing.enabled():
+        return
+    _emit(name, float(dur_ms), parent, attrs)

@@ -92,13 +92,14 @@ FEED_STALL = metrics.histogram(
 class _Span:
     """One dispatch measurement; hand back via StepTelemetry.step()."""
 
-    __slots__ = ("tel", "miss", "t0", "_ev", "cache0")
+    __slots__ = ("tel", "miss", "t0", "_ev", "cache0", "_pspan")
 
     def __init__(self, tel: "StepTelemetry", miss: bool):
         self.tel = tel
         self.miss = miss
         self._ev = None
         self.cache0 = None
+        self._pspan = None
 
     def __enter__(self):
         if self.tel is not None:
@@ -106,6 +107,13 @@ class _Span:
                 ("compile:" if self.miss else "step:") + self.tel.engine)
             if self._ev is not None:
                 self._ev.begin()
+            # the same boundary as a profiling span: "compile" on a cache
+            # miss, "dispatch" on a hit — nested under whatever span the
+            # caller holds open (fit's "step"), so step time decomposes
+            self._pspan = _open_span("compile" if self.miss else "dispatch",
+                                     engine=self.tel.engine)
+            if self._pspan is not None:
+                self._pspan.__enter__()
             if self.miss and _cache_probe is not None:
                 try:
                     self.cache0 = _cache_probe()
@@ -119,12 +127,32 @@ class _Span:
             dt = time.perf_counter() - self.t0
             if self._ev is not None:
                 self._ev.end()
+            if self._pspan is not None:
+                self._pspan.__exit__(exc_type, exc, tb)
             if exc_type is None:
                 self.tel._finish(self, dt)
         return False
 
 
 _NULL_SPAN = _Span(None, False)
+
+_spans_mod = None
+
+
+def _open_span(name: str, **attrs):
+    """Profiling span for a dispatch boundary. Lazy + cached import so
+    tracing (imported by spans for the enabled() switch) never forms a
+    load-time cycle with it; returns None if spans is unavailable."""
+    global _spans_mod
+    if _spans_mod is None:
+        try:
+            from . import spans as _spans_mod_imp
+            _spans_mod = _spans_mod_imp
+        except Exception:
+            _spans_mod = False
+    if _spans_mod is False:
+        return None
+    return _spans_mod.span(name, **attrs)
 
 
 def _record_event(name: str):

@@ -97,7 +97,16 @@ class TestWarmCacheContract:
 class TestReclassification:
     """StepTelemetry must journal a miss-span as `compile_cache` exactly
     when the persistent cache served everything (hits>0, misses==0) —
-    and keep byte-identical retrace accounting otherwise."""
+    and keep byte-identical retrace accounting otherwise. Every
+    miss-span also closes a `compile` profiling span (observability/
+    spans.py), which rides in the same journal as a `span` event."""
+
+    @staticmethod
+    def _classified(evs):
+        """(non-span events, span events) — the dispatch profiling span
+        is part of the journal but not of the retrace classification."""
+        return ([e for e in evs if e["event"] != "span"],
+                [e for e in evs if e["event"] == "span"])
 
     def _miss_span(self, tmp_path, engine, probe_seq):
         j = run_journal.RunJournal(str(tmp_path))
@@ -120,26 +129,35 @@ class TestReclassification:
         # probe read at span entry then at finish: 2 hits, 0 misses
         evs, dr = self._miss_span(tmp_path, "eng_warm", [(0, 0), (2, 0)])
         assert dr == 0
+        evs, spans = self._classified(evs)
         assert [e["event"] for e in evs] == ["compile_cache"]
         assert evs[0]["hits"] == 2 and evs[0]["engine"] == "eng_warm"
         assert evs[0]["compile_s"] >= 0
+        # the reload still stalls the loop, so it still profiles as a
+        # compile span
+        assert [s["name"] for s in spans] == ["compile"]
+        assert spans[0]["attrs"]["engine"] == "eng_warm"
 
     def test_cache_miss_stays_a_retrace(self, tmp_path):
         evs, dr = self._miss_span(tmp_path, "eng_miss", [(0, 0), (0, 1)])
         assert dr == 1
+        evs, spans = self._classified(evs)
         assert [e["event"] for e in evs] == ["retrace"]
         assert evs[0]["cache_misses"] == 1
+        assert [s["name"] for s in spans] == ["compile"]
 
     def test_partial_hit_stays_a_retrace(self, tmp_path):
         # some executables reloaded, one still compiled: that dispatch
         # paid real XLA time, so it counts
         evs, dr = self._miss_span(tmp_path, "eng_part", [(0, 0), (3, 1)])
         assert dr == 1
+        evs, _ = self._classified(evs)
         assert evs[0]["event"] == "retrace"
 
     def test_no_probe_keeps_legacy_accounting(self, tmp_path):
         evs, dr = self._miss_span(tmp_path, "eng_nop", None)
         assert dr == 1
+        evs, _ = self._classified(evs)
         assert evs[0]["event"] == "retrace"
         assert "cache_misses" not in evs[0]
 

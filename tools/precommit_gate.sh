@@ -14,9 +14,11 @@ LOG="${PRECOMMIT_GATE_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
 
 # Static-analysis gate (docs/STATIC_ANALYSIS.md): ptlint over paddle_tpu/
-# must report zero unsuppressed findings. Cheapest check — runs first so
-# a lint failure doesn't cost a full tier-1 round.
-timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/ptlint.py paddle_tpu/
+# must report zero unsuppressed findings. --train-step also traces the
+# reference train step and runs the jaxpr rules (donation, sharding,
+# exposed-collective, ...) over it. Cheapest check — runs first so a
+# lint failure doesn't cost a full tier-1 round.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/ptlint.py --train-step paddle_tpu/
 lint_rc=$?
 if [ "$lint_rc" -ne 0 ]; then
     echo "PTLINT=FAILED (rc=$lint_rc — fix the findings or suppress with a reason via --update-baseline)"
@@ -342,6 +344,72 @@ EOF
         tail -20 "$EL_DIR/launch.log"
         [ -f "$EL_DIR/ptdoctor.log" ] && tail -20 "$EL_DIR/ptdoctor.log"
         [ "$smoke_rc" -ne 0 ] && rc=$smoke_rc || rc=1
+    fi
+fi
+
+# Profile smoke (docs/OBSERVABILITY.md "Spans & step profiling"): a
+# 2-step gpt-tiny fit with telemetry on must journal nested step spans
+# whose children (feed/compile/dispatch/host) cover >= 90% of measured
+# step wall time with sane durations, write a static step card, and
+# `ptdoctor profile` must render the breakdown with rc 0.
+if [ "$rc" -eq 0 ]; then
+    PROF_DIR="$(mktemp -d /tmp/pt_prof_smoke_XXXXXX)"
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        PT_PROF_SMOKE_DIR="$PROF_DIR" python - <<'EOF'
+import os
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.analysis import step_card, write_step_card
+from paddle_tpu.models import GPTPretrainingCriterion, gpt_tiny
+from paddle_tpu.observability import read_journal
+
+d = os.environ["PT_PROF_SMOKE_DIR"]
+paddle.seed(0)
+m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+             intermediate_size=64, max_position_embeddings=32)
+model = paddle.Model(m)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=m.parameters()),
+              GPTPretrainingCriterion())
+ids = np.random.RandomState(0).randint(0, 64, (4, 17)).astype(np.int64)
+model.fit([(ids[i, :-1], ids[i, 1:]) for i in range(4)], batch_size=2,
+          epochs=1, verbose=0, telemetry_dir=d)
+
+x, y = paddle.to_tensor(ids[:2, :-1]), paddle.to_tensor(ids[:2, 1:])
+card = step_card(model._train_step_fn, [x], [y], label="gpt_tiny_train")
+write_step_card(card, os.path.join(d, "step_card.json"))
+assert card["flops"] > 0 and card["eqns"] > 0, card
+
+evs = read_journal(os.path.join(d, "journal-rank0.jsonl"))
+sp = [e for e in evs if e["event"] == "span"]
+steps = [e for e in sp if e["name"] == "step"]
+assert len(steps) == 2, [e["name"] for e in sp]
+assert all(0 < e["dur_ms"] < 120000 for e in sp), sp
+kids = [e for e in sp if e.get("parent") == "step"]
+assert {"feed", "compile", "dispatch", "host"} <= \
+    {e["name"] for e in kids}, kids
+step_total = sum(e["dur_ms"] for e in steps)
+child_total = sum(e["dur_ms"] for e in kids)
+assert child_total >= 0.9 * step_total, (child_total, step_total)
+print("PROFILE_SMOKE=ok (2-step fit: %d spans, step decomposition "
+      "%.1f%% covered, step card flops=%d)"
+      % (len(sp), 100.0 * child_total / step_total, card["flops"]))
+EOF
+    smoke_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        python tools/ptdoctor.py profile "$PROF_DIR" \
+            > "$PROF_DIR/profile.log" 2>&1 \
+            && grep -q "step decomposition" "$PROF_DIR/profile.log" \
+            && grep -q "step card" "$PROF_DIR/profile.log"
+        smoke_rc=$?
+    fi
+    if [ "$smoke_rc" -ne 0 ]; then
+        echo "PROFILE_SMOKE=FAILED (rc=$smoke_rc, logs in $PROF_DIR)"
+        [ -f "$PROF_DIR/profile.log" ] && tail -10 "$PROF_DIR/profile.log"
+        rc=$smoke_rc
+    else
+        grep -h "critical path" "$PROF_DIR/profile.log"
+        rm -rf "$PROF_DIR"
     fi
 fi
 

@@ -5,13 +5,18 @@
     python tools/ptdoctor.py timeline <telemetry_dir> [--last N]
     python tools/ptdoctor.py crash    <telemetry_dir>
     python tools/ptdoctor.py lint     <telemetry_dir>
+    python tools/ptdoctor.py profile  <telemetry_dir>
 
 `summary` answers "what happened to run X" from one command: per-rank
 step counts/rates and last-alive step, retraces per engine, restart
 count, the stalest rank, and a digest of every crash bundle. `timeline`
 prints the merged cross-rank event stream (monotonic by ts).  `crash`
 dumps each bundle's manifest, the tail of its flight ring, and the head
-of its stack capture.
+of its stack capture.  `profile` answers "where did the time go": the
+per-span latency table (count/total/mean/p50/p95 over every `span`
+journal event), the step and serve_request decompositions with a
+critical-path share line (compute vs feed vs host vs unattributed), and
+the static step card (analysis/cost_pass.py) when the run dir has one.
 
 Stdlib only, and paddle_tpu is never imported (it pulls in jax — this
 tool must run on a machine that has nothing but the run dir). The
@@ -310,6 +315,32 @@ def cmd_summary(agg, directory) -> int:
             print("    prefill buckets: " + "  ".join(
                 "%s=%d" % (k, int(v)) for k, v in sorted(
                     serve_buckets.items(), key=lambda kv: int(kv[0]))))
+        # per-replica view from the rollup's serving block (written by
+        # rollup_metrics; regenerate with aggregate_run if stale)
+        serving_roll = None
+        rollup_path = os.path.join(directory, "metrics-rollup.json")
+        if os.path.exists(rollup_path):
+            try:
+                with open(rollup_path) as f:
+                    serving_roll = (json.load(f) or {}).get("serving")
+            except (OSError, ValueError):
+                serving_roll = None
+        for src in sorted((serving_roll or {}).get("per_source") or {}):
+            vals = serving_roll["per_source"][src]
+            parts = []
+            for key in ("pt_serve_admitted_total",
+                        "pt_serve_completed_total",
+                        "pt_serve_tokens_total"):
+                v = vals.get(key)
+                if isinstance(v, (int, float)):
+                    parts.append("%s=%d" % (
+                        key[len("pt_serve_"):-len("_total")], int(v)))
+            ttft = vals.get("pt_serve_ttft_seconds")
+            if isinstance(ttft, dict) and ttft.get("count"):
+                parts.append("ttft_mean=%.0fms" %
+                             (1e3 * ttft["sum"] / ttft["count"]))
+            if parts:
+                print("    %s: %s" % (src, "  ".join(parts)))
     # static-analysis findings recorded into this run dir (ptlint
     # --telemetry-dir, or emit_findings from a test harness)
     lint = _counter_by_label(agg, directory, "pt_lint_findings_total",
@@ -446,12 +477,125 @@ def cmd_lint(agg, directory) -> int:
     return 0
 
 
+def _fmt_qty(v) -> str:
+    """1234567 -> '1.23M' (flops / bytes at step-card granularity)."""
+    if not isinstance(v, (int, float)):
+        return str(v)
+    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= div:
+            return "%.2f%s" % (v / div, unit)
+    return "%g" % v
+
+
+def _decomposition(title, total, n, kids, shares=None):
+    """Render one parent-span breakdown: each child's total and share of
+    the parent total, the unattributed remainder, and (optionally) a
+    critical-path line over coarse categories."""
+    print("== %s (%d, %.1f ms total)" % (title, n, total))
+    attributed = 0.0
+    for name, tot in sorted(kids.items(), key=lambda kv: -kv[1]):
+        attributed += tot
+        print("  %-18s %12.1f ms  %5.1f%%" % (name, tot,
+                                              100.0 * tot / total))
+    print("  %-18s %12.1f ms  %5.1f%%" % (
+        "(unattributed)", total - attributed,
+        100.0 * (total - attributed) / total))
+    if shares:
+        print("  critical path: " + "  ".join(
+            "%s %.1f%%" % (k, 100.0 * v / total) for k, v in shares))
+
+
+def cmd_profile(agg, directory) -> int:
+    """Where did the time go: per-span latency table from the `span`
+    journal events, step / serve_request decompositions, and the static
+    step card (analysis/cost_pass.py) when the run dir has one."""
+    events = agg.load_events(directory)
+    sp = [e for e in events if e.get("event") == "span"
+          and isinstance(e.get("dur_ms"), (int, float))]
+    if not sp:
+        print("ptdoctor: no span events under %s (spans are emitted "
+              "when PADDLE_TPU_TELEMETRY_DIR is set at run time)"
+              % directory)
+        return 2
+    by_name = {}
+    children = {}          # parent name -> {child name: summed dur_ms}
+    for e in sp:
+        name = e.get("name", "?")
+        by_name.setdefault(name, []).append(float(e["dur_ms"]))
+        par = e.get("parent")
+        if par:
+            kids = children.setdefault(par, {})
+            kids[name] = kids.get(name, 0.0) + float(e["dur_ms"])
+    print("== spans (%d events)" % len(sp))
+    print("  %-18s %6s %12s %10s %10s %10s" %
+          ("name", "n", "total_ms", "mean_ms", "p50_ms", "p95_ms"))
+    for name in sorted(by_name, key=lambda n: -sum(by_name[n])):
+        vs = by_name[name]
+        print("  %-18s %6d %12.1f %10.2f %10.2f %10.2f" % (
+            name, len(vs), sum(vs), sum(vs) / len(vs),
+            agg.percentile(vs, 50), agg.percentile(vs, 95)))
+    step_total = sum(by_name.get("step", []))
+    if step_total > 0:
+        kids = children.get("step", {})
+        compute = kids.get("compile", 0.0) + kids.get("dispatch", 0.0)
+        feed = kids.get("feed", 0.0) + kids.get("feed_wait", 0.0)
+        host = kids.get("host", 0.0)
+        other = max(0.0, step_total - compute - feed - host)
+        _decomposition("step decomposition", step_total,
+                       len(by_name["step"]), kids,
+                       shares=[("compute", compute), ("feed", feed),
+                               ("host", host), ("other", other)])
+    serve_total = sum(by_name.get("serve_request", []))
+    if serve_total > 0:
+        kids = children.get("serve_request", {})
+        _decomposition("serve_request decomposition", serve_total,
+                       len(by_name["serve_request"]), kids)
+        ttft = kids.get("queue_wait", 0.0) + kids.get("prefill", 0.0)
+        n = len(by_name["serve_request"])
+        print("  ttft (queue_wait + prefill): %.1f ms total, "
+              "%.1f ms/request" % (ttft, ttft / n))
+    import glob
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "step_card*.json"))):
+        try:
+            with open(path) as f:
+                card = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(card, dict):
+            continue
+        print("== step card: %s (%s)" % (card.get("label", "?"),
+                                         os.path.basename(path)))
+        print("  eqns=%s  flops=%s  hbm_bytes=%s  intensity=%s" % (
+            card.get("eqns"), _fmt_qty(card.get("flops")),
+            _fmt_qty(card.get("hbm_bytes")),
+            card.get("arithmetic_intensity")))
+        col = card.get("collectives") or {}
+        if col.get("count"):
+            print("  collectives: %d ops, %s bytes" % (
+                col["count"], _fmt_qty(col.get("bytes", 0))))
+            for c in (col.get("inventory") or [])[:5]:
+                print("    %s %s%s (%s)" % (
+                    c.get("primitive"), c.get("dtype"), c.get("shape"),
+                    _fmt_qty(c.get("bytes", 0))))
+        for r in (card.get("dominant_eqns") or [])[:5]:
+            print("  top: %-22s out=%-16s flops=%-8s bytes=%s" % (
+                r.get("primitive"), r.get("out_shape"),
+                _fmt_qty(r.get("flops", 0)), _fmt_qty(r.get("bytes", 0))))
+        xc = card.get("xla_cost")
+        if isinstance(xc, dict) and xc:
+            print("  xla: " + "  ".join(
+                "%s=%s" % (k, _fmt_qty(v))
+                for k, v in sorted(xc.items())))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="ptdoctor", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     sub = ap.add_subparsers(dest="cmd", required=True)
-    for name in ("summary", "timeline", "crash", "lint"):
+    for name in ("summary", "timeline", "crash", "lint", "profile"):
         p = sub.add_parser(name)
         p.add_argument("dir", help="telemetry directory (--log_dir / "
                                    "telemetry_dir of the run)")
@@ -469,6 +613,8 @@ def main(argv=None) -> int:
         return cmd_timeline(agg, args.dir, last=args.last)
     if args.cmd == "lint":
         return cmd_lint(agg, args.dir)
+    if args.cmd == "profile":
+        return cmd_profile(agg, args.dir)
     return cmd_crash(agg, args.dir)
 
 
