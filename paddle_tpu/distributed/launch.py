@@ -190,6 +190,40 @@ def launch_collective(args) -> int:
     grace_s = float(os.environ.get("PADDLE_TPU_GANG_GRACE_S", "10") or 10)
     _trace_id = uuid.uuid4().hex[:12]
 
+    # live fleet plane (observability/httpd.py): with $PADDLE_TPU_HTTP_PORT
+    # set the launcher serves a fleet-level /statusz that fans out to the
+    # per-rank endpoints (workers are re-pointed at port 0 + discovery
+    # files below); unset, no socket anywhere — the parity contract.
+    fleet_http = os.environ.get("PADDLE_TPU_HTTP_PORT")
+    fleet_srv = None
+    if log_dir and fleet_http not in (None, ""):
+        from ..observability import httpd
+
+        def _workers_alive():
+            live = sum(1 for w in procs if w.proc.poll() is None)
+            return live > 0, "%d/%d workers alive" % (live, len(procs))
+
+        def _launch_status():
+            return {"world": world, "nnodes": args.nnodes,
+                    "restarts": restarts, "rounds": rounds,
+                    "shrinks": shrinks,
+                    "workers": [{"rank": w.rank, "pid": w.proc.pid,
+                                 "alive": w.proc.poll() is None}
+                                for w in procs]}
+
+        try:
+            fleet_srv = httpd.TelemetryServer(
+                port=int(fleet_http), rank=args.node_rank,
+                endpoint_dir=None, fleet_dir=log_dir).start()
+            httpd.register_probe("workers", _workers_alive)
+            httpd.register_status("launch", _launch_status)
+            logger.info("fleet telemetry at %s (/statusz fans out to "
+                        "endpoint-rank*.json under %s)",
+                        fleet_srv.url, log_dir)
+        except (ValueError, OSError) as e:
+            logger.warning("fleet telemetry server failed to start: %s", e)
+            fleet_srv = None
+
     def spawn(local_rank, respawn=False, restart_round=0):
         rank = args.node_rank * nprocs + local_rank
         sweep_checkpoints()
@@ -231,6 +265,18 @@ def launch_collective(args) -> int:
                 os.unlink(health.heartbeat_path(log_dir, rank))
             except OSError:
                 pass
+        if env.get("PADDLE_TPU_HTTP_PORT"):
+            # the operator's fixed port belongs to the launcher's fleet
+            # endpoint; N workers inheriting it would collide, so each
+            # worker binds an ephemeral port and publishes it through an
+            # endpoint-rank<N>.json discovery file in its telemetry dir
+            env["PADDLE_TPU_HTTP_PORT"] = "0"
+            if log_dir:
+                try:
+                    from ..observability import httpd as _httpd
+                    os.unlink(_httpd.endpoint_path(log_dir, rank))
+                except (ImportError, OSError):
+                    pass
         if multiproc:
             # Several controllers on one host: give each a CPU device set.
             # JAX_PLATFORMS alone is overridden by sitecustomize's axon
@@ -303,6 +349,16 @@ def launch_collective(args) -> int:
 
     procs = [spawn(lr) for lr in range(nprocs)]
 
+    # $PADDLE_TPU_AGG_INTERVAL_S > 0: re-run the cross-rank aggregation
+    # every interval while the gang is healthy, so timeline.jsonl and
+    # metrics-rollup.json (what fleet /statusz attaches) track a LIVE
+    # run instead of only materializing at exit/restart boundaries
+    try:
+        from ..observability import aggregate as _agg_mod
+        agg_tick = _agg_mod.PeriodicAggregator(log_dir)
+    except Exception:
+        agg_tick = None
+
     # watch loop (reference: fleet/launch.py:276-347) with a bounded
     # restart budget (reference: elastic manager). world == 1: a crashed
     # worker is respawned individually. world > 1: any worker death —
@@ -370,6 +426,8 @@ def launch_collective(args) -> int:
                 if not alive:
                     break          # every worker exited 0
                 time.sleep(0.5)
+                if agg_tick is not None:
+                    agg_tick.maybe()
                 continue
 
             w, cause, code = failed
@@ -467,6 +525,14 @@ def launch_collective(args) -> int:
             rc = rc or 1
     finally:
         close_logs()
+        if fleet_srv is not None:
+            try:
+                from ..observability import httpd
+                httpd.unregister_probe("workers")
+                httpd.unregister_status("launch")
+                fleet_srv.stop()
+            except Exception as e:
+                logger.warning("fleet telemetry shutdown failed: %s", e)
         if journal_obj is not None:
             # per-line flush puts launch_end on disk before aggregation
             # reads the journal files back

@@ -179,7 +179,8 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None,
             auto_checkpoint_dir=None, exit_on_preempt=True,
-            telemetry_dir=None, device_prefetch=None):
+            telemetry_dir=None, device_prefetch=None,
+            telemetry_http=None):
         """Train. With `auto_checkpoint_dir` set, fit is PREEMPTION-SAFE:
         SIGTERM/SIGINT is deferred to the next batch boundary, an atomic
         checkpoint (params + optimizer + position + RNG) is written there,
@@ -199,7 +200,14 @@ class Model:
         queue depth of the async device feed (io.prefetch): batches are
         device_put from a background thread so host→device copies overlap
         compute; per-batch wait shows up as `pt_feed_stall_ms`. 0 feeds
-        synchronously; sharded nets feed pre-sharded over the data axes."""
+        synchronously; sharded nets feed pre-sharded over the data axes.
+
+        `telemetry_http` (default $PADDLE_TPU_HTTP_PORT; unset = no
+        socket, ever) starts the embedded telemetry server
+        (observability/httpd.py): /metrics, /healthz, /statusz and
+        /journal served live for the life of the process; port 0 binds
+        ephemeral and writes endpoint-rank<N>.json into telemetry_dir
+        for discovery (docs/OBSERVABILITY.md "Live endpoints")."""
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         if device_prefetch is None:
@@ -243,6 +251,22 @@ class Model:
                 pass
             if not any(isinstance(c, TelemetryCallback) for c in cbks):
                 cbks.append(TelemetryCallback())
+
+        # live telemetry plane: opens a socket ONLY when telemetry_http
+        # or $PADDLE_TPU_HTTP_PORT asks for one (parity contract); the
+        # server outlives fit (the plane belongs to the process)
+        fit_state = None
+        try:
+            from ..observability import httpd
+            http_server = httpd.ensure_server(port=telemetry_http,
+                                              endpoint_dir=telemetry_dir)
+            if http_server is not None:
+                fit_state = {"epochs": epochs, "epoch": 0, "step": 0,
+                             "active": True}
+                httpd.register_status("train_loop",
+                                      lambda s=fit_state: dict(s))
+        except Exception:
+            http_server = None
 
         cbk = CallbackList(cbks)
         cbk.set_model(self)
@@ -291,6 +315,8 @@ class Model:
             try:
                 for epoch in range(max(0, resume_epoch), epochs):
                     cbk.on_epoch_begin(epoch)
+                    if fit_state is not None:
+                        fit_state["epoch"] = epoch
                     for m in self._metrics:
                         m.reset()
                     logs = {}
@@ -324,6 +350,8 @@ class Model:
                                 logs = self.train_batch(inputs, labels)
                                 cbk.on_train_batch_end(step, logs)
                                 it_count += 1
+                                if fit_state is not None:
+                                    fit_state["step"] = it_count
                                 if anomaly is not None:
                                     anomaly.observe(
                                         logs["loss"],
@@ -383,6 +411,10 @@ class Model:
                     pass
             raise
         finally:
+            if fit_state is not None:
+                # the provider stays registered (the plane outlives fit)
+                # but /statusz readers can see the loop has ended
+                fit_state["active"] = False
             if journal_obj is not None:
                 journal_obj.emit("run_end", it_count=it_count,
                                  preempted=self.preempted)

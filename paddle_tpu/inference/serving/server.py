@@ -20,7 +20,7 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
-from ...observability import flight, metrics, spans
+from ...observability import flight, httpd, metrics, spans
 from ...resilience import health
 from .engine import GenerationEngine
 from .scheduler import ContinuousBatcher, Request
@@ -73,7 +73,7 @@ class InferenceServer:
     def __init__(self, model, max_batch: int = 4, max_seq_len: int = 128,
                  prefill_buckets: Sequence[int] = (32, 64, 128),
                  pad_id: int = 0, workers: int = 1,
-                 poll_s: float = 0.002):
+                 poll_s: float = 0.002, http_port=None):
         if workers < 1:
             raise ValueError("workers must be >= 1")
         self._engines = [
@@ -86,6 +86,10 @@ class InferenceServer:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._started = False
+        # live telemetry plane: socket opened ONLY when http_port or
+        # $PADDLE_TPU_HTTP_PORT asks for one (parity contract)
+        self._http_port = http_port
+        self._http = None
 
     @property
     def engines(self) -> List[GenerationEngine]:
@@ -102,12 +106,42 @@ class InferenceServer:
                                  name="pt-serve-%d" % i, daemon=True)
             t.start()
             self._threads.append(t)
+        try:
+            self._http = httpd.ensure_server(port=self._http_port)
+        except Exception:
+            self._http = None
+        if self._http is not None:
+            # a dead batcher loop must flip /healthz to 503 so a router
+            # drains this replica instead of timing requests out
+            httpd.register_probe("serve_loop", self._loop_alive)
+            httpd.register_status("serving_workers", self._http_status)
         return self
+
+    def _loop_alive(self):
+        """/healthz probe: every worker thread of a started, not-yet-
+        stopped server must be alive (a crashed loop leaves a dead
+        thread behind — the raise in _loop ends it)."""
+        dead = [t.name for t in self._threads if not t.is_alive()]
+        if self._started and not self._stop.is_set() and dead:
+            return False, "dead serving worker(s): %s" % ",".join(dead)
+        return True, "%d/%d workers alive" % (
+            sum(t.is_alive() for t in self._threads), len(self._threads))
+
+    def _http_status(self) -> dict:
+        return {"workers": len(self._threads),
+                "alive": sum(t.is_alive() for t in self._threads),
+                "queue_depth": self._queue.qsize(),
+                "stopping": self._stop.is_set()}
 
     def stop(self, timeout: float = 60.0) -> None:
         self._stop.set()
         for t in self._threads:
             t.join(timeout)
+        if self._http is not None:
+            # a cleanly-stopped server is not a sick one
+            httpd.unregister_probe("serve_loop")
+            httpd.unregister_status("serving_workers")
+            self._http = None
 
     def __enter__(self) -> "InferenceServer":
         return self.start()

@@ -21,12 +21,19 @@ use them):
                   `pt_span_ms{name}` histograms;
   * `aggregate` — cross-rank merge of journals/heartbeats/crash bundles
                   into `timeline.jsonl` + `metrics-rollup.json`
-                  (rendered by `tools/ptdoctor.py`).
+                  (rendered by `tools/ptdoctor.py`);
+  * `httpd`     — the live half: embedded /metrics /healthz /statusz
+                  /journal endpoints (`TelemetryServer`), off unless
+                  `PADDLE_TPU_HTTP_PORT` is set;
+  * `traceview` — journal span events merged into a Chrome-trace/
+                  Perfetto JSON timeline (`ptdoctor trace`), and the
+                  shared trace-event serializer utils/profiler.py uses.
 
 See docs/OBSERVABILITY.md for the metric name table, journal event
 schema, and the "Post-mortem & crash forensics" section.
 """
-from . import aggregate, flight, journal, metrics, spans, tracing
+from . import (aggregate, flight, httpd, journal, metrics, spans,
+               traceview, tracing)
 from .aggregate import aggregate_run
 from .flight import dump_crash_bundle
 from .journal import RunJournal, emit, get_journal, read_journal, set_journal
@@ -36,6 +43,7 @@ from .tracing import StepTelemetry, enable, enabled, record_sync
 
 __all__ = [
     "metrics", "journal", "tracing", "flight", "aggregate", "spans",
+    "httpd", "traceview",
     "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "exponential_buckets",
     "RunJournal", "set_journal", "get_journal", "emit", "read_journal",

@@ -46,10 +46,12 @@ except ImportError:                     # standalone load by file path
 read_journal = _journal.read_journal
 
 __all__ = ["load_events", "merge_timeline", "rollup_metrics",
-           "aggregate_run", "percentile", "restart_to_first_step"]
+           "aggregate_run", "percentile", "restart_to_first_step",
+           "PeriodicAggregator", "ENV_AGG_INTERVAL"]
 
 TIMELINE = "timeline.jsonl"
 ROLLUP = "metrics-rollup.json"
+ENV_AGG_INTERVAL = "PADDLE_TPU_AGG_INTERVAL_S"
 
 
 # ---------------------------------------------------------------- sources
@@ -289,6 +291,47 @@ def rollup_metrics(directory: str,
         json.dump(out, f, indent=1)
     os.replace(tmp, path)
     return path, len(series)
+
+
+class PeriodicAggregator:
+    """Rate-limited in-flight aggregation for the launcher's watch loop.
+
+    `aggregate_run` used to fire only at exit and gang restarts, so the
+    fleet /statusz and `metrics-rollup.json` went stale for the whole
+    life of a long healthy run. With PADDLE_TPU_AGG_INTERVAL_S > 0 (or
+    an explicit `interval_s`) the launcher calls `maybe()` every watch
+    tick and a fresh timeline/rollup lands at most every interval;
+    disabled (the default) it never touches the disk.
+    """
+
+    def __init__(self, directory: Optional[str],
+                 interval_s: Optional[float] = None,
+                 cause: str = "periodic"):
+        if interval_s is None:
+            try:
+                interval_s = float(
+                    os.environ.get(ENV_AGG_INTERVAL, "") or 0.0)
+            except ValueError:
+                interval_s = 0.0
+        self.directory = directory
+        self.interval_s = max(0.0, float(interval_s))
+        self.cause = cause
+        self._last = time.monotonic()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.directory) and self.interval_s > 0
+
+    def maybe(self, now: Optional[float] = None) -> Optional[dict]:
+        """Aggregate iff the interval elapsed; returns aggregate_run's
+        summary when it ran, else None. Never raises (same contract)."""
+        if not self.enabled:
+            return None
+        now = time.monotonic() if now is None else now
+        if now - self._last < self.interval_s:
+            return None
+        self._last = now
+        return aggregate_run(self.directory, cause=self.cause)
 
 
 def aggregate_run(directory: str, cause: str = "exit") -> Optional[dict]:

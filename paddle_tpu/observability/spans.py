@@ -85,7 +85,10 @@ def _emit(name: str, dur_ms: float, parent: Optional[str], attrs) -> None:
     # journal still taps the flight ring, and per-step span events would
     # wash real dispatch history out of its 512 slots
     if journal.get_journal() is not None:
-        ev = {"name": name, "dur_ms": round(dur_ms, 3), "trace": trace_id()}
+        # tid gives traceview one track per rank x thread (the envelope
+        # already carries rank/pid); masked like profiler.RecordEvent's
+        ev = {"name": name, "dur_ms": round(dur_ms, 3), "trace": trace_id(),
+              "tid": threading.get_ident() % 100000}
         if parent:
             ev["parent"] = parent
         if attrs:

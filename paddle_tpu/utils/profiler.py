@@ -11,10 +11,11 @@ fallback; DEVICE-side timelines come from `jax.profiler` (XLA traces) —
 from __future__ import annotations
 
 import contextlib
-import json
 import threading
 import time
 from typing import Optional
+
+from ..observability import traceview
 
 _py_events = []
 _py_lock = threading.Lock()
@@ -92,11 +93,12 @@ def export_chrome_trace() -> str:
     rec = _native()
     if rec:
         return rec.dump_json()
+    # one trace-event serializer in the tree: observability/traceview.py
     with _py_lock:
-        evs = [{"ph": "X", "pid": 1, "tid": e[3], "ts": e[1] * 1e6,
-                "dur": e[2] * 1e6, "cat": e[4], "name": e[0]}
+        evs = [traceview.trace_event(e[0], e[1] * 1e6, e[2] * 1e6,
+                                     pid=1, tid=e[3], cat=e[4])
                for e in _py_events]
-    return json.dumps({"traceEvents": evs})
+    return traceview.dump_trace(evs)
 
 
 def reset_profiler():

@@ -213,32 +213,42 @@ class TestSpanOverhead:
             return make_train_step(net, nn.MSELoss(), opt)
 
         x = paddle.to_tensor(
-            np.random.RandomState(0).rand(64, 256).astype(np.float32))
+            np.random.RandomState(0).rand(256, 256).astype(np.float32))
         y = paddle.to_tensor(
-            np.random.RandomState(1).rand(64, 256).astype(np.float32))
-
-        def min_step_s(step):
-            for _ in range(5):           # compile + warm
-                with spans.span("t_ovh_step"):
-                    step([x], [y])
-            best = float("inf")
-            for _ in range(30):
-                t0 = _time.perf_counter()
-                with spans.span("t_ovh_step"):
-                    step([x], [y])
-                best = min(best, _time.perf_counter() - t0)
-            return best
+            np.random.RandomState(1).rand(256, 256).astype(np.float32))
 
         was = tracing.enabled()
         try:
-            # one re-measure absorbs a one-off scheduler burst landing on
-            # a single arm; the 5% bound itself never loosens
-            for attempt in range(2):
-                tracing.enable(False)
-                t_off = min_step_s(build())
-                tracing.enable(True)
-                t_on = min_step_s(build())
-                if t_on <= t_off * 1.05 + 5e-5:
+            tracing.enable(False)
+            step_off = build()
+            tracing.enable(True)
+            step_on = build()
+            def window(step, on):
+                # 5 warmup calls re-enter steady state after the
+                # enable() flip, then min-of-30 suppresses spikes
+                tracing.enable(on)
+                best = float("inf")
+                for j in range(35):
+                    t0 = _time.perf_counter()
+                    if on:
+                        with spans.span("t_ovh_step"):
+                            step([x], [y])
+                    else:
+                        step([x], [y])
+                    dt = _time.perf_counter() - t0
+                    if j >= 5:
+                        best = min(best, dt)
+                return best
+
+            t_off = t_on = float("inf")
+            # alternate whole measurement windows (A/B/A/B) so a multi-
+            # second load burst hits both arms instead of skewing
+            # whichever one it lands on — the single-pass sequential
+            # version flaked on 1-core boxes
+            for r in range(3):
+                t_off = min(t_off, window(step_off, False))
+                t_on = min(t_on, window(step_on, True))
+                if r >= 1 and t_on <= t_off * 1.05 + 5e-5:
                     break
         finally:
             tracing.enable(was)

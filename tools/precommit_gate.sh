@@ -489,4 +489,88 @@ EOF
         rm -f "$SERVE_LOG"
     fi
 fi
+
+# HTTP smoke (docs/OBSERVABILITY.md "Live endpoints & trace viewing"):
+# a 2-step fit with PADDLE_TPU_HTTP_PORT=0 must publish its ephemeral
+# endpoint through endpoint-rank0.json, answer a valid Prometheus
+# /metrics exposition (containing pt_span_ms) and a 200 /healthz WHILE
+# the fit is stepping, /statusz must parse with rank 0 and the step
+# count, and `ptdoctor trace` over the run dir (plus a second synthetic
+# rank's journal) must emit a chrome trace with >= 2 tracks.
+if [ "$rc" -eq 0 ]; then
+    HTTP_DIR="$(mktemp -d /tmp/pt_http_smoke_XXXXXX)"
+    timeout -k 10 180 env JAX_PLATFORMS=cpu PADDLE_TPU_HTTP_PORT=0 \
+        PT_HTTP_SMOKE_DIR="$HTTP_DIR" python - <<'EOF'
+import json, os, re, threading, time, urllib.request
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.observability import journal, spans
+
+d = os.environ["PT_HTTP_SMOKE_DIR"]
+paddle.seed(0)
+net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+model = paddle.Model(net)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters()),
+              nn.CrossEntropyLoss())
+X = np.random.RandomState(0).rand(16, 8).astype("float32")
+Y = np.zeros((16, 1), np.int64)
+ds = [(X[i], Y[i]) for i in range(16)]
+err = []
+def fit():
+    try:
+        model.fit(ds, batch_size=8, epochs=1, verbose=0, telemetry_dir=d)
+    except BaseException as e:
+        err.append(e)
+t = threading.Thread(target=fit, daemon=True)
+t.start()
+ep_path = os.path.join(d, "endpoint-rank0.json")
+deadline = time.time() + 60
+while not os.path.exists(ep_path) and time.time() < deadline and not err:
+    time.sleep(0.01)
+assert os.path.exists(ep_path), err
+url = json.load(open(ep_path))["url"]
+# scrape DURING the fit: exposition must never be torn
+body = urllib.request.urlopen(url + "/metrics", timeout=5).read().decode()
+assert "pt_span_ms" in body, body[:400]
+pat = re.compile(r"^(#.*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+)$")
+bad = [l for l in body.rstrip("\n").split("\n") if not pat.match(l)]
+assert not bad, bad[:3]
+assert urllib.request.urlopen(url + "/healthz", timeout=5).status == 200
+t.join(120)
+assert not t.is_alive() and not err, err
+st = json.loads(urllib.request.urlopen(url + "/statusz", timeout=5).read())
+assert st["rank"] == 0 and st["train"]["steps_total"] >= 2, st
+# a second rank's journal so the exported trace carries >= 2 tracks
+j = journal.RunJournal(d, rank=1, filename="journal-rank1.jsonl")
+prev = journal.set_journal(j)
+spans.record("step", 5.0)
+journal.set_journal(prev)
+j.close()
+print("HTTP_SMOKE=ok (live /metrics+/healthz during fit, "
+      "statusz steps=%d)" % st["train"]["steps_total"])
+EOF
+    smoke_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        python tools/ptdoctor.py trace "$HTTP_DIR" \
+            > "$HTTP_DIR/trace.log" 2>&1 \
+            && PT_HTTP_SMOKE_DIR="$HTTP_DIR" python - <<'EOF'
+import json, os
+evs = json.load(open(os.path.join(os.environ["PT_HTTP_SMOKE_DIR"],
+                                  "trace.json")))["traceEvents"]
+tracks = {(e["pid"], e["tid"]) for e in evs if e.get("ph") != "M"}
+assert len(tracks) >= 2, tracks
+print("HTTP_SMOKE trace: %d events, %d tracks" % (len(evs), len(tracks)))
+EOF
+        smoke_rc=$?
+    fi
+    if [ "$smoke_rc" -ne 0 ]; then
+        echo "HTTP_SMOKE=FAILED (rc=$smoke_rc, logs in $HTTP_DIR)"
+        [ -f "$HTTP_DIR/trace.log" ] && tail -5 "$HTTP_DIR/trace.log"
+        rc=$smoke_rc
+    else
+        rm -rf "$HTTP_DIR"
+    fi
+fi
 exit $rc

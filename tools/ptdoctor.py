@@ -6,6 +6,8 @@
     python tools/ptdoctor.py crash    <telemetry_dir>
     python tools/ptdoctor.py lint     <telemetry_dir>
     python tools/ptdoctor.py profile  <telemetry_dir>
+    python tools/ptdoctor.py trace    <telemetry_dir> [--out trace.json]
+    python tools/ptdoctor.py bench    <repo_or_results_dir>
 
 `summary` answers "what happened to run X" from one command: per-rank
 step counts/rates and last-alive step, retraces per engine, restart
@@ -17,6 +19,11 @@ per-span latency table (count/total/mean/p50/p95 over every `span`
 journal event), the step and serve_request decompositions with a
 critical-path share line (compute vs feed vs host vs unattributed), and
 the static step card (analysis/cost_pass.py) when the run dir has one.
+`trace` merges every rank's journal span events into one chrome-trace /
+Perfetto JSON (open in ui.perfetto.dev or chrome://tracing — one track
+per rank x thread, serve_request flow arrows across threads). `bench`
+renders the BENCH_*.json files as a per-config trend table and flags
+step_ms / MFU / compile_s regressions against the best prior row.
 
 Stdlib only, and paddle_tpu is never imported (it pulls in jax — this
 tool must run on a machine that has nothing but the run dir). The
@@ -39,6 +46,14 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _load_aggregate():
     path = os.path.join(_REPO, "paddle_tpu", "observability", "aggregate.py")
     spec = importlib.util.spec_from_file_location("_pt_aggregate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_traceview():
+    path = os.path.join(_REPO, "paddle_tpu", "observability", "traceview.py")
+    spec = importlib.util.spec_from_file_location("_pt_traceview", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
@@ -590,22 +605,152 @@ def cmd_profile(agg, directory) -> int:
     return 0
 
 
+def cmd_trace(directory, out=None) -> int:
+    """Export the run dir's journals as one Perfetto/chrome-trace JSON
+    (observability/traceview.py — same serializer the host profiler
+    uses, so the two artifacts open identically)."""
+    tv = _load_traceview()
+    path, n_events, n_tracks = tv.export_trace(directory, out_path=out)
+    if not n_events:
+        print("ptdoctor: no span events under %s (spans are emitted "
+              "when PADDLE_TPU_TELEMETRY_DIR is set at run time)"
+              % directory)
+        return 2
+    print("wrote %s  (%d events, %d track(s))" % (path, n_events, n_tracks))
+    print("open in https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+def _bench_rows(directory):
+    """((sort_key, label, rows), ...) per BENCH_*.json file, oldest
+    first. Each row: {config, value, unit, step_ms, mfu, compile_s} with
+    absent fields None. Failed runs yield rows=None (listed, not
+    trended)."""
+    import glob
+    out = []
+    for path in glob.glob(os.path.join(directory, "BENCH_*.json")):
+        base = os.path.basename(path)[len("BENCH_"):-len(".json")]
+        if base.startswith("r") and base[1:].isdigit():
+            key = (0, int(base[1:]), base)      # r01..rNN: oldest history
+        else:
+            key = (1, 0, base)                  # then TPU_<ts> by name
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            out.append((key, base, None))
+            continue
+        if not isinstance(data, dict):
+            continue
+        rows = []
+        if "results" in data:                   # tools/bench.py --save shape
+            for r in data.get("results") or []:
+                if isinstance(r, dict) and r.get("config"):
+                    rows.append({"config": r["config"],
+                                 "value": r.get("throughput"),
+                                 "unit": r.get("unit"),
+                                 "step_ms": r.get("step_ms"),
+                                 "mfu": r.get("mfu"),
+                                 "compile_s": r.get("compile_s")})
+        else:                                   # driver round shape
+            parsed = data.get("parsed")
+            if data.get("rc") not in (0, None) or not isinstance(
+                    parsed, dict):
+                out.append((key, base, None))   # failed / unparsed round
+                continue
+            config = str(parsed.get("metric", base))
+            for suffix in ("_tokens_per_sec_per_chip",
+                           "_images_per_sec_per_chip"):
+                if config.endswith(suffix):
+                    config = config[:-len(suffix)]
+            rows.append({"config": config, "value": parsed.get("value"),
+                         "unit": parsed.get("unit"),
+                         "step_ms": parsed.get("step_ms"),
+                         "mfu": parsed.get("mfu"),
+                         "compile_s": parsed.get("compile_s")})
+        out.append((key, base, rows))
+    out.sort(key=lambda e: e[0])
+    return out
+
+
+def cmd_bench(directory) -> int:
+    """Trend table over the checked-in BENCH_*.json results: one block
+    per config, rows oldest->newest, each compared against the BEST
+    prior row (not the previous one — a single slow round must not
+    reset the bar). Flags: step_ms >110% of best, MFU <90% of best,
+    compile_s >110% of best."""
+    files = _bench_rows(directory)
+    if not files:
+        print("ptdoctor: no BENCH_*.json under %s" % directory)
+        return 2
+    failed = [label for _, label, rows in files if rows is None]
+    by_config = {}
+    for _, label, rows in files:
+        for row in rows or []:
+            by_config.setdefault(row["config"], []).append((label, row))
+    for config in sorted(by_config):
+        hist = by_config[config]
+        unit = next((r.get("unit") for _, r in hist if r.get("unit")), "")
+        print("== %s%s" % (config, "  (%s)" % unit if unit else ""))
+        print("  %-22s %12s %10s %7s %10s  %s" %
+              ("run", "value", "step_ms", "mfu", "compile_s", "flags"))
+        best = {}                   # metric -> best value over PRIOR rows
+        for label, row in hist:
+            flags = []
+            for metric, better_low, tol in (("step_ms", True, 1.10),
+                                            ("mfu", False, 0.90),
+                                            ("compile_s", True, 1.10)):
+                v = row.get(metric)
+                if not isinstance(v, (int, float)):
+                    continue
+                b = best.get(metric)
+                if b is not None and (
+                        v > b * tol if better_low else v < b * tol):
+                    flags.append("%s REGRESSED (%.4g vs best %.4g)"
+                                 % (metric, v, b))
+                if b is None or (v < b if better_low else v > b):
+                    best[metric] = v
+            print("  %-22s %12s %10s %7s %10s  %s" % (
+                label,
+                "%.4g" % row["value"]
+                if isinstance(row.get("value"), (int, float)) else "-",
+                "%.4g" % row["step_ms"]
+                if isinstance(row.get("step_ms"), (int, float)) else "-",
+                "%.3f" % row["mfu"]
+                if isinstance(row.get("mfu"), (int, float)) else "-",
+                "%.4g" % row["compile_s"]
+                if isinstance(row.get("compile_s"), (int, float)) else "-",
+                "; ".join(flags)))
+    if failed:
+        print("failed/unparsed runs (not trended): " + "  ".join(failed))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="ptdoctor", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     sub = ap.add_subparsers(dest="cmd", required=True)
-    for name in ("summary", "timeline", "crash", "lint", "profile"):
+    for name in ("summary", "timeline", "crash", "lint", "profile",
+                 "trace", "bench"):
         p = sub.add_parser(name)
         p.add_argument("dir", help="telemetry directory (--log_dir / "
-                                   "telemetry_dir of the run)")
+                                   "telemetry_dir of the run); for "
+                                   "`bench`, the dir with BENCH_*.json")
         if name == "timeline":
             p.add_argument("--last", type=int, default=None,
                            help="only the last N events")
+        if name == "trace":
+            p.add_argument("--out", default=None,
+                           help="output path (default <dir>/trace.json)")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.dir):
         print("ptdoctor: not a directory: %s" % args.dir, file=sys.stderr)
         return 2
+    if args.cmd == "trace":
+        return cmd_trace(args.dir, out=args.out)
+    if args.cmd == "bench":
+        return cmd_bench(args.dir)
     agg = _load_aggregate()
     if args.cmd == "summary":
         return cmd_summary(agg, args.dir)
