@@ -272,13 +272,26 @@ def _budget_gates(row):
 
 
 def serving_gates(row):
-    """ISSUE 10 serving acceptance gates, computed on the
-    `inference_bench.py gpt2_generate` row (which imports this helper —
+    """Serving acceptance gates (ISSUE 10 + ISSUE 13), computed on the
+    `inference_bench.py` serving rows (which import this helper —
     bench.py has no paddle_tpu/jax imports at module level, so the
-    child importing it is safe): the compile-once contract (decode
-    compiles == 1, prefill compiles <= configured buckets) and the
-    continuous-batching arm beating static sequential batching on
-    throughput. Same contract as the budget gates: a miss emits a
+    child importing it is safe). Every check is keyed on the fields the
+    row actually carries, so the classic `gpt2_generate` row gets the
+    compile-once + continuous-beats-static gates and the
+    `gpt2_prefix_int8` row additionally gets the shared-prefix reuse
+    and int8-quantization contracts:
+
+      * prefix_hit_ttft_le_0.6x_miss — a prefix-cache hit's TTFT p50
+        must be <= 0.6x the miss TTFT p50 (reuse actually skips work)
+      * prefix_reuse_tps_ge_noreuse — reuse must never cost throughput
+      * int8_greedy_parity_ge_64 — >= 64 greedy tokens, all equal to
+        the float-cache engine's (the EQuARX-style accuracy contract)
+      * int8_nbytes_le_0.55x_bf16 — quantized cache bytes (payload +
+        scales) vs a bf16 cache of identical geometry
+      * int8_decode_compile_once — quantize-on-append must not break
+        the compile-once contract
+
+    Same contract as the budget gates: a miss emits a
     `bench_gate_failed` journal event but never breaks the one-JSON-
     line rc-0 contract."""
     gates = {}
@@ -290,12 +303,32 @@ def serving_gates(row):
             row["prefill_compiles"] <= row["n_buckets"]
     if isinstance(row.get("speedup_x"), (int, float)):
         gates["continuous_beats_static"] = row["speedup_x"] > 1.0
+    if isinstance(row.get("prefix_ttft_ratio"), (int, float)):
+        gates["prefix_hit_ttft_le_0.6x_miss"] = \
+            row["prefix_ttft_ratio"] <= 0.6
+    if isinstance(row.get("tokens_per_s"), (int, float)) and \
+            isinstance(row.get("noreuse_tokens_per_s"), (int, float)):
+        gates["prefix_reuse_tps_ge_noreuse"] = \
+            row["tokens_per_s"] >= row["noreuse_tokens_per_s"]
+    if isinstance(row.get("int8_parity_tokens"), (int, float)):
+        gates["int8_greedy_parity_ge_64"] = \
+            row["int8_parity_tokens"] >= 64 and \
+            bool(row.get("int8_parity_ok"))
+    if isinstance(row.get("int8_nbytes_ratio"), (int, float)):
+        gates["int8_nbytes_le_0.55x_bf16"] = \
+            row["int8_nbytes_ratio"] <= 0.55
+    if isinstance(row.get("int8_decode_compiles"), (int, float)):
+        gates["int8_decode_compile_once"] = \
+            row["int8_decode_compiles"] == 1
     if len(gates) < 3 or not all(gates.values()):
         _emit_bench_event(
             "bench_gate_failed", config=row.get("config"), gates=gates,
             decode_compiles=row.get("decode_compiles"),
             prefill_compiles=row.get("prefill_compiles"),
-            speedup_x=row.get("speedup_x"))
+            speedup_x=row.get("speedup_x"),
+            prefix_ttft_ratio=row.get("prefix_ttft_ratio"),
+            int8_parity_tokens=row.get("int8_parity_tokens"),
+            int8_nbytes_ratio=row.get("int8_nbytes_ratio"))
     return gates
 
 

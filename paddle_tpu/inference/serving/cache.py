@@ -1,4 +1,4 @@
-"""Static-shape paged KV cache for the generation serving engine.
+"""Static-shape paged KV cache + shared-prefix reuse for the serving engine.
 
 The training-era decode path (`GPTForPretraining.generate`) grows its
 KV cache by `concat` every token, so each step has a NEW shape — an
@@ -14,35 +14,76 @@ and every update is a `jax.lax.dynamic_update_slice` at a traced
 program). A slot is "freed" by simply overwriting it on the next
 prefill; no deallocation, no shape change, no recompile.
 
+Two throughput multipliers live here (ROADMAP item 3c):
+
+  * **int8 quantized KV** (`kv_dtype="int8"`): k/v are stored as int8
+    with a float32 scale per (layer, slot, head, token) — the
+    symmetric absmax scheme the TPU paged-attention kernels use
+    (int8 payload + scales side-buffer, dequantized next to the
+    matmul). Bytes/slot roughly halve vs bf16, so `max_batch` doubles
+    under the same HBM budget; the accuracy contract (greedy token
+    parity vs the float cache) is gated in `inference_bench.py`.
+  * **`PrefixCache`**: LRU store of bucket-aligned prompt-prefix K/V
+    keyed on the token ids themselves. Requests sharing a system
+    prompt skip recomputing it — the engine copies the cached K/V into
+    the slot and prefills only the suffix.
+
 `LayerCacheView` is the per-layer window handed to `GPTAttention`
 inside a traced serving step: the attention layer writes the step's
-K/V at each slot's length index and REPLACES `.k`/`.v` on the view
-with the updated buffers, which the engine stacks back into the cache
-state it returns from the jitted function. The view is a plain python
-carrier of traced arrays scoped to one trace — nothing escapes it.
+K/V at each slot's length index and REPLACES `.k`/`.v` (and the
+scales, when quantized) on the view with the updated buffers, which
+the engine stacks back into the cache state it returns from the jitted
+function. The view is a plain python carrier of traced arrays scoped
+to one trace — nothing escapes it.
 """
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+import os
+from collections import OrderedDict
+from typing import Optional, Sequence, Tuple
 
-__all__ = ["LayerCacheView", "PagedKVCache", "bucket_for"]
+from ...observability import metrics
+
+__all__ = ["LayerCacheView", "PagedKVCache", "PrefixCache", "bucket_for",
+           "dequantize_kv", "quantize_kv"]
+
+PREFIX_HITS = metrics.counter(
+    "pt_prefix_cache_hits_total",
+    "Admissions that reused a cached shared-prefix K/V")
+PREFIX_MISSES = metrics.counter(
+    "pt_prefix_cache_misses_total",
+    "Admissions that found no cached prefix and prefilled from scratch")
+PREFIX_EVICTIONS = metrics.counter(
+    "pt_prefix_cache_evictions_total",
+    "Prefix entries evicted by the LRU byte budget")
+PREFIX_BYTES = metrics.gauge(
+    "pt_prefix_cache_bytes",
+    "Bytes of K/V (+scales) currently held by the prefix cache")
+
+# env knob: default byte budget for each engine's PrefixCache; 0 disables
+PREFIX_CACHE_BYTES_ENV = "PADDLE_TPU_PREFIX_CACHE_BYTES"
+_PREFIX_CACHE_DEFAULT = 256 << 20
 
 
 class LayerCacheView:
     """One layer's slice of the paged cache during a traced step.
 
     k/v: [B, n_heads, max_seq_len, head_dim] (traced); lens: int32 [B].
-    `GPTAttention.forward` detects this type (duck-typed on `.lens`),
-    writes the incoming K/V at each slot's `lens` offset, attends over
-    positions `<= lens`, and stores the updated buffers back on the
-    view."""
+    For a quantized cache, k/v are int8 and k_scale/v_scale carry the
+    float32 per-(slot, head, token) scales [B, n_heads, max_seq_len]
+    (None otherwise). `GPTAttention.forward` detects this type
+    (duck-typed on `.lens`), writes the incoming K/V at each slot's
+    `lens` offset (quantizing on append), attends over positions
+    `<= lens`, and stores the updated buffers back on the view."""
 
-    __slots__ = ("k", "v", "lens")
+    __slots__ = ("k", "v", "lens", "k_scale", "v_scale")
 
-    def __init__(self, k, v, lens):
+    def __init__(self, k, v, lens, k_scale=None, v_scale=None):
         self.k = k
         self.v = v
         self.lens = lens
+        self.k_scale = k_scale
+        self.v_scale = v_scale
 
 
 def bucket_for(length: int, buckets: Sequence[int]) -> int:
@@ -60,33 +101,185 @@ def bucket_for(length: int, buckets: Sequence[int]) -> int:
         % (length, max(buckets)))
 
 
+def quantize_kv(x, eps=1e-8):
+    """Symmetric absmax int8 quantization over the last (head_dim) axis.
+
+    Returns (int8 values, float32 scales) with scales shaped like `x`
+    minus its last axis — one scale per (…, token). The zero-row guard
+    keeps idle-slot garbage finite (scale floor -> dequant of a zero
+    row is exactly zero)."""
+    import jax.numpy as jnp
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = (jnp.maximum(amax, eps) / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype="float32"):
+    """Inverse of `quantize_kv`: int8 values × per-token scales."""
+    import jax.numpy as jnp
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 class PagedKVCache:
     """Host-side handle on the preallocated cache state.
 
     Owns the device buffers between steps; the engine threads them
     through its jitted prefill/decode executables (donated, so XLA
-    updates them in place in HBM instead of double-buffering)."""
+    updates them in place in HBM instead of double-buffering).
+
+    `kv_dtype="int8"` stores k/v as int8 plus float32 `k_scale`/
+    `v_scale` side-buffers of shape [n_layers, max_batch, n_heads,
+    max_seq_len] — ~0.53x the bytes of bf16 at head_dim 64, which is
+    the whole point: more decode slots per HBM byte."""
 
     def __init__(self, n_layers: int, max_batch: int, n_heads: int,
-                 max_seq_len: int, head_dim: int, dtype="float32"):
+                 max_seq_len: int, head_dim: int, kv_dtype="float32"):
         import jax.numpy as jnp
         self.n_layers = int(n_layers)
         self.max_batch = int(max_batch)
         self.n_heads = int(n_heads)
         self.max_seq_len = int(max_seq_len)
         self.head_dim = int(head_dim)
+        self.kv_dtype = str(kv_dtype)
+        self.quantized = self.kv_dtype == "int8"
         shape = (self.n_layers, self.max_batch, self.n_heads,
                  self.max_seq_len, self.head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        store = jnp.int8 if self.quantized else self.kv_dtype
+        self.k = jnp.zeros(shape, store)
+        self.v = jnp.zeros(shape, store)
         self.lens = jnp.zeros((self.max_batch,), jnp.int32)
+        if self.quantized:
+            self.k_scale = jnp.zeros(shape[:-1], jnp.float32)
+            self.v_scale = jnp.zeros(shape[:-1], jnp.float32)
+        else:
+            self.k_scale = self.v_scale = None
 
     @property
     def nbytes(self) -> int:
-        return int(self.k.nbytes) + int(self.v.nbytes) + int(self.lens.nbytes)
+        n = int(self.k.nbytes) + int(self.v.nbytes) + int(self.lens.nbytes)
+        if self.quantized:
+            n += int(self.k_scale.nbytes) + int(self.v_scale.nbytes)
+        return n
 
     def state(self) -> Tuple:
+        """Flat state tuple the jitted steps thread (and donate).
+
+        Float: (k, v, lens). Quantized: (k, v, k_scale, v_scale, lens)
+        — the scales MUST travel with the values they decode."""
+        if self.quantized:
+            return self.k, self.v, self.k_scale, self.v_scale, self.lens
         return self.k, self.v, self.lens
 
-    def set_state(self, k, v, lens) -> None:
-        self.k, self.v, self.lens = k, v, lens
+    def set_state(self, *state) -> None:
+        want = 5 if self.quantized else 3
+        if len(state) == 1 and isinstance(state[0], (tuple, list)):
+            state = tuple(state[0])
+        if len(state) != want:
+            raise ValueError(
+                "set_state expects %d arrays for kv_dtype=%s, got %d "
+                "(a quantized cache's scales must round-trip with it)"
+                % (want, self.kv_dtype, len(state)))
+        k, v = state[0], state[1]
+        for name, arr, ref in (("k", k, self.k), ("v", v, self.v)):
+            if str(arr.dtype) != str(ref.dtype):
+                raise ValueError(
+                    "set_state %s dtype %s does not match this cache's "
+                    "kv_dtype=%s storage (%s); rebuild the cache instead "
+                    "of mixing quantized and float states"
+                    % (name, arr.dtype, self.kv_dtype, ref.dtype))
+        if self.quantized:
+            self.k, self.v, self.k_scale, self.v_scale, self.lens = state
+        else:
+            self.k, self.v, self.lens = state
+
+
+def prefix_cache_budget(explicit: Optional[int] = None) -> int:
+    """Resolve the prefix-cache byte budget: explicit arg beats the
+    PADDLE_TPU_PREFIX_CACHE_BYTES env, which beats the 256 MiB default.
+    <= 0 disables reuse entirely."""
+    if explicit is not None:
+        return int(explicit)
+    try:
+        return int(os.environ.get(PREFIX_CACHE_BYTES_ENV,
+                                  _PREFIX_CACHE_DEFAULT))
+    except ValueError:
+        return _PREFIX_CACHE_DEFAULT
+
+
+class PrefixCache:
+    """LRU map from bucket-aligned token-id prefixes to their K/V.
+
+    Keys are the prompt's first `p` token ids (p a configured prefill
+    bucket — bucket alignment keeps the engine's insert executables
+    compile-once-per-bucket); values are the device arrays the engine
+    stored after a cold prefill: (k, v) of shape
+    [n_layers, 1, n_heads, p, head_dim] plus (k_scale, v_scale) when
+    the paged cache is quantized — a quantized prefix is re-inserted
+    verbatim, never re-quantized, so a hit adds zero extra rounding
+    error over the cold path.
+
+    Eviction is LRU under `max_bytes` (`PADDLE_TPU_PREFIX_CACHE_BYTES`):
+    system prompts are few and hot, one-off prompt heads are many and
+    cold, which is exactly the access pattern LRU wins on."""
+
+    def __init__(self, max_bytes: int, buckets: Sequence[int]):
+        self.max_bytes = int(max_bytes)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self._entries: "OrderedDict[Tuple[int, ...], Tuple]" = OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @staticmethod
+    def _nbytes(arrays) -> int:
+        return sum(int(a.nbytes) for a in arrays)
+
+    def lookup(self, prompt) -> Tuple[int, Optional[Tuple]]:
+        """(prefix_len, arrays) for the LONGEST cached prefix of
+        `prompt`, or (0, None). Only proper prefixes qualify (p <
+        len(prompt)): a hit must leave >= 1 suffix token to prefill,
+        because the first generated token comes out of the suffix pass.
+        A prompt sharing tokens with a cached entry but not on a bucket
+        boundary simply misses — alignment is what keeps the insert
+        executables static-shaped."""
+        n = len(prompt)
+        for p in reversed(self.buckets):
+            if p >= n:
+                continue
+            key = tuple(int(t) for t in prompt[:p])
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                PREFIX_HITS.inc()
+                return p, entry
+        self.misses += 1
+        PREFIX_MISSES.inc()
+        return 0, None
+
+    def store(self, key_tokens, arrays) -> bool:
+        """Admit a prefix (device arrays) under the LRU byte budget.
+        Refreshes recency on re-store of an existing key. Returns
+        whether the entry is resident afterwards."""
+        key = tuple(int(t) for t in key_tokens)
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return True
+        size = self._nbytes(arrays)
+        if size > self.max_bytes:
+            return False             # bigger than the whole budget
+        while self.bytes + size > self.max_bytes and self._entries:
+            _, old = self._entries.popitem(last=False)
+            self.bytes -= self._nbytes(old)
+            self.evictions += 1
+            PREFIX_EVICTIONS.inc()
+        self._entries[key] = tuple(arrays)
+        self.bytes += size
+        PREFIX_BYTES.set(self.bytes)
+        return True

@@ -1,7 +1,7 @@
 """Jitted generation engine: bucketed prefill + compile-once decode.
 
 The serving-side replacement for `GPTForPretraining.generate()`'s eager
-loop. Two executables cover all of decoding:
+loop. Three executable families cover all of decoding:
 
   * prefill(bucket): one compile per configured prompt-length bucket.
     The prompt is right-padded to the bucket on the host (exact under
@@ -10,16 +10,36 @@ loop. Two executables cover all of decoding:
     single forward, and the resulting per-layer K/V is inserted into
     the paged cache at the slot index INSIDE the same executable, so
     admission costs one dispatch and no extra compiles.
+  * suffix-prefill(prefix_len, bucket): the shared-prefix fast path.
+    When the `PrefixCache` holds K/V for the prompt's head (a shared
+    system prompt), only the suffix runs through the model — the
+    cached prefix K/V enters as a regular argument, is concatenated as
+    a legacy cache (bottom-right-causal suffix attention in gpt.py),
+    and both halves are inserted into the slot inside the executable.
+    One compile per observed (prefix bucket, suffix bucket) pair;
+    TTFT on a hit is suffix-length cost.
   * decode: ONE compile, ever. All requests, all tokens, all slots run
     the same [max_batch, 1] program; per-slot progress lives in the
     `lens` index vector (cache.py), never in shapes.
 
-Both are wrapped in `StepTelemetry` ("serve_prefill"/"serve_decode")
-so `pt_jit_retraces_total` accounts the compile-once contract, and the
+With `kv_dtype="int8"` the quantize-on-append folds into the SAME
+executables: prefill/suffix quantize the freshly-computed K/V before
+the slot insert, decode quantizes the step's K/V inside
+`_paged_decode_attention` and dequantizes next to the matmul. The
+cache state a jitted step threads is then the 5-tuple
+(k, v, k_scale, v_scale, lens) instead of (k, v, lens) — shapes still
+never change, so decode still compiles exactly once. A cached prefix
+is re-inserted VERBATIM (int8 payload + its original scales), never
+dequantized-and-requantized, so a prefix hit is bit-identical to the
+cold path's cache contents.
+
+All executables are wrapped in `StepTelemetry`
+("serve_prefill"/"serve_suffix"/"serve_decode") so
+`pt_jit_retraces_total` accounts the compile-once contract, and the
 engine additionally counts REAL jax traces (the python body runs once
-per trace) in `prefill_compiles`/`decode_compiles` — the number the
-tests and the SERVING_SMOKE gate assert on, immune to the telemetry
-kill-switch.
+per trace) in `prefill_compiles`/`suffix_prefill_compiles`/
+`decode_compiles` — the numbers the tests and the SERVING_SMOKE gate
+assert on, immune to the telemetry kill-switch.
 
 Weights are functionalized exactly like jit/engine.py's eval step:
 parameter `_data` is swapped for traced inputs during the trace and
@@ -62,10 +82,19 @@ class GenerationEngine:
     that is by design: masking slots out would put batch composition
     into the compiled program's shape. The scheduler simply ignores
     tokens from slots it has not admitted.
+
+    `kv_dtype="int8"` swaps the paged cache for the quantized layout
+    (~0.53x bf16 bytes at head_dim 64 — see cache.py); `prefix_cache`
+    is the shared-prefix store (None disables reuse; byte budget from
+    the `prefix_cache_bytes` arg or PADDLE_TPU_PREFIX_CACHE_BYTES).
+    After every `prefill()` the engine leaves `admit_info`
+    (prefix_len/bucket of THAT admission) for the scheduler's
+    `serve_admit` journal event.
     """
 
     def __init__(self, model, max_batch=4, max_seq_len=128,
-                 prefill_buckets=(32, 64, 128), pad_id=0):
+                 prefill_buckets=(32, 64, 128), pad_id=0,
+                 kv_dtype="float32", prefix_cache_bytes=None):
         import jax
         import jax.numpy as jnp
         from ...jit import compile_cache
@@ -113,20 +142,78 @@ class GenerationEngine:
 
         self.kv = cache_mod.PagedKVCache(
             self._n_layers, self.max_batch, self._n_heads,
-            self.max_seq_len, self._head_dim)
+            self.max_seq_len, self._head_dim, kv_dtype=kv_dtype)
         self._last = jnp.zeros((self.max_batch, 1), jnp.int32)
 
-        self._traces = {"prefill": 0, "decode": 0}
+        budget = cache_mod.prefix_cache_budget(prefix_cache_bytes)
+        self.prefix_cache = (cache_mod.PrefixCache(budget, self.buckets)
+                             if budget > 0 else None)
+        self.admit_info = {"prefix_len": 0, "bucket": 0}
+
+        self._traces = {"prefill": 0, "decode": 0, "suffix": 0}
         self._prefill_tel = tracing.StepTelemetry("serve_prefill")
+        self._suffix_tel = tracing.StepTelemetry("serve_suffix")
         self._decode_tel = tracing.StepTelemetry("serve_decode")
-        self._jit_prefill = jax.jit(self._prefill_fn,
-                                    donate_argnums=(3, 4, 5, 6))
-        self._jit_decode = jax.jit(self._decode_fn,
-                                   donate_argnums=(3, 4, 5, 6))
+        self._jit_prefill = jax.jit(self._prefill_fn, donate_argnums=(3, 4))
+        self._jit_decode = jax.jit(self._decode_fn, donate_argnums=(3, 4))
+        # one jit object; jax retraces per (prefix_len, suffix bucket)
+        # shape pair — counted in _traces["suffix"], never in "prefill"
+        self._jit_suffix = jax.jit(self._suffix_fn, donate_argnums=(3, 4))
+
+    # -- cache-state plumbing ----------------------------------------------
+
+    def _split_cache(self, cache):
+        """(k, v, k_scale|None, v_scale|None, lens) from the flat state
+        tuple a jitted step received (see PagedKVCache.state)."""
+        if self.kv.quantized:
+            kc, vc, ksc, vsc, lens = cache
+            return kc, vc, ksc, vsc, lens
+        kc, vc, lens = cache
+        return kc, vc, None, None, lens
+
+    def _join_cache(self, kc, vc, ksc, vsc, lens):
+        if self.kv.quantized:
+            return kc, vc, ksc, vsc, lens
+        return kc, vc, lens
+
+    def _insert_kv(self, cache, ks, vs, tl, slot, offset=0,
+                   prefix=None):
+        """Write freshly-computed float K/V [L,1,nh,T',hd] (quantizing
+        first when the cache is int8) into `cache` at (slot, offset),
+        optionally preceded by a VERBATIM stored prefix at offset 0,
+        and set the slot's length to `tl`. Runs inside a trace."""
+        import jax
+        import jax.numpy as jnp
+        kc, vc, ksc, vsc, lens = self._split_cache(cache)
+        s, z = slot.astype(jnp.int32), jnp.int32(0)
+        o = jnp.int32(offset)
+        if self.kv.quantized:
+            ks, ks_sc = cache_mod.quantize_kv(ks)
+            vs, vs_sc = cache_mod.quantize_kv(vs)
+            if prefix is not None:
+                pk, pv, pks, pvs = prefix
+                ksc = jax.lax.dynamic_update_slice(ksc, pks, (z, s, z, z))
+                vsc = jax.lax.dynamic_update_slice(vsc, pvs, (z, s, z, z))
+            ksc = jax.lax.dynamic_update_slice(ksc, ks_sc, (z, s, z, o))
+            vsc = jax.lax.dynamic_update_slice(vsc, vs_sc, (z, s, z, o))
+        elif prefix is not None:
+            pk, pv = prefix
+        if prefix is not None:
+            kc = jax.lax.dynamic_update_slice(
+                kc, pk.astype(kc.dtype), (z, s, z, z, z))
+            vc = jax.lax.dynamic_update_slice(
+                vc, pv.astype(vc.dtype), (z, s, z, z, z))
+        kc = jax.lax.dynamic_update_slice(
+            kc, ks.astype(kc.dtype), (z, s, z, o, z))
+        vc = jax.lax.dynamic_update_slice(
+            vc, vs.astype(vc.dtype), (z, s, z, o, z))
+        lens = jax.lax.dynamic_update_slice(
+            lens, jnp.reshape(tl, (1,)), (s,))
+        return self._join_cache(kc, vc, ksc, vsc, lens)
 
     # -- traced bodies ----------------------------------------------------
 
-    def _prefill_fn(self, arrs, buf_arrs, key, kc, vc, lens, last,
+    def _prefill_fn(self, arrs, buf_arrs, key, cache, last,
                     ids, true_len, slot):
         import jax
         import jax.numpy as jnp
@@ -158,19 +245,82 @@ class GenerationEngine:
             tok = jnp.argmax(logits._data, axis=-1).astype(jnp.int32)
             ks = jnp.stack([c[0]._data for c in kvs])   # [L,1,nh,Tb,hd]
             vs = jnp.stack([c[1]._data for c in kvs])
+            cache = self._insert_kv(cache, ks, vs, tl, slot)
             s, z = slot.astype(jnp.int32), jnp.int32(0)
-            kc = jax.lax.dynamic_update_slice(kc, ks, (z, s, z, z, z))
-            vc = jax.lax.dynamic_update_slice(vc, vs, (z, s, z, z, z))
-            lens = jax.lax.dynamic_update_slice(
-                lens, jnp.reshape(tl, (1,)), (s,))
             last = jax.lax.dynamic_update_slice(last, tok, (s, z))
-            return kc, vc, lens, last, tok, RNG.key
+            return cache, last, tok, RNG.key
         finally:
             for m, a in zip(self._mutable, saved):
                 m._data = a
             RNG.key = saved_key
 
-    def _decode_fn(self, arrs, buf_arrs, key, kc, vc, lens, last):
+    def _suffix_fn(self, arrs, buf_arrs, key, cache, last, prefix,
+                   ids, true_len, slot):
+        """Prefix-hit admission: run ONLY the suffix tokens through the
+        model, attending over the cached prefix K/V (legacy concat path;
+        gpt.py applies the bottom-right causal mask), then insert
+        prefix-verbatim + fresh-suffix into the slot. `prefix` is NOT
+        donated — it stays resident in the PrefixCache for the next hit.
+        prefix_len is static (baked from the prefix arrays' shape), so
+        each (prefix bucket, suffix bucket) pair is its own executable.
+        """
+        import jax
+        import jax.numpy as jnp
+        self._traces["suffix"] += 1
+        p = int(prefix[0].shape[3])
+        saved = [m._data for m in self._mutable]
+        saved_key = RNG.key
+        try:
+            for m, a in zip(self._weights, arrs):
+                m._data = a
+            for b, a in zip(self._buffers, buf_arrs):
+                b._data = a
+            RNG.key = key
+            gpt = self._gpt
+            if self.kv.quantized:
+                pk, pv, pks, pvs = prefix
+                pkf = cache_mod.dequantize_kv(pk, pks)
+                pvf = cache_mod.dequantize_kv(pv, pvs)
+            else:
+                pk, pv = prefix
+                pkf, pvf = pk, pv
+            legacy = [(Tensor(pkf[i], _internal=True),
+                       Tensor(pvf[i], _internal=True))
+                      for i in range(self._n_layers)]
+            sb = int(ids.shape[1])
+            pos = jnp.arange(sb, dtype=jnp.int32) + jnp.int32(p)
+            with state.trace_guard(), state.no_grad_guard(), \
+                    state.mesh_guard(None):
+                hidden, kvs = gpt(Tensor(ids, _internal=True),
+                                  Tensor(pos, _internal=True), legacy)
+                from ...models.gpt import _lm_logits
+                tl = true_len.astype(jnp.int32)
+                # hidden covers ONLY the suffix: its true last row sits
+                # at (total_len - prefix_len) - 1
+                h_last = jax.lax.dynamic_slice(
+                    hidden._data,
+                    (jnp.int32(0), tl - jnp.int32(p) - 1, jnp.int32(0)),
+                    (1, 1, self._hidden))
+                logits = _lm_logits(
+                    Tensor(h_last, _internal=True),
+                    gpt.embeddings.word_embeddings.weight)
+            tok = jnp.argmax(logits._data, axis=-1).astype(jnp.int32)
+            # kvs are prefix+suffix concats; keep only the fresh suffix —
+            # the stored prefix is re-inserted untouched (for int8 that
+            # means NO dequantize->requantize round trip on a hit)
+            ks = jnp.stack([c[0]._data[:, :, p:, :] for c in kvs])
+            vs = jnp.stack([c[1]._data[:, :, p:, :] for c in kvs])
+            cache = self._insert_kv(cache, ks, vs, tl, slot,
+                                    offset=p, prefix=prefix)
+            s, z = slot.astype(jnp.int32), jnp.int32(0)
+            last = jax.lax.dynamic_update_slice(last, tok, (s, z))
+            return cache, last, tok, RNG.key
+        finally:
+            for m, a in zip(self._mutable, saved):
+                m._data = a
+            RNG.key = saved_key
+
+    def _decode_fn(self, arrs, buf_arrs, key, cache, last):
         import jax.numpy as jnp
         self._traces["decode"] += 1
         saved = [m._data for m in self._mutable]
@@ -182,7 +332,11 @@ class GenerationEngine:
                 b._data = a
             RNG.key = key
             gpt = self._gpt
-            views = [cache_mod.LayerCacheView(kc[i], vc[i], lens)
+            kc, vc, ksc, vsc, lens = self._split_cache(cache)
+            views = [cache_mod.LayerCacheView(
+                        kc[i], vc[i], lens,
+                        None if ksc is None else ksc[i],
+                        None if vsc is None else vsc[i])
                      for i in range(self._n_layers)]
             # new token's absolute position == tokens already resident;
             # clamped so idle slots that hit the wall index a real row
@@ -198,8 +352,11 @@ class GenerationEngine:
             tok = jnp.argmax(logits._data, axis=-1).astype(jnp.int32)
             kc = jnp.stack([v.k for v in views])
             vc = jnp.stack([v.v for v in views])
+            if self.kv.quantized:
+                ksc = jnp.stack([v.k_scale for v in views])
+                vsc = jnp.stack([v.v_scale for v in views])
             lens = jnp.minimum(lens + 1, jnp.int32(self.max_seq_len))
-            return kc, vc, lens, tok, RNG.key
+            return self._join_cache(kc, vc, ksc, vsc, lens), tok, RNG.key
         finally:
             for m, a in zip(self._mutable, saved):
                 m._data = a
@@ -210,14 +367,40 @@ class GenerationEngine:
     def bucket_for(self, length: int) -> int:
         return cache_mod.bucket_for(length, self.buckets)
 
+    def _suffix_bucket(self, suffix_len: int, prefix_len: int):
+        """Smallest bucket holding the suffix such that prefix+bucket
+        still fits the cache time axis; None -> fall back to a cold
+        prefill (the hit would overflow the slot)."""
+        for b in self.buckets:
+            if b >= suffix_len and prefix_len + b <= self.max_seq_len:
+                return b
+        return None
+
     def prefill(self, slot: int, prompt) -> int:
-        """Admit a prompt into `slot`; returns its first generated token."""
+        """Admit a prompt into `slot`; returns its first generated token.
+
+        Consults the PrefixCache first: on a hit only the suffix runs
+        through the model; on a miss the full bucketed prefill runs and
+        the prompt's largest bucket-aligned head is stored for the next
+        request that shares it. `admit_info` is left describing this
+        admission (reused prefix_len + dispatched bucket)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         n = int(prompt.shape[0])
         if n < 1:
             raise ValueError("empty prompt")
         if not 0 <= slot < self.max_batch:
             raise ValueError("slot %d out of range" % slot)
+        reused, entry, sb = 0, None, None
+        if self.prefix_cache is not None:
+            reused, entry = self.prefix_cache.lookup(prompt)
+            if entry is not None:
+                sb = self._suffix_bucket(n - reused, reused)
+                if sb is None:
+                    reused, entry = 0, None
+        if entry is not None:
+            tok = self._suffix_prefill(slot, prompt, n, reused, entry, sb)
+            self.admit_info = {"prefix_len": reused, "bucket": sb}
+            return tok
         b = self.bucket_for(n)
         padded = np.full((1, b), self.pad_id, np.int32)
         padded[0, :n] = prompt
@@ -225,26 +408,66 @@ class GenerationEngine:
         PREFILL_BUCKET_HITS.labels(str(b)).inc()
         with _DISPATCH_LOCK:
             with self._prefill_tel.step(("prefill", b)):
-                kc, vc, lens, last, tok, key = self._jit_prefill(
+                kvstate, last, tok, key = self._jit_prefill(
                     [p._data for p in self._weights],
                     [bf._data for bf in self._buffers], RNG.key,
-                    self.kv.k, self.kv.v, self.kv.lens, self._last,
+                    self.kv.state(), self._last,
                     padded, np.int32(n), np.int32(slot))
             RNG.key = key
-            self.kv.set_state(kc, vc, lens)
+            self.kv.set_state(kvstate)
+            self._last = last
+            if self.prefix_cache is not None:
+                self._store_prefix(prompt, n, slot)
+        self.admit_info = {"prefix_len": 0, "bucket": b}
+        return int(np.asarray(tok)[0, 0])
+
+    def _suffix_prefill(self, slot, prompt, n, p, entry, sb) -> int:
+        padded = np.full((1, sb), self.pad_id, np.int32)
+        padded[0, :n - p] = prompt[p:]
+        self.bucket_hits[sb] += 1
+        PREFILL_BUCKET_HITS.labels(str(sb)).inc()
+        with _DISPATCH_LOCK:
+            with self._suffix_tel.step(("suffix", p, sb)):
+                kvstate, last, tok, key = self._jit_suffix(
+                    [w._data for w in self._weights],
+                    [bf._data for bf in self._buffers], RNG.key,
+                    self.kv.state(), self._last, entry,
+                    padded, np.int32(n), np.int32(slot))
+            RNG.key = key
+            self.kv.set_state(kvstate)
             self._last = last
         return int(np.asarray(tok)[0, 0])
+
+    def _store_prefix(self, prompt, n: int, slot: int) -> None:
+        """Harvest the slot's freshly-prefilled K/V head (largest bucket
+        <= prompt length) and admit it to the PrefixCache. The slices
+        materialize NEW device buffers, so later donations of the paged
+        cache can't invalidate a stored prefix. Called under the
+        dispatch lock, right after set_state."""
+        p_store = 0
+        for b in self.buckets:
+            if b <= n:
+                p_store = b
+        if not p_store:
+            return
+        s = int(slot)
+        arrays = [self.kv.k[:, s:s + 1, :, :p_store, :],
+                  self.kv.v[:, s:s + 1, :, :p_store, :]]
+        if self.kv.quantized:
+            arrays += [self.kv.k_scale[:, s:s + 1, :, :p_store],
+                       self.kv.v_scale[:, s:s + 1, :, :p_store]]
+        self.prefix_cache.store(prompt[:p_store], arrays)
 
     def decode(self) -> np.ndarray:
         """One decode step for the whole batch; next token per slot."""
         with _DISPATCH_LOCK:
             with self._decode_tel.step("decode"):
-                kc, vc, lens, tok, key = self._jit_decode(
+                kvstate, tok, key = self._jit_decode(
                     [p._data for p in self._weights],
                     [bf._data for bf in self._buffers], RNG.key,
-                    self.kv.k, self.kv.v, self.kv.lens, self._last)
+                    self.kv.state(), self._last)
             RNG.key = key
-            self.kv.set_state(kc, vc, lens)
+            self.kv.set_state(kvstate)
             self._last = tok
         return np.asarray(tok).reshape(-1)
 
@@ -252,8 +475,15 @@ class GenerationEngine:
 
     @property
     def prefill_compiles(self) -> int:
-        """Actual jax traces of the prefill body (must stay <= n buckets)."""
+        """Actual jax traces of the cold-prefill body (<= n buckets)."""
         return self._traces["prefill"]
+
+    @property
+    def suffix_prefill_compiles(self) -> int:
+        """Actual jax traces of the suffix body (<= observed
+        (prefix, suffix-bucket) pairs; separate from prefill_compiles
+        so the prefill<=n_buckets gate stays exact)."""
+        return self._traces["suffix"]
 
     @property
     def decode_compiles(self) -> int:

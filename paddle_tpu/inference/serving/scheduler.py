@@ -24,7 +24,6 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...observability import journal, metrics, spans
-from .cache import bucket_for
 
 __all__ = ["Request", "ContinuousBatcher", "run_open_loop"]
 
@@ -60,6 +59,7 @@ class Request:
     ttft_s: Optional[float] = None        # submit -> first token
     latency_s: Optional[float] = None     # submit -> completion
     slot: Optional[int] = None
+    prefix_len: int = 0                   # cached-prefix tokens reused
     on_complete: Optional[Callable[["Request"], None]] = None
     span: Optional[object] = None         # serve_request spans.begin handle
 
@@ -117,7 +117,9 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        bucket_for(int(prompt.shape[0]), self.engine.buckets)
+        # single source of truth for bucketing lives in serving/cache.py;
+        # the engine method is its thin delegate
+        self.engine.bucket_for(int(prompt.shape[0]))
         if prompt.shape[0] + req.max_new_tokens > self.engine.max_seq_len:
             raise ValueError(
                 "prompt (%d) + max_new_tokens (%d) exceeds max_seq_len %d"
@@ -168,21 +170,27 @@ class ContinuousBatcher:
             tok = self.engine.prefill(slot, req.prompt)
             now = self._clock()
             req.ttft_s = now - req.submit_ts
+            # what THIS admission actually dispatched: on a prefix hit
+            # the bucket is the (smaller) suffix bucket and prefix_len
+            # counts the reused tokens
+            info = getattr(self.engine, "admit_info", None) or \
+                {"prefix_len": 0, "bucket": self.engine.bucket_for(n)}
+            req.prefix_len = int(info.get("prefix_len", 0))
             # queue_wait + prefill == ttft_s exactly: same clock, same
             # instants — the TTFT decomposition SERVING.md documents
             spans.record("queue_wait", (t_pre - req.submit_ts) * 1e3,
                          parent="serve_request", rid=req.rid)
             spans.record("prefill", (now - t_pre) * 1e3,
                          parent="serve_request", rid=req.rid,
-                         bucket=self.engine.bucket_for(n))
+                         bucket=info["bucket"])
             req.tokens.append(tok)
             req.slot = slot
             ADMITTED.inc()
             TOKENS.inc()
             TTFT.observe(req.ttft_s)
             journal.emit("serve_admit", rid=req.rid, slot=slot,
-                         prompt_len=n,
-                         bucket=self.engine.bucket_for(n))
+                         prompt_len=n, bucket=info["bucket"],
+                         prefix_len=req.prefix_len)
             if req.done:          # max_new_tokens == 1 (or instant eos)
                 self._complete(req, completed)
             else:

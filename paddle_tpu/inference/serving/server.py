@@ -73,13 +73,19 @@ class InferenceServer:
     def __init__(self, model, max_batch: int = 4, max_seq_len: int = 128,
                  prefill_buckets: Sequence[int] = (32, 64, 128),
                  pad_id: int = 0, workers: int = 1,
-                 poll_s: float = 0.002, http_port=None):
+                 poll_s: float = 0.002, http_port=None,
+                 kv_dtype: str = "float32", prefix_cache_bytes=None):
         if workers < 1:
             raise ValueError("workers must be >= 1")
+        # each worker gets its OWN prefix cache (an engine's stored K/V
+        # slices must never outlive into another engine's donation
+        # lifecycle); kv_dtype="int8" halves each worker's cache bytes
         self._engines = [
             GenerationEngine(model, max_batch=max_batch,
                              max_seq_len=max_seq_len,
-                             prefill_buckets=prefill_buckets, pad_id=pad_id)
+                             prefill_buckets=prefill_buckets, pad_id=pad_id,
+                             kv_dtype=kv_dtype,
+                             prefix_cache_bytes=prefix_cache_bytes)
             for _ in range(workers)]
         self._queue: "queue.Queue[ServeHandle]" = queue.Queue()
         self._poll_s = poll_s

@@ -93,6 +93,13 @@ def _paged_decode_attention(q, k, v, view):
     `dynamic_update_slice` (a scatter — indices are traced, shapes are
     not), then scores over positions > lens are masked off. Replaces
     the growing `concat` cache so the decode step compiles once.
+
+    When the view carries scales (int8 cache), the step's K/V is
+    quantized before the write — the int8 payload and its float32
+    per-token scale land at the same index — and the whole buffer is
+    dequantized right next to the matmul (values x scale), the standard
+    quantized-paged-attention layout. Same shapes either way, so the
+    quantized decode is still one compiled program.
     """
     import jax
     import jax.numpy as jnp
@@ -104,18 +111,64 @@ def _paged_decode_attention(q, k, v, view):
         return jax.lax.dynamic_update_slice(
             buf, new, (z, ln.astype(jnp.int32), z))
 
-    kb = jax.vmap(_write)(view.k, ka.astype(view.k.dtype), lens)
-    vb = jax.vmap(_write)(view.v, va.astype(view.v.dtype), lens)
-    view.k, view.v = kb, vb
+    def _write_scale(buf, new, ln):
+        return jax.lax.dynamic_update_slice(
+            buf, new, (jnp.int32(0), ln.astype(jnp.int32)))
+
+    if view.k_scale is not None:
+        from ..inference.serving.cache import quantize_kv
+        qk, k_sc = quantize_kv(ka)      # int8 [B,nh,1,hd] + f32 [B,nh,1]
+        qv, v_sc = quantize_kv(va)
+        kb = jax.vmap(_write)(view.k, qk, lens)
+        vb = jax.vmap(_write)(view.v, qv, lens)
+        ksb = jax.vmap(_write_scale)(view.k_scale, k_sc, lens)
+        vsb = jax.vmap(_write_scale)(view.v_scale, v_sc, lens)
+        view.k, view.v = kb, vb
+        view.k_scale, view.v_scale = ksb, vsb
+        kf = kb.astype(jnp.float32) * ksb[..., None]
+        vf = vb.astype(jnp.float32) * vsb[..., None]
+    else:
+        kb = jax.vmap(_write)(view.k, ka.astype(view.k.dtype), lens)
+        vb = jax.vmap(_write)(view.v, va.astype(view.v.dtype), lens)
+        view.k, view.v = kb, vb
+        kf = kb.astype(jnp.float32)
+        vf = vb.astype(jnp.float32)
     scale = 1.0 / math.sqrt(qa.shape[-1])
     scores = jnp.einsum("bhqd,bhkd->bhqk", qa.astype(jnp.float32),
-                        kb.astype(jnp.float32)) * scale
+                        kf) * scale
     # the freshly written token sits AT index lens -> keep positions <= lens
     valid = (jnp.arange(kb.shape[2])[None, None, None, :]
              <= lens[:, None, None, None])
     scores = jnp.where(valid, scores, jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vb.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
+    return Tensor(out.astype(qa.dtype), _internal=True)
+
+
+def _prefix_concat_attention(q, k, v, prefix_len):
+    """Suffix-prefill attention: Tq suffix queries over prefix+suffix keys.
+
+    Query i sits at ABSOLUTE position prefix_len + i, so it may attend
+    keys j <= prefix_len + i — a bottom-right-aligned causal mask. The
+    plain `is_causal` path aligns top-left (query i sees keys j <= i),
+    which would hide the reused prefix from every early suffix query;
+    that is why the serving engine's prefix-hit path needs its own mask.
+    Right-padding within the suffix bucket stays exact: a pad key at
+    absolute position prefix_len + j is visible only to queries i >= j,
+    which are themselves pad.
+    """
+    import jax
+    import jax.numpy as jnp
+    qa, ka, va = q._data, k._data, v._data
+    scale = 1.0 / math.sqrt(qa.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qa.astype(jnp.float32),
+                        ka.astype(jnp.float32)) * scale
+    tq, tk = qa.shape[2], ka.shape[2]
+    valid = (jnp.arange(tk)[None, :]
+             <= (jnp.int32(prefix_len) + jnp.arange(tq))[:, None])
+    scores = jnp.where(valid[None, None], scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, va.astype(jnp.float32))
     return Tensor(out.astype(qa.dtype), _internal=True)
 
 
@@ -156,9 +209,17 @@ class GPTAttention(Layer):
                 (B, T, self.hidden_size))
             return self.out_proj(out), cache
         if cache is not None:
+            prefix_len = cache[0].shape[2]
             k = mp.concat([cache[0], k], axis=2)
             v = mp.concat([cache[1], v], axis=2)
             cache = (k, v)
+            if q.shape[2] > 1 and prefix_len > 0:
+                # serving suffix-prefill: multi-token queries behind a
+                # non-empty cache need the bottom-right causal mask
+                out = _prefix_concat_attention(q, k, v, prefix_len)
+                out = out.transpose((0, 2, 1, 3)).reshape(
+                    (B, T, self.hidden_size))
+                return self.out_proj(out), cache
         causal = cache is None or q.shape[2] > 1
         out = None
         if cache is None:

@@ -275,7 +275,13 @@ def build_status() -> dict:
                       ("batch_occupancy", "pt_serve_batch_occupancy"),
                       ("admitted", "pt_serve_admitted_total"),
                       ("completed", "pt_serve_completed_total"),
-                      ("tokens", "pt_serve_tokens_total")):
+                      ("tokens", "pt_serve_tokens_total"),
+                      ("prefix_cache_hits", "pt_prefix_cache_hits_total"),
+                      ("prefix_cache_misses",
+                       "pt_prefix_cache_misses_total"),
+                      ("prefix_cache_evictions",
+                       "pt_prefix_cache_evictions_total"),
+                      ("prefix_cache_bytes", "pt_prefix_cache_bytes")):
         v = _scalar(name)
         if v is not None:
             serving[key] = int(v) if float(v).is_integer() else v

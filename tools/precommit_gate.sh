@@ -416,9 +416,11 @@ fi
 # Serving smoke (docs/SERVING.md): 4 staggered requests through the
 # threaded InferenceServer must all complete with their full token
 # budget, the decode step must compile exactly ONCE (a second trace in
-# the fixed-shape decode loop is a retrace bug), and the gpt2_generate
-# bench must emit a valid gated JSON row where continuous batching
-# beats static sequential batching on the same open-loop workload.
+# the fixed-shape decode loop is a retrace bug), two staggered requests
+# sharing a system prompt must make the second admission a prefix-cache
+# HIT whose TTFT beats a cold admission's, and the gpt2 bench must emit
+# valid gated JSON rows where continuous batching beats static
+# sequential batching and the prefix/int8 multipliers hold.
 if [ "$rc" -eq 0 ]; then
     timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
 import time
@@ -449,6 +451,46 @@ assert RETRACES.labels("serve_decode").value == 1.0, \
     RETRACES.labels("serve_decode").value
 print("SERVING_SMOKE=ok (4 staggered requests complete, decode compiled "
       "once, prefill compiles=%d/2 buckets)" % eng.prefill_compiles)
+
+# shared-prefix reuse (docs/SERVING.md "Prefix cache"): requests
+# sharing a 48-token system prompt — after a warmup pass compiles both
+# admission paths, a prefix-HIT admission (suffix-only prefill) must
+# beat a cold full-bucket admission on TTFT
+head = rs.randint(0, 64, (48,))
+
+
+def req(suffix_len, shared):
+    base = head if shared else rs.randint(0, 64, (48,))
+    return np.concatenate([base, rs.randint(0, 64, (suffix_len,))])
+
+
+with InferenceServer(m, max_batch=2, max_seq_len=64,
+                     prefill_buckets=(8, 48, 56),
+                     prefix_cache_bytes=32 << 20) as srv:
+    eng = srv.engines[0]
+    # warm: store the shared prefix, compile the cold-56 bucket and the
+    # (48, 8) suffix executables — the timed loop reuses all three
+    srv.submit(req(4, True), max_new_tokens=2).result(timeout=120)
+    srv.submit(req(2, True), max_new_tokens=2).result(timeout=120)
+    srv.submit(req(3, False), max_new_tokens=2).result(timeout=120)
+    assert eng.prefix_cache.hits == 1, eng.prefix_cache.hits
+    miss_t, hit_t = [], []
+    for _ in range(3):
+        hm = srv.submit(req(3, False), max_new_tokens=2)
+        hm.result(timeout=120)
+        hh = srv.submit(req(3, True), max_new_tokens=2)
+        hh.result(timeout=120)
+        assert hm.request.prefix_len == 0, hm.request.prefix_len
+        assert hh.request.prefix_len == 48, hh.request.prefix_len
+        miss_t.append(hm.request.ttft_s)
+        hit_t.append(hh.request.ttft_s)
+    hits = eng.prefix_cache.hits
+    assert hits == 4, hits
+    assert eng.decode_compiles == 1, eng.decode_compiles
+assert min(hit_t) < min(miss_t), (hit_t, miss_t)
+print("SERVING_SMOKE=ok+prefix (hit ttft %.1fms < miss ttft %.1fms over "
+      "%d hits, decode compiled once)"
+      % (min(hit_t) * 1e3, min(miss_t) * 1e3, hits))
 EOF
     smoke_rc=$?
     if [ "$smoke_rc" -ne 0 ]; then
@@ -457,12 +499,15 @@ EOF
     fi
 fi
 
-# Serving bench gate: the capture artifact row must parse and its gates
-# must hold (decode_compile_once, prefill_le_buckets,
-# continuous_beats_static) — bench.py emits bench_gate_failed otherwise.
+# Serving bench gate: both capture artifact rows must parse and their
+# gates must hold — gpt2_generate (decode_compile_once,
+# prefill_le_buckets, continuous_beats_static) and gpt2_prefix_int8
+# (prefix hit TTFT <= 0.6x miss, reuse tokens/s >= no-reuse, int8
+# greedy parity >= 64 tokens, int8 bytes <= 0.55x bf16, int8 decode
+# compiles once) — bench.py emits bench_gate_failed otherwise.
 if [ "$rc" -eq 0 ]; then
     SERVE_LOG="$(mktemp /tmp/pt_serve_bench_XXXXXX.json)"
-    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    timeout -k 10 480 env JAX_PLATFORMS=cpu \
         python benchmarks/inference_bench.py gpt2 > "$SERVE_LOG" 2>&1
     bench_rc=$?
     if [ "$bench_rc" -eq 0 ]; then
@@ -478,6 +523,18 @@ assert row["gates"] and all(row["gates"].values()), row["gates"]
 print("SERVING_BENCH=ok (%.0f tok/s, ttft p50=%.0fms, "
       "continuous/static=%.2fx)" % (row["tokens_per_s"],
                                     row["ttft_ms_p50"], row["speedup_x"]))
+row = next(r for r in rows if r.get("config") == "gpt2_prefix_int8")
+assert "error" not in row, row
+for k in ("tokens_per_s", "noreuse_tokens_per_s", "prefix_ttft_ratio",
+          "int8_parity_tokens", "int8_parity_ok", "int8_nbytes_ratio",
+          "gates"):
+    assert k in row, (k, sorted(row))
+assert row["gates"] and all(row["gates"].values()), row["gates"]
+print("SERVING_BENCH=ok+prefix_int8 (reuse %.0f vs %.0f tok/s, ttft "
+      "hit/miss=%.2fx, int8 parity %d/%d, bytes=%.2fx bf16)"
+      % (row["tokens_per_s"], row["noreuse_tokens_per_s"],
+         row["prefix_ttft_ratio"], row["int8_parity_tokens"],
+         row["int8_parity_total"], row["int8_nbytes_ratio"]))
 EOF
         bench_rc=$?
     fi

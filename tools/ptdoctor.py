@@ -330,6 +330,21 @@ def cmd_summary(agg, directory) -> int:
             print("    prefill buckets: " + "  ".join(
                 "%s=%d" % (k, int(v)) for k, v in sorted(
                     serve_buckets.items(), key=lambda kv: int(kv[0]))))
+        # shared-prefix KV reuse: hit rate is the serving-cost story
+        # (a hit prefills only the suffix — docs/SERVING.md)
+        pfx_hits = _counter_total(agg, directory,
+                                  "pt_prefix_cache_hits_total")
+        pfx_miss = _counter_total(agg, directory,
+                                  "pt_prefix_cache_misses_total")
+        pfx_evic = _counter_total(agg, directory,
+                                  "pt_prefix_cache_evictions_total")
+        if pfx_hits is not None or pfx_miss is not None:
+            total = (pfx_hits or 0) + (pfx_miss or 0)
+            rate = (100.0 * (pfx_hits or 0) / total) if total else 0.0
+            print("    prefix cache: hits=%d  misses=%d  evictions=%d"
+                  "  hit_rate=%.0f%%" % (int(pfx_hits or 0),
+                                         int(pfx_miss or 0),
+                                         int(pfx_evic or 0), rate))
         # per-replica view from the rollup's serving block (written by
         # rollup_metrics; regenerate with aggregate_run if stale)
         serving_roll = None
@@ -652,6 +667,16 @@ def _bench_rows(directory):
                                  "step_ms": r.get("step_ms"),
                                  "mfu": r.get("mfu"),
                                  "compile_s": r.get("compile_s")})
+            # serving rows (inference_bench.py via the TPU window) trend
+            # alongside training: throughput column = tokens_per_s, and
+            # ttft p95 gets its own column + regression flag
+            for r in data.get("inference") or []:
+                if isinstance(r, dict) and r.get("config"):
+                    rows.append({"config": r["config"],
+                                 "value": r.get("tokens_per_s"),
+                                 "unit": r.get("unit") or "tok/s",
+                                 "tokens_per_s": r.get("tokens_per_s"),
+                                 "ttft_ms_p95": r.get("ttft_ms_p95")})
         else:                                   # driver round shape
             parsed = data.get("parsed")
             if data.get("rc") not in (0, None) or not isinstance(
@@ -678,7 +703,8 @@ def cmd_bench(directory) -> int:
     per config, rows oldest->newest, each compared against the BEST
     prior row (not the previous one — a single slow round must not
     reset the bar). Flags: step_ms >110% of best, MFU <90% of best,
-    compile_s >110% of best."""
+    compile_s >110% of best; serving rows (inference_bench) flag
+    tokens_per_s <90% of best and ttft_ms_p95 >110% of best."""
     files = _bench_rows(directory)
     if not files:
         print("ptdoctor: no BENCH_*.json under %s" % directory)
@@ -692,14 +718,17 @@ def cmd_bench(directory) -> int:
         hist = by_config[config]
         unit = next((r.get("unit") for _, r in hist if r.get("unit")), "")
         print("== %s%s" % (config, "  (%s)" % unit if unit else ""))
-        print("  %-22s %12s %10s %7s %10s  %s" %
-              ("run", "value", "step_ms", "mfu", "compile_s", "flags"))
+        print("  %-22s %12s %10s %7s %10s %9s  %s" %
+              ("run", "value", "step_ms", "mfu", "compile_s", "ttft_p95",
+               "flags"))
         best = {}                   # metric -> best value over PRIOR rows
         for label, row in hist:
             flags = []
             for metric, better_low, tol in (("step_ms", True, 1.10),
                                             ("mfu", False, 0.90),
-                                            ("compile_s", True, 1.10)):
+                                            ("compile_s", True, 1.10),
+                                            ("tokens_per_s", False, 0.90),
+                                            ("ttft_ms_p95", True, 1.10)):
                 v = row.get(metric)
                 if not isinstance(v, (int, float)):
                     continue
@@ -710,7 +739,7 @@ def cmd_bench(directory) -> int:
                                  % (metric, v, b))
                 if b is None or (v < b if better_low else v > b):
                     best[metric] = v
-            print("  %-22s %12s %10s %7s %10s  %s" % (
+            print("  %-22s %12s %10s %7s %10s %9s  %s" % (
                 label,
                 "%.4g" % row["value"]
                 if isinstance(row.get("value"), (int, float)) else "-",
@@ -720,6 +749,9 @@ def cmd_bench(directory) -> int:
                 if isinstance(row.get("mfu"), (int, float)) else "-",
                 "%.4g" % row["compile_s"]
                 if isinstance(row.get("compile_s"), (int, float)) else "-",
+                "%.4g" % row["ttft_ms_p95"]
+                if isinstance(row.get("ttft_ms_p95"),
+                              (int, float)) else "-",
                 "; ".join(flags)))
     if failed:
         print("failed/unparsed runs (not trended): " + "  ".join(failed))
