@@ -86,6 +86,7 @@ def _child_main():
             "feed_stall_ms": res.get("feed_stall_ms"),
             "compile_cache": res.get("compile_cache"),
             "span_breakdown": res.get("span_breakdown"),
+            "hbm_peak": res.get("hbm_peak"),
             "batch": res["batch"],
             "seq_len": res["seq_len"],
             "attn_paths": res.get("attn_paths"),
@@ -461,6 +462,7 @@ def main():
             "feed_stall_ms": banked_gpt2.get("feed_stall_ms"),
             "compile_cache": banked_gpt2.get("compile_cache"),
             "span_breakdown": banked_gpt2.get("span_breakdown"),
+            "hbm_peak": banked_gpt2.get("hbm_peak"),
             "batch": banked_gpt2.get("batch"),
             "seq_len": banked_gpt2.get("seq_len"),
             "attn_paths": banked_gpt2.get("attn_paths"),

@@ -140,6 +140,13 @@ def _gpt_train_bench(net, B, T, steps, warmup, on_tpu, config, next_batch):
     from paddle_tpu.observability import metrics as obs_metrics
     obs_metrics.gauge("pt_tokens_per_sec",
                       "Bench throughput, tokens/sec/chip").set(tokens / dt)
+    # HBM high-water mark for the trend table (ptdoctor bench hbm_peak
+    # column): force one post-loop sample past the rate limiter, then
+    # read the same gauge /statusz and the rollup report
+    from paddle_tpu.observability import flight as obs_flight
+    obs_flight.sample_hbm(force=True, phase="step")
+    _g = obs_metrics.REGISTRY.get("pt_hbm_peak_bytes")
+    hbm_peak = int(_g.value) if _g is not None and _g.value else None
     return {"config": config,
             "throughput": round(tokens / dt, 1),
             "unit": "tokens/sec/chip",
@@ -151,6 +158,7 @@ def _gpt_train_bench(net, B, T, steps, warmup, on_tpu, config, next_batch):
             "compile_cache": {"hits": cc1[0] - cc0[0],
                               "misses": cc1[1] - cc0[1]},
             "span_breakdown": span_breakdown or None,
+            "hbm_peak": hbm_peak,
             "batch": B, "seq_len": T, "params": n_params,
             "attn_paths": attn_paths,
             "mfu": _mfu(flops, dt)}

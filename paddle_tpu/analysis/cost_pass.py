@@ -33,8 +33,8 @@ from .jaxpr_pass import JAXPR_RULES, _nbytes, _walk_jaxprs
 
 __all__ = [
     "COLLECTIVE_PRIMITIVES", "OVERLAPPABLE_PRIMITIVES",
-    "exposed_collective_findings", "step_card", "step_card_from_jaxpr",
-    "write_step_card",
+    "exposed_collective_findings", "memory_analysis", "step_card",
+    "step_card_from_jaxpr", "write_step_card",
 ]
 
 #: primitives that move data across devices (jax lax.parallel lowerings;
@@ -241,7 +241,12 @@ def step_card(step_call, inputs, labels, *, label: str = "<train_step>",
     """Step card for a compiled train step via its `analysis_handle`
     (jit/engine.py:make_train_step). When the backend exposes
     `compiled.cost_analysis()`, XLA's own totals ride along under
-    `xla_cost` for calibration of the static estimate."""
+    `xla_cost` for calibration of the static estimate; the executable
+    memory analysis (argument/output/temp/generated-code bytes, or the
+    aval-size estimate where the backend lacks memory_analysis()) rides
+    under `memory` and is banked into the memprof gauges so /statusz
+    and the OOM bundle carry it too. `device_kind` pins which peak-
+    table row `ptdoctor roofline` should read offline."""
     handle = getattr(step_call, "analysis_handle", None)
     if handle is None:
         raise ValueError(
@@ -250,16 +255,31 @@ def step_card(step_call, inputs, labels, *, label: str = "<train_step>",
     args = handle["pack"](inputs, labels)
     traced = handle["jitted"].trace(*args)
     card = step_card_from_jaxpr(traced.jaxpr, label, top_n=top_n)
+    compiled = _compile(traced) if with_xla else None
     if with_xla:
-        card["xla_cost"] = _xla_cost(traced)
+        card["xla_cost"] = _xla_cost(compiled)
+    card["memory"] = memory_analysis(traced, compiled)
+    try:
+        from ..observability import memprof
+        card["device_kind"] = memprof.device_kind()
+        memprof.bank_executable(label, card["memory"])
+    except Exception:
+        card.setdefault("device_kind", None)
     return card
 
 
-def _xla_cost(traced) -> Optional[dict]:
+def _compile(traced):
+    try:
+        return traced.lower().compile()
+    except Exception:
+        return None
+
+
+def _xla_cost(compiled) -> Optional[dict]:
     """XLA cost analysis of the compiled step, when the backend offers
     it (dict of flops/bytes accessed/optimal seconds; None elsewhere)."""
     try:
-        ca = traced.lower().compile().cost_analysis()
+        ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else None
         if not isinstance(ca, dict):
@@ -273,6 +293,53 @@ def _xla_cost(traced) -> Optional[dict]:
                     or "optimal" in k):
                 keep[k] = v
         return keep or None
+    except Exception:
+        return None
+
+
+def memory_analysis(traced, compiled=None) -> Optional[dict]:
+    """Executable memory attribution for one traced step.
+
+    Source "xla" when `compiled.memory_analysis()` is reachable
+    (argument/output/temp/generated-code section sizes of the actual
+    executable); source "avals" elsewhere (CPU backend) — the
+    invar/outvar aval footprints of the traced jaxpr, which bound the
+    argument/output sections but cannot see XLA's temp allocations
+    (reported 0, honestly)."""
+    if compiled is not None:
+        try:
+            ma = compiled.memory_analysis()
+            if isinstance(ma, (list, tuple)):
+                ma = ma[0] if ma else None
+            if ma is not None:
+                args_b = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+                out_b = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+                temp_b = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+                gen_b = int(getattr(ma, "generated_code_size_in_bytes", 0)
+                            or 0)
+                if args_b or out_b or temp_b or gen_b:
+                    return {"source": "xla", "args_bytes": args_b,
+                            "out_bytes": out_b, "temp_bytes": temp_b,
+                            "gen_code_bytes": gen_b,
+                            "total_bytes": args_b + out_b + temp_b + gen_b}
+        except Exception:
+            pass
+    try:
+        jaxpr = getattr(traced.jaxpr, "jaxpr", traced.jaxpr)
+
+        def _tot(vs):
+            n = 0
+            for v in vs:
+                a = _aval(v)
+                if a is not None and getattr(a, "shape", None) is not None:
+                    n += _nbytes(a.shape, a.dtype)
+            return n
+
+        args_b = _tot(jaxpr.invars)
+        out_b = _tot(jaxpr.outvars)
+        return {"source": "avals", "args_bytes": args_b,
+                "out_bytes": out_b, "temp_bytes": 0, "gen_code_bytes": 0,
+                "total_bytes": args_b + out_b}
     except Exception:
         return None
 

@@ -257,20 +257,15 @@ class VisualDL(Callback):
 
 
 def _device_mem_bytes():
-    """Best-effort device memory in use. TPU/GPU backends expose
-    memory_stats(); the CPU backend returns None there, so fall back to
-    summing live jax array footprints (an under-count — live python
-    handles only — but monotone with real usage)."""
+    """Best-effort device memory in use, via the ONE canonical sampler
+    (observability/memprof.py: backend memory_stats() through
+    paddle_tpu.device, live-array footprint fallback on CPU) — the same
+    read flight.sample_hbm banks, so a callback row and a crash bundle
+    can never disagree about the number."""
     try:
-        import jax
-        dev = jax.local_devices()[0]
-        stats_fn = getattr(dev, "memory_stats", None)
-        if stats_fn is not None:
-            stats = stats_fn()
-            if stats and "bytes_in_use" in stats:
-                return int(stats["bytes_in_use"])
-        return int(sum(int(getattr(a, "nbytes", 0) or 0)
-                       for a in jax.live_arrays()))
+        from ..observability import memprof
+        res = memprof.read_device_memory()
+        return int(res[0]) if res is not None else -1
     except Exception:
         return -1
 

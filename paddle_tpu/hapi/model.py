@@ -20,7 +20,7 @@ from ..framework.tensor import Tensor
 from ..io import DataLoader
 from ..metric import Metric
 from ..observability import journal as run_journal
-from ..observability import spans, tracing
+from ..observability import memprof, spans, tracing
 from ..resilience import AnomalyGuard, PreemptionGuard, chaos, health
 from .callbacks import (Callback, CallbackList, ProgBarLogger,
                         ModelCheckpoint, TelemetryCallback)
@@ -343,11 +343,17 @@ class Model:
                                     # consumed before preemption ckpt
                                     step_sp.cancel()
                                     continue
+                                # phase-boundary HBM sample (rate-limited
+                                # inside): the post-feed reading separates
+                                # host-staging growth from step growth in
+                                # the memprof timeline
+                                memprof.sample(phase="feed")
                                 chaos.step_hook(it_count)
                                 health.tick(it_count)
                                 cbk.on_train_batch_begin(step)
                                 inputs, labels = self._split_batch(batch)
                                 logs = self.train_batch(inputs, labels)
+                                memprof.sample(phase="step")
                                 cbk.on_train_batch_end(step, logs)
                                 it_count += 1
                                 if fit_state is not None:

@@ -56,7 +56,7 @@ import numpy as np
 from ...framework import state
 from ...framework.random import RNG
 from ...framework.tensor import Tensor
-from ...observability import metrics, tracing
+from ...observability import memprof, metrics, tracing
 from . import cache as cache_mod
 
 __all__ = ["GenerationEngine"]
@@ -407,12 +407,17 @@ class GenerationEngine:
         self.bucket_hits[b] += 1
         PREFILL_BUCKET_HITS.labels(str(b)).inc()
         with _DISPATCH_LOCK:
-            with self._prefill_tel.step(("prefill", b)):
-                kvstate, last, tok, key = self._jit_prefill(
-                    [p._data for p in self._weights],
-                    [bf._data for bf in self._buffers], RNG.key,
-                    self.kv.state(), self._last,
-                    padded, np.int32(n), np.int32(slot))
+            try:
+                with self._prefill_tel.step(("prefill", b)):
+                    kvstate, last, tok, key = self._jit_prefill(
+                        [p._data for p in self._weights],
+                        [bf._data for bf in self._buffers], RNG.key,
+                        self.kv.state(), self._last,
+                        padded, np.int32(n), np.int32(slot))
+            except Exception as e:
+                if memprof.is_oom(e):
+                    memprof.on_oom("serve_prefill", e)
+                raise
             RNG.key = key
             self.kv.set_state(kvstate)
             self._last = last
@@ -427,12 +432,17 @@ class GenerationEngine:
         self.bucket_hits[sb] += 1
         PREFILL_BUCKET_HITS.labels(str(sb)).inc()
         with _DISPATCH_LOCK:
-            with self._suffix_tel.step(("suffix", p, sb)):
-                kvstate, last, tok, key = self._jit_suffix(
-                    [w._data for w in self._weights],
-                    [bf._data for bf in self._buffers], RNG.key,
-                    self.kv.state(), self._last, entry,
-                    padded, np.int32(n), np.int32(slot))
+            try:
+                with self._suffix_tel.step(("suffix", p, sb)):
+                    kvstate, last, tok, key = self._jit_suffix(
+                        [w._data for w in self._weights],
+                        [bf._data for bf in self._buffers], RNG.key,
+                        self.kv.state(), self._last, entry,
+                        padded, np.int32(n), np.int32(slot))
+            except Exception as e:
+                if memprof.is_oom(e):
+                    memprof.on_oom("serve_suffix", e)
+                raise
             RNG.key = key
             self.kv.set_state(kvstate)
             self._last = last
@@ -461,11 +471,16 @@ class GenerationEngine:
     def decode(self) -> np.ndarray:
         """One decode step for the whole batch; next token per slot."""
         with _DISPATCH_LOCK:
-            with self._decode_tel.step("decode"):
-                kvstate, tok, key = self._jit_decode(
-                    [p._data for p in self._weights],
-                    [bf._data for bf in self._buffers], RNG.key,
-                    self.kv.state(), self._last)
+            try:
+                with self._decode_tel.step("decode"):
+                    kvstate, tok, key = self._jit_decode(
+                        [p._data for p in self._weights],
+                        [bf._data for bf in self._buffers], RNG.key,
+                        self.kv.state(), self._last)
+            except Exception as e:
+                if memprof.is_oom(e):
+                    memprof.on_oom("serve_decode", e)
+                raise
             RNG.key = key
             self.kv.set_state(kvstate)
             self._last = tok

@@ -183,6 +183,16 @@ class ContinuousBatcher:
             spans.record("prefill", (now - t_pre) * 1e3,
                          parent="serve_request", rid=req.rid,
                          bucket=info["bucket"])
+            if req.prefix_len > 0:
+                # prefix-cache hit: a serve_suffix child over the SAME
+                # interval as prefill (parent="prefill", not a sibling
+                # under serve_request), so queue_wait + prefill == ttft
+                # stays exact while the trace shows which admissions ran
+                # the suffix-only path
+                spans.record("serve_suffix", (now - t_pre) * 1e3,
+                             parent="prefill", rid=req.rid,
+                             prefix_len=req.prefix_len,
+                             bucket=info["bucket"])
             req.tokens.append(tok)
             req.slot = slot
             ADMITTED.inc()

@@ -29,7 +29,7 @@ from ..framework import state
 from ..framework.flags import flag
 from ..framework.random import RNG
 from ..framework.tensor import Tensor
-from ..observability import flight, tracing
+from ..observability import flight, memprof, tracing
 from ..resilience import chaos
 from ..resilience.watchdog import StepWatchdog
 
@@ -297,24 +297,41 @@ def make_train_step(network, loss_fn, optimizer, mesh=None):
         # one dict assignment: lets a crash bundle name the exact step
         # that was in flight when the process died mid-dispatch
         flight.note_dispatch("jit_train", optimizer._step_count)
-        with telemetry.step(_aval_sig(in_arrs, lab_arrs)):
-            if wd_s > 0:
-                # a wedged backend hangs INSIDE dispatch/blocking with no
-                # python-level recourse; the watchdog makes it observable
-                # (all-thread stack dump) and, with action=abort,
-                # recoverable by a supervisor. block_until_ready pulls the
-                # hang into the watchdog's scope (dispatch alone returns
-                # futures).
-                with StepWatchdog(wd_s,
-                                  context="compiled train step %d"
-                                          % optimizer._step_count,
-                                  action=str(flag("step_watchdog_action"))):
+        try:
+            with telemetry.step(_aval_sig(in_arrs, lab_arrs)):
+                if wd_s > 0:
+                    # a wedged backend hangs INSIDE dispatch/blocking with
+                    # no python-level recourse; the watchdog makes it
+                    # observable (all-thread stack dump) and, with
+                    # action=abort, recoverable by a supervisor.
+                    # block_until_ready pulls the hang into the watchdog's
+                    # scope (dispatch alone returns futures).
+                    with StepWatchdog(
+                            wd_s,
+                            context="compiled train step %d"
+                                    % optimizer._step_count,
+                            action=str(flag("step_watchdog_action"))):
+                        chaos.hang_before_dispatch(optimizer._step_count)
+                        chaos.oom_at_dispatch(optimizer._step_count)
+                        out = jitted(*args)
+                        jax.block_until_ready(out[0])
+                else:
                     chaos.hang_before_dispatch(optimizer._step_count)
+                    chaos.oom_at_dispatch(optimizer._step_count)
                     out = jitted(*args)
-                    jax.block_until_ready(out[0])
-            else:
-                chaos.hang_before_dispatch(optimizer._step_count)
-                out = jitted(*args)
+        except Exception as e:
+            # RESOURCE_EXHAUSTED forensics before the unwind: the
+            # post-mortem needs the live-buffer table captured while the
+            # buffers are still live
+            if memprof.is_oom(e):
+                memprof.on_oom("jit_train", e,
+                               step=optimizer._step_count)
+            raise
+        if not getattr(call, "_mem_banked", False):
+            call._mem_banked = True
+            memprof.bank_executable(
+                "jit_train",
+                memprof.analysis_from_arrays(args, out))
         if tracing.enabled():
             tracing.TRAIN_STEPS.inc()
         loss, out_arrs, new_bufs, new_key, new_params, new_accs, ok = out
@@ -488,10 +505,15 @@ def make_eval_step(network, loss_fn=None, mesh=None):
                                            _batch_spec(mesh, t._data.ndim)))
         in_arrs = [x._data for x in inputs]
         lab_arrs = [x._data for x in labels]
-        with telemetry.step(_aval_sig(in_arrs, lab_arrs)):
-            out_arrs, loss, new_key = jitted(
-                [p._data for p in params + frozen],
-                [b._data for b in buffers], RNG.key, in_arrs, lab_arrs)
+        try:
+            with telemetry.step(_aval_sig(in_arrs, lab_arrs)):
+                out_arrs, loss, new_key = jitted(
+                    [p._data for p in params + frozen],
+                    [b._data for b in buffers], RNG.key, in_arrs, lab_arrs)
+        except Exception as e:
+            if memprof.is_oom(e):
+                memprof.on_oom("jit_eval", e)
+            raise
         RNG.key = new_key
         outs = [Tensor(o, _internal=True) for o in out_arrs]
         return (Tensor(loss, _internal=True) if loss is not None else None,

@@ -27,12 +27,17 @@ use them):
                   `PADDLE_TPU_HTTP_PORT` is set;
   * `traceview` — journal span events merged into a Chrome-trace/
                   Perfetto JSON timeline (`ptdoctor trace`), and the
-                  shared trace-event serializer utils/profiler.py uses.
+                  shared trace-event serializer utils/profiler.py uses;
+  * `memprof`   — memory forensics: the canonical HBM sampler shared by
+                  flight and the hapi callbacks, per-engine executable
+                  memory attribution (`pt_hbm_args_bytes` /
+                  `pt_hbm_temp_bytes`), and the OOM post-mortem that
+                  gives crash bundles their `memory.json`.
 
 See docs/OBSERVABILITY.md for the metric name table, journal event
 schema, and the "Post-mortem & crash forensics" section.
 """
-from . import (aggregate, flight, httpd, journal, metrics, spans,
+from . import (aggregate, flight, httpd, journal, memprof, metrics, spans,
                traceview, tracing)
 from .aggregate import aggregate_run
 from .flight import dump_crash_bundle
@@ -43,7 +48,7 @@ from .tracing import StepTelemetry, enable, enabled, record_sync
 
 __all__ = [
     "metrics", "journal", "tracing", "flight", "aggregate", "spans",
-    "httpd", "traceview",
+    "httpd", "traceview", "memprof",
     "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "exponential_buckets",
     "RunJournal", "set_journal", "get_journal", "emit", "read_journal",

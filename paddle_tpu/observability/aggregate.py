@@ -230,6 +230,23 @@ def _serving_fold(src: str, name: str, series: List[dict],
             t["sum"] += float(s.get("sum", 0.0))
 
 
+def _hbm_fold(src: str, name: str, series: List[dict], acc: dict) -> None:
+    """Fold one snapshot's `pt_hbm_*` gauges into the hbm block: gauges
+    are level readings, so ranks combine by MAX (the fleet high-water
+    mark), never by sum — summing per-rank peaks would report a fleet
+    that "used" memory no chip ever held. Per-rank detail is preserved
+    under per_source."""
+    per_src = acc["per_source"].setdefault(src, {})
+    hw = acc["high_water"]
+    for s in series:
+        if not isinstance(s.get("value"), (int, float)):
+            continue
+        key = _series_key(name, s.get("labels") or {})
+        val = float(s["value"])
+        per_src[key] = max(per_src.get(key, val), val)
+        hw[key] = max(hw.get(key, val), val)
+
+
 def rollup_metrics(directory: str,
                    out_path: Optional[str] = None) -> Tuple[str, int]:
     """Reduce every per-rank/launch metrics snapshot to run-level stats.
@@ -240,11 +257,14 @@ def rollup_metrics(directory: str,
     `pt_serve_*` series additionally fold into a `serving` block —
     per-source counter totals plus exact cross-rank histogram
     (count, sum, mean) — so `ptdoctor summary` can show the fleet view
-    without re-reading every snapshot.
+    without re-reading every snapshot. `pt_hbm_*` gauges fold into an
+    `hbm` block (per-rank detail + max-across-ranks high_water) that
+    the launcher's fleet /statusz surfaces.
     """
     per_series: dict = {}
     hist_counts: dict = {}
     serving = {"per_source": {}, "totals": {}}
+    hbm = {"per_source": {}, "high_water": {}}
     sources = []
     for path in _snapshot_files(directory):
         try:
@@ -260,6 +280,9 @@ def rollup_metrics(directory: str,
             if name.startswith("pt_serve_"):
                 _serving_fold(os.path.basename(path), name,
                               meta.get("series", []), serving)
+            if name.startswith("pt_hbm_"):
+                _hbm_fold(os.path.basename(path), name,
+                          meta.get("series", []), hbm)
             for s in meta.get("series", []):
                 key = _series_key(name, s.get("labels") or {})
                 if "value" in s:
@@ -286,6 +309,8 @@ def rollup_metrics(directory: str,
     out = {"ts": time.time(), "sources": sources, "series": series}
     if serving["per_source"]:
         out["serving"] = serving
+    if hbm["per_source"]:
+        out["hbm"] = hbm
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "w") as f:
         json.dump(out, f, indent=1)

@@ -52,7 +52,7 @@ import time
 import traceback
 from typing import Optional
 
-from . import metrics
+from . import memprof, metrics
 
 __all__ = ["record", "record_raw", "note_compile", "note_dispatch",
            "note_step", "step_finished", "sample_hbm", "configure",
@@ -156,43 +156,35 @@ def step_finished(engine: str, dt: float, miss: bool = False) -> None:
         _ring.append({"ts": round(time.time(), 6),
                       "event": "compile_end" if miss else "step_end",
                       "engine": engine, "dt": round(dt, 6)})
-        sample_hbm()
+        sample_hbm(phase="dispatch")
     except Exception:
         pass
 
 
 # ------------------------------------------------------------- HBM gauges
-def sample_hbm(force: bool = False) -> Optional[int]:
+def sample_hbm(force: bool = False, phase: Optional[str] = None
+               ) -> Optional[int]:
     """Sample device memory into pt_hbm_bytes_in_use / pt_hbm_peak_bytes.
 
-    TPU/GPU backends expose memory_stats(); the CPU backend does not, so
-    the fallback sums live jax array footprints (an under-count, but
-    monotone with real usage — same contract as TelemetryCallback's
-    sampler). jax is read from sys.modules only: a process that never
-    imported jax has no device memory to sample. Rate-limited
-    (PADDLE_TPU_HBM_SAMPLE_S, default 0.5s); the first call always
-    samples so a 2-step fit still populates the gauges."""
+    The read itself is memprof.read_device_memory() — the ONE sampler
+    (backend memory_stats() via the canonical device helper, live-array
+    footprint fallback on CPU) this module, memprof and the hapi
+    TelemetryCallback all share. Rate-limited (PADDLE_TPU_HBM_SAMPLE_S,
+    default 0.5s); the first call always samples so a 2-step fit still
+    populates the gauges. Each real sample also lands in the flight
+    ring (`hbm` event) and memprof's phase-tagged history, so a crash
+    bundle carries the recent HBM timeline."""
     global _hbm_last_sample, _hbm_peak, _g_in_use, _g_peak
     now = time.monotonic()
     if not force and _hbm_last_sample and \
             now - _hbm_last_sample < _env_float(ENV_HBM_INTERVAL, 0.5):
         return None
-    jax = sys.modules.get("jax")
-    if jax is None:
+    res = memprof.read_device_memory()
+    if res is None:
         return None
     _hbm_last_sample = now
     try:
-        in_use = peak = None
-        dev = jax.local_devices()[0]
-        stats_fn = getattr(dev, "memory_stats", None)
-        if stats_fn is not None:
-            stats = stats_fn()
-            if stats and "bytes_in_use" in stats:
-                in_use = int(stats["bytes_in_use"])
-                peak = stats.get("peak_bytes_in_use")
-        if in_use is None:
-            in_use = int(sum(int(getattr(a, "nbytes", 0) or 0)
-                             for a in jax.live_arrays()))
+        in_use, peak = res
         _hbm_peak = max(_hbm_peak, float(in_use))
         if peak is None:
             peak = _hbm_peak
@@ -206,6 +198,10 @@ def sample_hbm(force: bool = False) -> Optional[int]:
                 "running max of samples when the backend lacks it)")
         _g_in_use.set(in_use)
         _g_peak.set(float(peak))
+        memprof.note_sample(in_use, peak, phase=phase)
+        _ring.append({"ts": round(time.time(), 6), "event": "hbm",
+                      "in_use": int(in_use), "peak": int(peak),
+                      "phase": phase})
         return in_use
     except Exception:
         return None
@@ -301,7 +297,9 @@ def _env_fingerprint() -> dict:
 
 def dump_crash_bundle(reason: str, exc: Optional[BaseException] = None,
                       last_step: Optional[int] = None,
-                      force: bool = False, **info) -> Optional[str]:
+                      force: bool = False,
+                      memory: Optional[dict] = None,
+                      **info) -> Optional[str]:
     """Write the crash bundle; returns its path (None when no directory
     is configured). Once per process by default — a fit-loop dump
     followed by the excepthook firing on the same exception must not
@@ -310,7 +308,12 @@ def dump_crash_bundle(reason: str, exc: Optional[BaseException] = None,
     metrics snapshot racing a writer) cannot void the others. The
     `crash_bundle` journal line is emitted BEFORE returning: the
     journal flushes per line, so it survives an immediately following
-    SIGKILL (the chaos kill path dumps pre-mortem)."""
+    SIGKILL (the chaos kill path dumps pre-mortem). `memory` (the
+    memprof OOM payload: live-buffer table, executable analyses, HBM
+    history) is written as its own memory.json artifact; when it is
+    not supplied but the executable bank or sample history has
+    content, a best-effort memory.json is synthesized so every bundle
+    answers "where were the bytes"."""
     global _dumped_path, _last_step
     base = _resolve_dir()
     if not base:
@@ -367,6 +370,20 @@ def dump_crash_bundle(reason: str, exc: Optional[BaseException] = None,
     try:
         with open(os.path.join(bdir, "env.json"), "w") as f:
             json.dump(_env_fingerprint(), f, indent=1, default=str)
+    except Exception:
+        pass
+    try:
+        if memory is None:
+            bank = memprof.executable_bank()
+            hist = memprof.hbm_history()
+            if bank or hist:
+                memory = {"reason": reason,
+                          "device_kind": memprof.device_kind(),
+                          "buffers": memprof.live_buffer_table(),
+                          "executables": bank, "hbm_history": hist}
+        if memory is not None:
+            with open(os.path.join(bdir, "memory.json"), "w") as f:
+                json.dump(memory, f, indent=1, default=str)
     except Exception:
         pass
     try:

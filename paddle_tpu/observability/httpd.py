@@ -299,6 +299,18 @@ def build_status() -> dict:
         v = _scalar(name)
         if v is not None:
             hbm[key] = int(v)
+    for key, name in (("args", "pt_hbm_args_bytes"),
+                      ("temp", "pt_hbm_temp_bytes")):
+        per_engine = _by_label(name, "engine")
+        if per_engine:
+            hbm[key] = {k: int(v) for k, v in sorted(per_engine.items())}
+    try:
+        from . import memprof
+        bank = memprof.executable_bank()
+        if bank:
+            hbm["executables"] = bank
+    except Exception:
+        pass
     if hbm:
         st["hbm_bytes"] = hbm
     with _plug_lock:
@@ -346,6 +358,10 @@ def fleet_status(fleet_dir: str, timeout_s: float = 2.0) -> dict:
                              "series": len(rollup.get("series") or {})}
             if rollup.get("serving"):
                 out["rollup"]["serving"] = rollup["serving"].get("totals")
+            if rollup.get("hbm"):
+                # fleet-wide HBM high-water mark (max across ranks) next
+                # to the per-rank detail the ranks themselves answer
+                out["rollup"]["hbm"] = rollup["hbm"].get("high_water")
     except (OSError, ValueError):
         pass
     return out

@@ -1,0 +1,291 @@
+"""Memory forensics: one HBM sampler, executable memory attribution,
+and OOM post-mortems with named causes.
+
+The time half of the observability plane (spans, step telemetry,
+flight ring) answers "where did the time go"; this module answers
+"where did the bytes go":
+
+  * **One sampler.** `read_device_memory()` is the canonical device
+    memory read (backend `memory_stats()` via `paddle_tpu.device` when
+    available, `live_arrays()` nbytes-sum fallback on backends without
+    it — the CPU contract). flight.sample_hbm and the hapi
+    TelemetryCallback both delegate here instead of carrying their own
+    copy-pasted fallbacks.
+  * **Executable attribution.** `bank_executable(engine, analysis)`
+    keeps the per-engine compiled-executable memory analysis
+    (argument/output/temp/generated-code bytes from XLA's
+    `compiled.memory_analysis()`, or an aval-size estimate where the
+    backend lacks it) and exports `pt_hbm_args_bytes` /
+    `pt_hbm_temp_bytes` gauges, labeled by engine. The step card
+    (analysis/cost_pass.py) and the jit/serving engines feed it; the
+    /statusz hbm block and the OOM bundle read it back.
+  * **Phase timeline.** `note_sample()` rings a bounded
+    (ts, phase, in_use, peak) history next to the flight ring's `hbm`
+    events so a post-mortem can see the sawtooth, not just the peak.
+  * **OOM forensics.** `on_oom(engine, exc)` turns an opaque
+    `RESOURCE_EXHAUSTED` into evidence: an `oom` journal event, a
+    `pt_oom_total` counter, and a crash bundle carrying `memory.json`
+    (top-N live buffers grouped by shape/dtype, the executable bank,
+    the HBM history). `resilience/chaos.py`'s `oom:K` injection raises
+    a synthetic RESOURCE_EXHAUSTED through the same dispatch catch so
+    the whole path is drillable on the CPU mesh.
+
+Pure stdlib by contract (same rule as flight.py): jax is only read
+from sys.modules, never imported, so jax-free processes (ptdoctor, the
+launcher) can load this file. Every public function is best-effort —
+observing memory must never be what exhausts it.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import time
+from typing import Optional, Tuple
+
+from . import metrics
+
+__all__ = [
+    "read_device_memory", "device_kind", "sample", "note_sample",
+    "hbm_history", "bank_executable", "executable_bank",
+    "analysis_from_arrays", "live_buffer_table", "is_oom", "on_oom",
+    "reset",
+]
+
+ENV_HISTORY = "PADDLE_TPU_HBM_HISTORY"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+#: (ts, phase, in_use, peak) samples, oldest first
+_history = collections.deque(maxlen=max(8, _env_int(ENV_HISTORY, 64)))
+#: engine/label -> memory-analysis dict (last one banked wins per key)
+_bank: dict = {}
+_g_args = _g_temp = None
+_oom_counter = None
+
+
+# ----------------------------------------------------------------- sampler
+def read_device_memory() -> Optional[Tuple[int, Optional[int]]]:
+    """Canonical device-memory read: (bytes_in_use, backend_peak|None),
+    or None when jax was never imported or every read path failed.
+
+    Prefers the backend's memory_stats() through the canonical
+    `paddle_tpu.device.memory_stats()` helper (sys.modules only — this
+    module never imports jax or the package); falls back to summing
+    live jax array footprints, an under-count but monotone with real
+    usage, which is what the CPU backend gets."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        stats = None
+        device_mod = sys.modules.get("paddle_tpu.device")
+        if device_mod is not None:
+            stats = device_mod.memory_stats()
+        else:
+            dev = jax.local_devices()[0]
+            stats_fn = getattr(dev, "memory_stats", None)
+            stats = dict(stats_fn() or {}) if stats_fn else {}
+        if stats and "bytes_in_use" in stats:
+            peak = stats.get("peak_bytes_in_use")
+            return (int(stats["bytes_in_use"]),
+                    int(peak) if peak is not None else None)
+        in_use = int(sum(int(getattr(a, "nbytes", 0) or 0)
+                         for a in jax.live_arrays()))
+        return (in_use, None)
+    except Exception:
+        return None
+
+
+def device_kind() -> Optional[str]:
+    """device_kind of device 0 ("cpu", "TPU v5 lite", ...) so offline
+    tooling (ptdoctor roofline) can pick a peak-table row. sys.modules
+    only; None in jax-free processes."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return str(jax.local_devices()[0].device_kind)
+    except Exception:
+        return None
+
+
+def note_sample(in_use: int, peak: Optional[float],
+                phase: Optional[str] = None) -> None:
+    """Append one sample to the bounded history (called by
+    flight.sample_hbm after the gauges are set). Never raises."""
+    try:
+        _history.append({"ts": round(time.time(), 6), "phase": phase,
+                         "in_use": int(in_use),
+                         "peak": int(peak) if peak is not None else None})
+    except Exception:
+        pass
+
+
+def sample(phase: Optional[str] = None, force: bool = False
+           ) -> Optional[int]:
+    """Phase-boundary HBM sample: delegates to flight.sample_hbm (rate
+    limit + gauges + ring) tagging the history entry with `phase`
+    ("feed", "step", "dispatch", ...)."""
+    try:
+        from . import flight
+        return flight.sample_hbm(force=force, phase=phase)
+    except Exception:
+        return None
+
+
+def hbm_history() -> list:
+    """Snapshot of the sample history, oldest first."""
+    return list(_history)
+
+
+# ------------------------------------------------------- executable bank
+def bank_executable(engine: str, analysis: Optional[dict]) -> None:
+    """Bank one engine's memory analysis and export the gauges. The
+    analysis dict carries args_bytes/out_bytes/temp_bytes/
+    gen_code_bytes/total_bytes plus a "source" tag ("xla" when it came
+    from compiled.memory_analysis(), "avals" for the estimate)."""
+    global _g_args, _g_temp
+    if not analysis:
+        return
+    try:
+        _bank[str(engine)] = dict(analysis)
+        if _g_args is None:
+            _g_args = metrics.gauge(
+                "pt_hbm_args_bytes",
+                "Compiled-executable argument bytes per engine "
+                "(XLA memory_analysis, or an aval-size estimate)",
+                labelnames=("engine",))
+            _g_temp = metrics.gauge(
+                "pt_hbm_temp_bytes",
+                "Compiled-executable temp-allocation bytes per engine "
+                "(XLA memory_analysis; 0 when only estimated)",
+                labelnames=("engine",))
+        _g_args.labels(engine).set(float(analysis.get("args_bytes") or 0))
+        _g_temp.labels(engine).set(float(analysis.get("temp_bytes") or 0))
+    except Exception:
+        pass
+
+
+def executable_bank() -> dict:
+    """engine -> banked memory-analysis dict (copies)."""
+    return {k: dict(v) for k, v in _bank.items()}
+
+
+def analysis_from_arrays(args, outs=None) -> Optional[dict]:
+    """Aval-source analysis from concrete arrays: what the dispatch
+    actually moved, when no compiled.memory_analysis() is reachable.
+    temp bytes are unknowable from the outside and reported 0."""
+    try:
+        def _tot(xs):
+            total = 0
+            for x in xs or ():
+                for leaf in (x if isinstance(x, (list, tuple)) else (x,)):
+                    total += int(getattr(leaf, "nbytes", 0) or 0)
+            return total
+        return {"source": "avals", "args_bytes": _tot(args),
+                "out_bytes": _tot(outs), "temp_bytes": 0,
+                "gen_code_bytes": 0,
+                "total_bytes": _tot(args) + _tot(outs)}
+    except Exception:
+        return None
+
+
+# ------------------------------------------------------------ OOM path
+def live_buffer_table(top_n: int = 15) -> Optional[dict]:
+    """Top-N live device buffers grouped by (shape, dtype): the "what
+    was holding the memory" table of the OOM bundle. None in jax-free
+    processes."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        groups: dict = {}
+        total = 0
+        n = 0
+        for a in jax.live_arrays():
+            nbytes = int(getattr(a, "nbytes", 0) or 0)
+            key = (str(getattr(a, "dtype", "?")),
+                   tuple(getattr(a, "shape", ()) or ()))
+            cnt, tot = groups.get(key, (0, 0))
+            groups[key] = (cnt + 1, tot + nbytes)
+            total += nbytes
+            n += 1
+        rows = [{"dtype": dtype, "shape": list(shape), "count": cnt,
+                 "total_bytes": tot}
+                for (dtype, shape), (cnt, tot) in groups.items()]
+        rows.sort(key=lambda r: -r["total_bytes"])
+        return {"n_arrays": n, "total_bytes": total,
+                "groups": rows[:max(1, int(top_n))],
+                "n_groups": len(rows)}
+    except Exception:
+        return None
+
+
+def is_oom(exc: BaseException) -> bool:
+    """RESOURCE_EXHAUSTED, however it's spelled: the XLA runtime error
+    string (real OOM) or the chaos `oom:K` synthetic."""
+    msg = "%s %s" % (type(exc).__name__, exc)
+    return "RESOURCE_EXHAUSTED" in msg or "Resource exhausted" in msg
+
+
+def on_oom(engine: str, exc: BaseException,
+           step: Optional[int] = None) -> Optional[str]:
+    """OOM post-mortem, called from the dispatch catch before the
+    exception unwinds: `oom` journal event + pt_oom_total, then a crash
+    bundle whose memory.json names the live buffers, the per-engine
+    executable analyses and the HBM sample history. Returns the bundle
+    path (None when no flight dir is configured). Never raises — the
+    original RESOURCE_EXHAUSTED must stay the error the caller sees."""
+    global _oom_counter
+    try:
+        if _oom_counter is None:
+            _oom_counter = metrics.counter(
+                "pt_oom_total",
+                "RESOURCE_EXHAUSTED dispatches caught (real or "
+                "chaos-injected)")
+        _oom_counter.inc()
+    except Exception:
+        pass
+    try:
+        from . import journal
+        journal.emit("oom", engine=engine, step=step,
+                     error=str(exc)[:500])
+    except Exception:
+        pass
+    payload = None
+    try:
+        payload = {
+            "engine": engine,
+            "step": step,
+            "error": "%s: %s" % (type(exc).__name__, str(exc)[:2000]),
+            "device_kind": device_kind(),
+            "buffers": live_buffer_table(),
+            "executables": executable_bank(),
+            "hbm_history": hbm_history(),
+        }
+    except Exception:
+        pass
+    try:
+        from . import flight
+        flight.record("oom", engine=engine, step=step)
+        return flight.dump_crash_bundle("oom", exc=exc, last_step=step,
+                                        memory=payload)
+    except Exception:
+        return None
+
+
+def reset() -> None:
+    """Test isolation: clear the history and the executable bank (the
+    gauge objects live in the metrics registry and are reset there)."""
+    global _g_args, _g_temp, _oom_counter
+    _history.clear()
+    _bank.clear()
+    _g_args = _g_temp = None
+    _oom_counter = None

@@ -54,7 +54,7 @@ TRACE_JSON = "trace.json"
 _TRAIN = frozenset(("step", "feed", "feed_wait", "compile", "dispatch",
                     "host"))
 _SERVE = frozenset(("serve_request", "queue_wait", "prefill",
-                    "decode_steps"))
+                    "serve_suffix", "decode_steps"))
 
 
 # ------------------------------------------------- shared serializer

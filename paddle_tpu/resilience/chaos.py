@@ -17,6 +17,11 @@ Spec grammar: `;`-separated `name[:int[:float]]` entries —
     hang_at_step:K:SECS   host-side sleep of SECS inside the compiled-step
                           dispatch of optimizer step K (exercises the step
                           watchdog; 1-based)
+    oom:K                 the compiled-step dispatch of optimizer step K
+                          (1-based) raises a synthetic RESOURCE_EXHAUSTED,
+                          driving the real OOM-forensics path (memprof
+                          catch -> oom journal event -> crash bundle with
+                          memory.json) without exhausting any HBM
     torn_write:K          the K-th checkpoint blob written by this process
                           (checkpoint/store.py; 1-based) is torn: half its
                           bytes reach disk, then the process is SIGKILLed —
@@ -227,3 +232,17 @@ def hang_before_dispatch(step: int) -> None:
     if args and int(args[0]) == step and not _counts.get("hang_%d" % step):
         _counts["hang_%d" % step] = 1
         time.sleep(args[1] if len(args) > 1 else 5.0)
+
+
+def oom_at_dispatch(step: int) -> None:
+    """Engine hook: raise a synthetic RESOURCE_EXHAUSTED from the
+    compiled-step dispatch of optimizer step `step` (1-based, once per
+    process). The message matches the XLA runtime's spelling so the
+    engines' real OOM catch (observability/memprof.py) fires, proving
+    the memory.json bundle path end-to-end on the CPU mesh."""
+    args = get("oom")
+    if args and int(args[0]) == step and not _counts.get("oom_%d" % step):
+        _counts["oom_%d" % step] = 1
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: injected by %s=oom:%d — synthetic HBM "
+            "exhaustion (chaos drill)" % (ENV_VAR, step))

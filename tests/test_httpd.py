@@ -345,6 +345,18 @@ def _write_fixture(d):
     r1 = [
         {"event": "serve_admit", "ts": 100.025, "rank": 1, "tid": 2,
          "rid": 7, "slot": 0, "prefill_bucket": 8},
+        {"event": "span", "ts": 100.030, "dur_ms": 5.0,
+         "name": "queue_wait", "trace": "gold", "rank": 1, "tid": 2,
+         "parent": "serve_request", "attrs": {"rid": 7}},
+        {"event": "span", "ts": 100.040, "dur_ms": 10.0, "name": "prefill",
+         "trace": "gold", "rank": 1, "tid": 2, "parent": "serve_request",
+         "attrs": {"rid": 7, "bucket": 8}},
+        # a prefix-cache hit: serve_suffix covers the SAME interval as
+        # prefill (parent=prefill), naming the suffix-only dispatch
+        {"event": "span", "ts": 100.040, "dur_ms": 10.0,
+         "name": "serve_suffix", "trace": "gold", "rank": 1, "tid": 2,
+         "parent": "prefill", "attrs": {"rid": 7, "prefix_len": 8,
+                                        "bucket": 8}},
         {"event": "span", "ts": 100.055, "dur_ms": 30.0,
          "name": "serve_request", "trace": "gold", "rank": 1, "tid": 2,
          "attrs": {"rid": 7}},
@@ -376,6 +388,14 @@ class TestTraceview:
         flows = [e for e in evs if e["ph"] in ("s", "f")]
         assert {e["ph"] for e in flows} == {"s", "f"}
         assert all(e["id"] == 7 for e in flows)
+        # suffix-prefill admission: serve_suffix slice in the serve cat,
+        # nested under prefill over the identical interval
+        (sx,) = [e for e in evs if e["name"] == "serve_suffix"]
+        (pre,) = [e for e in evs if e["name"] == "prefill"]
+        assert sx["ph"] == "X" and sx["cat"] == "serve"
+        assert sx["args"]["prefix_len"] == 8
+        assert sx["args"]["parent"] == "prefill"
+        assert (sx["ts"], sx["dur"]) == (pre["ts"], pre["dur"])
         # slices rebased to t0: earliest start at ts=0
         slices = [e for e in evs if e["ph"] == "X"]
         assert min(e["ts"] for e in slices) == 0.0

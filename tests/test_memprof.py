@@ -1,0 +1,425 @@
+"""Memory forensics + roofline attribution (ISSUE 14,
+docs/OBSERVABILITY.md "Memory forensics & roofline").
+
+The three contracts:
+  * one sampler — flight.sample_hbm and the hapi TelemetryCallback both
+    delegate to memprof.read_device_memory(), which works on the CPU
+    backend via the live-array fallback;
+  * attribution — the step card and the jit engine bank per-executable
+    memory analyses (pt_hbm_args_bytes / pt_hbm_temp_bytes, /statusz
+    hbm block, metrics-rollup hbm fold);
+  * OOM forensics — a RESOURCE_EXHAUSTED dispatch (chaos `oom:K`
+    drills it on CPU) produces exactly one crash bundle whose
+    memory.json names the live buffers — proven in-process AND in a
+    subprocess end-to-end drill.
+
+`ptdoctor roofline` turns the same evidence into a named limiter and
+degrades to rc 2 (no crash) when evidence is missing.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTPretrainingCriterion, gpt_tiny
+from paddle_tpu.observability import (aggregate, flight, memprof, metrics)
+from paddle_tpu.observability import journal as run_journal
+from paddle_tpu.resilience import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """History, bank, flight ring/dir and chaos counters are process-
+    global; every test starts clean."""
+    flight.reset()
+    memprof.reset()
+    chaos._counts.clear()
+    yield
+    flight.reset()
+    memprof.reset()
+    chaos._counts.clear()
+
+
+def _tiny_model():
+    paddle.seed(0)
+    m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                 intermediate_size=64, max_position_embeddings=32)
+    model = paddle.Model(m)
+    model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                       parameters=m.parameters()),
+                  GPTPretrainingCriterion())
+    return model
+
+
+def _fit_data(n=4):
+    ids = np.random.RandomState(0).randint(0, 64, (n, 17)).astype(np.int64)
+    return [(ids[i, :-1], ids[i, 1:]) for i in range(n)]
+
+
+# ------------------------------------------------------------ one sampler
+class TestSampler:
+    def test_cpu_fallback_reads_live_arrays(self):
+        x = paddle.to_tensor(np.ones((16, 16), np.float32))
+        res = memprof.read_device_memory()
+        assert res is not None
+        in_use, peak = res
+        assert in_use >= x.numpy().nbytes      # footprint includes x
+        assert peak is None or peak >= in_use  # CPU backend has no peak
+
+    def test_callbacks_delegate_to_the_one_sampler(self, monkeypatch):
+        from paddle_tpu.hapi import callbacks
+        monkeypatch.setattr(memprof, "read_device_memory",
+                            lambda: (1234, 9999))
+        assert callbacks._device_mem_bytes() == 1234
+        monkeypatch.setattr(memprof, "read_device_memory", lambda: None)
+        assert callbacks._device_mem_bytes() == -1
+
+    def test_sample_tags_history_phase_and_sets_gauges(self):
+        keep = paddle.to_tensor(np.ones((8,), np.float32))  # noqa: F841
+        assert memprof.sample(phase="feed", force=True) is not None
+        assert memprof.sample(phase="step", force=True) is not None
+        hist = memprof.hbm_history()
+        assert [h["phase"] for h in hist] == ["feed", "step"]
+        assert all(h["in_use"] > 0 and h["peak"] >= h["in_use"] >= 0
+                   for h in hist)
+        g = metrics.REGISTRY.get("pt_hbm_bytes_in_use")
+        assert g is not None and g.value == hist[-1]["in_use"]
+
+    def test_history_is_bounded_by_env_knob(self):
+        cap = memprof._history.maxlen
+        for i in range(cap + 5):
+            memprof.note_sample(i, None)
+        hist = memprof.hbm_history()
+        assert len(hist) == cap
+        assert hist[-1]["in_use"] == cap + 4   # oldest dropped, not newest
+
+    def test_jax_free_process_reads_none(self, monkeypatch):
+        monkeypatch.setitem(sys.modules, "jax", None)
+        assert memprof.read_device_memory() is None
+        assert memprof.device_kind() is None
+        assert memprof.live_buffer_table() is None
+
+
+# ------------------------------------------------------------ attribution
+class TestAttribution:
+    def test_bank_sets_engine_labeled_gauges(self):
+        memprof.bank_executable("engA", {"source": "xla",
+                                         "args_bytes": 100,
+                                         "temp_bytes": 7,
+                                         "total_bytes": 107})
+        memprof.bank_executable("engB", {"source": "avals",
+                                         "args_bytes": 50,
+                                         "temp_bytes": 0,
+                                         "total_bytes": 50})
+        bank = memprof.executable_bank()
+        assert {"engA", "engB"} <= set(bank)
+        g = metrics.REGISTRY.get("pt_hbm_args_bytes")
+        # subset check: the registry gauge keeps children from earlier
+        # tests in the same process (reset() clears the bank, not the
+        # registry), so assert only the engines this test banked
+        by_engine = {lbls.get("engine"): child.value
+                     for lbls, child in g._series()}
+        assert by_engine.get("engA") == 100.0, by_engine
+        assert by_engine.get("engB") == 50.0, by_engine
+
+    def test_analysis_from_arrays_counts_nested_nbytes(self):
+        a = np.ones((4, 4), np.float32)
+        res = memprof.analysis_from_arrays([a, [a, a]], [a])
+        assert res["source"] == "avals"
+        assert res["args_bytes"] == 3 * a.nbytes
+        assert res["out_bytes"] == a.nbytes
+        assert res["temp_bytes"] == 0
+        assert res["total_bytes"] == 4 * a.nbytes
+
+    def test_step_card_carries_memory_block_and_banks_it(self):
+        from paddle_tpu.analysis import step_card
+        model = _tiny_model()
+        ids = np.random.RandomState(0).randint(0, 64, (2, 17))
+        x = paddle.to_tensor(ids[:, :-1].astype(np.int64))
+        y = paddle.to_tensor(ids[:, 1:].astype(np.int64))
+        model.train_batch([x], [y])     # builds the analysis handle
+        card = step_card(model._train_step_fn, [x], [y],
+                         label="gpt_tiny_train")
+        mem = card["memory"]
+        assert mem["source"] in ("xla", "avals")
+        assert mem["args_bytes"] > 0 and mem["total_bytes"] > 0
+        if mem["source"] == "xla":      # CPU XLA exposes memory_analysis
+            assert mem["temp_bytes"] > 0
+        assert card["device_kind"] == "cpu"
+        assert "gpt_tiny_train" in memprof.executable_bank()
+
+    def test_fit_banks_jit_train_and_statusz_shows_hbm(self, tmp_path):
+        from paddle_tpu.observability.httpd import build_status
+        model = _tiny_model()
+        model.fit(_fit_data(), batch_size=2, epochs=1, verbose=0,
+                  telemetry_dir=str(tmp_path))
+        bank = memprof.executable_bank()
+        assert "jit_train" in bank
+        assert bank["jit_train"]["args_bytes"] > 0
+        hbm = build_status()["hbm_bytes"]
+        assert hbm["in_use"] > 0 and hbm["peak"] >= hbm["in_use"]
+        assert hbm["args"]["jit_train"] > 0
+        assert "jit_train" in hbm["executables"]
+        # fit sampled the feed/step phase boundaries
+        phases = {h["phase"] for h in memprof.hbm_history()}
+        assert "feed" in phases or "step" in phases
+
+    def test_rollup_folds_hbm_gauges_max_across_ranks(self, tmp_path):
+        for rank, peak in ((0, 100.0), (1, 300.0)):
+            path = os.path.join(str(tmp_path), "metrics-rank%d.json" % rank)
+            with open(path, "w") as f:
+                json.dump({"ts": 1.0, "metrics": {
+                    "pt_hbm_peak_bytes": {
+                        "type": "gauge", "help": "", "labelnames": [],
+                        "series": [{"labels": {}, "value": peak}]},
+                    "pt_hbm_args_bytes": {
+                        "type": "gauge", "help": "",
+                        "labelnames": ["engine"],
+                        "series": [{"labels": {"engine": "jit_train"},
+                                    "value": 10.0 * (rank + 1)}]},
+                }}, f)
+        out_path, _ = aggregate.rollup_metrics(str(tmp_path))
+        hbm = json.load(open(out_path))["hbm"]
+        assert hbm["high_water"]["pt_hbm_peak_bytes"] == 300.0
+        # per-rank detail preserved, max (not sum) across ranks
+        assert set(hbm["per_source"]) == {"metrics-rank0.json",
+                                          "metrics-rank1.json"}
+        key = [k for k in hbm["high_water"]
+               if k.startswith("pt_hbm_args_bytes")]
+        assert key and hbm["high_water"][key[0]] == 20.0
+
+
+# ----------------------------------------------------------- OOM forensics
+class TestOOM:
+    def test_is_oom_matches_xla_and_chaos_spellings(self):
+        assert memprof.is_oom(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 1 bytes"))
+        assert memprof.is_oom(ValueError("Resource exhausted: hbm"))
+        assert not memprof.is_oom(ValueError("shapes do not match"))
+
+    def test_chaos_oom_raises_once_at_step(self, monkeypatch):
+        monkeypatch.setenv(chaos.ENV_VAR, "oom:2")
+        chaos.oom_at_dispatch(1)               # not yet
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            chaos.oom_at_dispatch(2)
+        chaos.oom_at_dispatch(2)               # once per process
+
+    def test_on_oom_bundles_memory_json(self, tmp_path):
+        flight.configure(str(tmp_path), rank=0)
+        memprof.bank_executable("jit_train", {"source": "avals",
+                                              "args_bytes": 64,
+                                              "temp_bytes": 0,
+                                              "total_bytes": 64})
+        memprof.note_sample(100, 200, phase="step")
+        paddle.to_tensor(np.ones((8, 8), np.float32))
+        c0 = metrics.REGISTRY.get("pt_oom_total")
+        c0 = c0.value if c0 is not None else 0
+        path = memprof.on_oom(
+            "jit_train", RuntimeError("RESOURCE_EXHAUSTED: boom"), step=3)
+        assert path and os.path.isdir(path)
+        mem = json.load(open(os.path.join(path, "memory.json")))
+        assert mem["engine"] == "jit_train" and mem["step"] == 3
+        assert mem["buffers"]["n_arrays"] > 0
+        assert mem["buffers"]["groups"][0]["total_bytes"] > 0
+        assert mem["executables"]["jit_train"]["args_bytes"] == 64
+        assert mem["hbm_history"][-1]["phase"] == "step"
+        assert metrics.REGISTRY.get("pt_oom_total").value == c0 + 1
+
+    def test_crash_bundle_synthesizes_memory_json_without_payload(
+            self, tmp_path):
+        """Any crash bundle answers "where were the bytes" once the bank
+        or history has content — not only the OOM path."""
+        flight.configure(str(tmp_path), rank=0)
+        memprof.bank_executable("jit_eval", {"source": "avals",
+                                             "args_bytes": 8,
+                                             "temp_bytes": 0,
+                                             "total_bytes": 8})
+        path = flight.dump_crash_bundle("fit_exception")
+        mem = json.load(open(os.path.join(path, "memory.json")))
+        assert mem["reason"] == "fit_exception"
+        assert "jit_eval" in mem["executables"]
+
+    def test_end_to_end_chaos_oom_drill_subprocess(self, tmp_path):
+        """The acceptance drill: PADDLE_TPU_CHAOS=oom:1 on a 2-step fit
+        -> the fit raises RESOURCE_EXHAUSTED AND exactly one crash
+        bundle exists, whose memory.json names live buffers."""
+        code = r"""
+import numpy as np, sys
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTPretrainingCriterion, gpt_tiny
+paddle.seed(0)
+m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+             intermediate_size=64, max_position_embeddings=32)
+model = paddle.Model(m)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=m.parameters()),
+              GPTPretrainingCriterion())
+ids = np.random.RandomState(0).randint(0, 64, (4, 17)).astype(np.int64)
+try:
+    model.fit([(ids[i, :-1], ids[i, 1:]) for i in range(4)], batch_size=2,
+              epochs=1, verbose=0, telemetry_dir=sys.argv[1])
+    raise SystemExit("fit did not raise")
+except RuntimeError as e:
+    assert "RESOURCE_EXHAUSTED" in str(e), e
+print("DRILL_OK")
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PADDLE_TPU_CHAOS="oom:1")
+        r = subprocess.run([sys.executable, "-c", code, str(tmp_path)],
+                           capture_output=True, text=True, timeout=300,
+                           env=env, cwd=REPO)
+        assert r.returncode == 0 and "DRILL_OK" in r.stdout, \
+            r.stdout + r.stderr
+        crash = os.path.join(str(tmp_path), "crash")
+        bundles = sorted(os.listdir(crash))
+        assert len(bundles) == 1, bundles    # once-guard: exactly one
+        bdir = os.path.join(crash, bundles[0])
+        manifest = json.load(open(os.path.join(bdir, "MANIFEST.json")))
+        assert manifest["reason"] == "oom"
+        mem = json.load(open(os.path.join(bdir, "memory.json")))
+        assert mem["engine"] == "jit_train"
+        assert mem["buffers"]["n_arrays"] > 0 and mem["buffers"]["groups"]
+        evs = run_journal.read_journal(
+            os.path.join(str(tmp_path), "journal-rank0.jsonl"))
+        ooms = [e for e in evs if e["event"] == "oom"]
+        assert len(ooms) == 1 and ooms[0]["engine"] == "jit_train"
+
+
+# ------------------------------------------------------------- roofline
+def _write_roofline_evidence(d, steps_ms=(1.8, 2.2, 2.0, 2.1, 1.9),
+                             card_extra=None):
+    card = {"label": "gpt_tiny_train", "eqns": 10, "flops": 4.0e9,
+            "hbm_bytes": 2.0e8, "arithmetic_intensity": 20.0,
+            "collectives": {"count": 0, "bytes": 0},
+            "device_kind": "cpu",
+            "memory": {"source": "xla", "args_bytes": 100,
+                       "temp_bytes": 50, "total_bytes": 150}}
+    card.update(card_extra or {})
+    with open(os.path.join(d, "step_card.json"), "w") as f:
+        json.dump(card, f)
+    with open(os.path.join(d, "journal-rank0.jsonl"), "w") as f:
+        ts = 100.0
+        for i, ms in enumerate(steps_ms):
+            ts += 0.01
+            if i == 0:   # compile-bearing first step
+                f.write(json.dumps(
+                    {"event": "span", "ts": ts, "dur_ms": 500.0,
+                     "name": "compile", "parent": "step", "rank": 0}) + "\n")
+                ms += 500.0
+            f.write(json.dumps(
+                {"event": "span", "ts": ts, "dur_ms": 0.2, "name": "feed",
+                 "parent": "step", "rank": 0}) + "\n")
+            f.write(json.dumps(
+                {"event": "span", "ts": ts, "dur_ms": ms, "name": "step",
+                 "rank": 0}) + "\n")
+
+
+class TestRoofline:
+    def _run(self, *argv, env_extra=None):
+        env = dict(os.environ)
+        env.pop("PADDLE_TPU_PEAK_TFLOPS", None)
+        env.pop("PADDLE_TPU_PEAK_GBPS", None)
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ptdoctor.py"),
+             *argv], capture_output=True, text=True, timeout=60, env=env)
+
+    def test_unknown_device_names_limiter_honestly(self, tmp_path):
+        _write_roofline_evidence(str(tmp_path))
+        r = self._run("roofline", str(tmp_path))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "unknown device 'cpu'" in r.stdout
+        # intensity 20 flop/byte, below the static balance threshold
+        assert "limiter: memory-bound (static heuristic" in r.stdout
+
+    def test_env_peaks_classify_memory_vs_compute(self, tmp_path):
+        _write_roofline_evidence(str(tmp_path))
+        # 4 GFLOP / 0.2 GB per step: at 100 TFLOP/s + 10 GB/s the
+        # memory side dominates (20 ms vs 0.04 ms)
+        r = self._run("roofline", str(tmp_path),
+                      env_extra={"PADDLE_TPU_PEAK_TFLOPS": "100",
+                                 "PADDLE_TPU_PEAK_GBPS": "10"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "limiter: memory-bound" in r.stdout
+        assert "% of peak" in r.stdout
+        # flip the balance: huge bandwidth, tiny compute
+        r = self._run("roofline", str(tmp_path),
+                      env_extra={"PADDLE_TPU_PEAK_TFLOPS": "0.001",
+                                 "PADDLE_TPU_PEAK_GBPS": "1000"})
+        assert r.returncode == 0
+        assert "limiter: compute-bound" in r.stdout
+
+    def test_table_row_matched_by_device_kind_substring(self, tmp_path):
+        _write_roofline_evidence(str(tmp_path),
+                                 card_extra={"device_kind": "TPU v5 lite"})
+        r = self._run("roofline", str(tmp_path))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "197.0 TFLOP/s" in r.stdout and "819 GB/s" in r.stdout
+
+    def test_host_feed_bound_wins_over_intensity(self, tmp_path):
+        # feed spans dominating the non-compile step time
+        card = {"label": "x", "eqns": 1, "flops": 1e9, "hbm_bytes": 1e6,
+                "collectives": {"count": 0, "bytes": 0},
+                "device_kind": "cpu"}
+        with open(os.path.join(str(tmp_path), "step_card.json"), "w") as f:
+            json.dump(card, f)
+        with open(os.path.join(str(tmp_path),
+                               "journal-rank0.jsonl"), "w") as f:
+            for i in range(5):
+                f.write(json.dumps(
+                    {"event": "span", "ts": 100 + i, "dur_ms": 8.0,
+                     "name": "feed_wait", "parent": "step",
+                     "rank": 0}) + "\n")
+                f.write(json.dumps(
+                    {"event": "span", "ts": 100 + i, "dur_ms": 10.0,
+                     "name": "step", "rank": 0}) + "\n")
+        r = self._run("roofline", str(tmp_path))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "limiter: host-or-feed-bound" in r.stdout
+
+    def test_exposed_collective_classification(self, tmp_path):
+        # measured step far above both ideal times, card has collectives
+        _write_roofline_evidence(
+            str(tmp_path), steps_ms=(50.0,) * 5,
+            card_extra={"collectives": {"count": 2, "bytes": int(1e8)}})
+        r = self._run("roofline", str(tmp_path),
+                      env_extra={"PADDLE_TPU_PEAK_TFLOPS": "1000",
+                                 "PADDLE_TPU_PEAK_GBPS": "1000"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "exposed-collective headroom" in r.stdout
+        assert "limiter: exposed-collective" in r.stdout
+
+    def test_missing_evidence_degrades_rc2(self, tmp_path):
+        r = self._run("roofline", str(tmp_path))     # no card at all
+        assert r.returncode == 2 and "no step_card" in r.stdout
+        card = {"label": "x", "flops": 1e9, "hbm_bytes": 1e6}
+        with open(os.path.join(str(tmp_path), "step_card.json"), "w") as f:
+            json.dump(card, f)
+        r = self._run("roofline", str(tmp_path))     # card, no spans
+        assert r.returncode == 2 and "no measured" in r.stdout
+
+
+# ---------------------------------------------------- bench hbm_peak trend
+class TestBenchHbmPeak:
+    def test_bench_table_flags_hbm_peak_regression(self, tmp_path):
+        for i, peak in enumerate((100 << 20, 100 << 20, 150 << 20)):
+            with open(os.path.join(str(tmp_path),
+                                   "BENCH_r%02d.json" % (i + 1)), "w") as f:
+                json.dump({"results": [
+                    {"config": "gpt_tiny_train", "throughput": 1000.0,
+                     "unit": "tok/s", "step_ms": 2.0, "mfu": 0.4,
+                     "compile_s": 1.0, "hbm_peak": peak}]}, f)
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ptdoctor.py"),
+             "bench", str(tmp_path)],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "hbm_peak" in r.stdout
+        assert "hbm_peak REGRESSED" in r.stdout     # 150M > 110% of 100M
+        assert r.stdout.count("REGRESSED") == 1     # older rows clean

@@ -196,6 +196,71 @@ class TestServingSpanParity:
             # the root span covers its children
             assert root["dur_ms"] >= ch["queue_wait"]
 
+    def test_suffix_prefill_span_rides_the_ttft_decomposition(
+            self, tmp_path):
+        """A prefix-cache hit admission records a `serve_suffix` child
+        UNDER prefill (same interval) — so the trace names the
+        suffix-only dispatches while queue_wait + prefill == ttft stays
+        exact — and the Perfetto export carries the slice plus the
+        request's flow arrows."""
+        from paddle_tpu.inference.serving import (ContinuousBatcher,
+                                                  GenerationEngine,
+                                                  Request)
+        from paddle_tpu.models import gpt_tiny
+        from paddle_tpu.observability import traceview
+
+        paddle.seed(0)
+        m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=4, intermediate_size=64,
+                     max_position_embeddings=64)
+        m.eval()
+        j = run_journal.RunJournal(str(tmp_path),
+                                   filename="journal-rank0.jsonl")
+        prev = run_journal.set_journal(j)
+        try:
+            eng = GenerationEngine(m, max_batch=2, max_seq_len=32,
+                                   prefill_buckets=(8, 16),
+                                   prefix_cache_bytes=32 << 20)
+            rs = np.random.RandomState(7)
+            head = rs.randint(0, 64, (8,)).astype(np.int64)
+            cold = np.concatenate([head, rs.randint(0, 64, (4,))])
+            hot = np.concatenate([head, rs.randint(0, 64, (3,))])
+            b = ContinuousBatcher(eng)
+            b.submit(Request(prompt=cold, max_new_tokens=2))
+            b.run_until_idle()                # stores the 8-token prefix
+            hit = b.submit(Request(prompt=hot, max_new_tokens=2))
+            b.run_until_idle()
+            assert hit.prefix_len == 8
+        finally:
+            run_journal.set_journal(prev)
+            j.close()
+        sp = _span_events(str(tmp_path / "journal-rank0.jsonl"))
+        suffix = [e for e in sp if e["name"] == "serve_suffix"]
+        # exactly the hit admission ran the suffix path
+        assert len(suffix) == 1
+        (sx,) = suffix
+        assert sx["parent"] == "prefill"
+        assert sx["attrs"]["rid"] == hit.rid
+        assert sx["attrs"]["prefix_len"] == 8
+        # same interval as the hit's prefill: the decomposition parity
+        # queue_wait + prefill == ttft is untouched by the extra span
+        pre = {e["attrs"]["rid"]: e for e in sp if e["name"] == "prefill"}
+        qw = {e["attrs"]["rid"]: e for e in sp
+              if e["name"] == "queue_wait"}
+        assert sx["dur_ms"] == pre[hit.rid]["dur_ms"]
+        assert (qw[hit.rid]["dur_ms"] + pre[hit.rid]["dur_ms"]) == \
+            pytest.approx(hit.ttft_s * 1e3, rel=0.10, abs=0.5)
+        # the Perfetto export carries the slice (cat=serve) and the
+        # request's flow arrows survive alongside it
+        path, n_events, _ = traceview.export_trace(str(tmp_path))
+        evs = json.load(open(path))["traceEvents"]
+        sx_slices = [e for e in evs if e["name"] == "serve_suffix"
+                     and e["ph"] == "X"]
+        assert len(sx_slices) == 1 and sx_slices[0]["cat"] == "serve"
+        assert sx_slices[0]["args"]["prefix_len"] == 8
+        flow_ids = {e["id"] for e in evs if e["ph"] in ("s", "f")}
+        assert hit.rid in flow_ids
+
 
 # ------------------------------------------------------- overhead contract
 class TestSpanOverhead:

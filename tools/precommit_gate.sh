@@ -25,7 +25,7 @@ if [ "$lint_rc" -ne 0 ]; then
     exit "$lint_rc"
 fi
 echo "PTLINT=ok"
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest $TARGET -q \
+timeout -k 10 1800 env JAX_PLATFORMS=cpu python -m pytest $TARGET -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
@@ -410,6 +410,109 @@ EOF
     else
         grep -h "critical path" "$PROF_DIR/profile.log"
         rm -rf "$PROF_DIR"
+    fi
+fi
+
+# Memprof smoke (docs/OBSERVABILITY.md "Memory forensics & roofline"):
+# a 2-step gpt-tiny fit must bank executable memory attribution into
+# the step card (`memory` block with an honest source tag) and the HBM
+# sample history, `ptdoctor roofline` must join card + spans and name a
+# limiter with rc 0, and a chaos oom:1 drill must walk the whole
+# RESOURCE_EXHAUSTED catch path: exactly ONE crash bundle whose
+# memory.json carries a non-empty live-buffer table.
+if [ "$rc" -eq 0 ]; then
+    MEM_DIR="$(mktemp -d /tmp/pt_mem_smoke_XXXXXX)"
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        PT_MEM_SMOKE_DIR="$MEM_DIR" python - <<'EOF'
+import os
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.analysis import step_card, write_step_card
+from paddle_tpu.models import GPTPretrainingCriterion, gpt_tiny
+from paddle_tpu.observability import memprof
+
+d = os.environ["PT_MEM_SMOKE_DIR"]
+paddle.seed(0)
+m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+             intermediate_size=64, max_position_embeddings=32)
+model = paddle.Model(m)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=m.parameters()),
+              GPTPretrainingCriterion())
+ids = np.random.RandomState(0).randint(0, 64, (4, 17)).astype(np.int64)
+model.fit([(ids[i, :-1], ids[i, 1:]) for i in range(4)], batch_size=2,
+          epochs=1, verbose=0, telemetry_dir=d)
+
+x, y = paddle.to_tensor(ids[:2, :-1]), paddle.to_tensor(ids[:2, 1:])
+card = step_card(model._train_step_fn, [x], [y], label="gpt_tiny_train")
+write_step_card(card, os.path.join(d, "step_card.json"))
+mem = card.get("memory")
+assert mem and mem.get("source") in ("xla", "avals"), mem
+assert mem.get("total_bytes", 0) > 0, mem
+assert memprof.executable_bank().get("gpt_tiny_train"), \
+    memprof.executable_bank()
+hist = memprof.hbm_history()
+assert hist and all(s.get("in_use", 0) > 0 for s in hist), hist
+print("MEMPROF_SMOKE fit=ok (memory source=%s total=%d bytes, "
+      "%d hbm samples)"
+      % (mem["source"], mem["total_bytes"], len(hist)))
+EOF
+    smoke_rc=$?
+    if [ "$smoke_rc" -eq 0 ]; then
+        python tools/ptdoctor.py roofline "$MEM_DIR" \
+            > "$MEM_DIR/roofline.log" 2>&1 \
+            && grep -q "limiter:" "$MEM_DIR/roofline.log"
+        smoke_rc=$?
+    fi
+    if [ "$smoke_rc" -eq 0 ]; then
+        timeout -k 10 180 env JAX_PLATFORMS=cpu \
+            PADDLE_TPU_CHAOS=oom:1 \
+            PT_MEM_SMOKE_DIR="$MEM_DIR/oom_drill" python - <<'EOF'
+import glob
+import json
+import os
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTPretrainingCriterion, gpt_tiny
+
+d = os.environ["PT_MEM_SMOKE_DIR"]
+paddle.seed(0)
+m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+             intermediate_size=64, max_position_embeddings=32)
+model = paddle.Model(m)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=m.parameters()),
+              GPTPretrainingCriterion())
+ids = np.random.RandomState(0).randint(0, 64, (4, 17)).astype(np.int64)
+try:
+    model.fit([(ids[i, :-1], ids[i, 1:]) for i in range(4)], batch_size=2,
+              epochs=1, verbose=0, telemetry_dir=d)
+    raise SystemExit("chaos oom:1 did not raise")
+except Exception as e:
+    assert "RESOURCE_EXHAUSTED" in str(e), e
+
+bundles = sorted(glob.glob(os.path.join(d, "crash", "*", "MANIFEST.json")))
+assert len(bundles) == 1, bundles
+manifest = json.load(open(bundles[0]))
+assert manifest["reason"] == "oom", manifest
+mem = json.load(open(os.path.join(os.path.dirname(bundles[0]),
+                                  "memory.json")))
+assert mem.get("engine") == "jit_train", mem
+bufs = (mem.get("buffers") or {}).get("groups") or []
+assert bufs and all(b["total_bytes"] > 0 for b in bufs), mem.get("buffers")
+print("MEMPROF_SMOKE oom_drill=ok (1 bundle, %d live-buffer groups, "
+      "engine=%s)" % (len(bufs), mem["engine"]))
+EOF
+        smoke_rc=$?
+    fi
+    if [ "$smoke_rc" -ne 0 ]; then
+        echo "MEMPROF_SMOKE=FAILED (rc=$smoke_rc, logs in $MEM_DIR)"
+        [ -f "$MEM_DIR/roofline.log" ] && tail -10 "$MEM_DIR/roofline.log"
+        rc=$smoke_rc
+    else
+        echo "MEMPROF_SMOKE=ok ($(grep -h 'limiter:' "$MEM_DIR/roofline.log" \
+            | head -1 | sed 's/^ *//'))"
+        rm -rf "$MEM_DIR"
     fi
 fi
 

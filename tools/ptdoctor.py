@@ -6,6 +6,7 @@
     python tools/ptdoctor.py crash    <telemetry_dir>
     python tools/ptdoctor.py lint     <telemetry_dir>
     python tools/ptdoctor.py profile  <telemetry_dir>
+    python tools/ptdoctor.py roofline <telemetry_dir>
     python tools/ptdoctor.py trace    <telemetry_dir> [--out trace.json]
     python tools/ptdoctor.py bench    <repo_or_results_dir>
 
@@ -19,11 +20,19 @@ per-span latency table (count/total/mean/p50/p95 over every `span`
 journal event), the step and serve_request decompositions with a
 critical-path share line (compute vs feed vs host vs unattributed), and
 the static step card (analysis/cost_pass.py) when the run dir has one.
+`roofline` answers "why is the achieved FLOP/s what it is": it joins
+the static step card (FLOPs, unfused HBM bytes, collective operand
+bytes) with the measured span timings and a per-device-kind peak table
+(override with PADDLE_TPU_PEAK_TFLOPS / PADDLE_TPU_PEAK_GBPS) to
+classify each card as compute-bound / memory-bound / exposed-collective
+/ host-or-feed-bound, with achieved-vs-peak TFLOP/s and GB/s and the
+measured exposed-collective headroom overlap work would burn down.
 `trace` merges every rank's journal span events into one chrome-trace /
 Perfetto JSON (open in ui.perfetto.dev or chrome://tracing — one track
 per rank x thread, serve_request flow arrows across threads). `bench`
 renders the BENCH_*.json files as a per-config trend table and flags
-step_ms / MFU / compile_s regressions against the best prior row.
+step_ms / MFU / compile_s / hbm_peak regressions against the best
+prior row.
 
 Stdlib only, and paddle_tpu is never imported (it pulls in jax — this
 tool must run on a machine that has nothing but the run dir). The
@@ -620,6 +629,174 @@ def cmd_profile(agg, directory) -> int:
     return 0
 
 
+#: device_kind substring (lowercase, first match wins) ->
+#: (peak dense bf16 TFLOP/s, peak HBM GB/s) per chip — same table family
+#: as benchmarks/train_bench.py's _PEAK_FLOPS, extended with bandwidth.
+_ROOFLINE_PEAKS = (
+    ("v6", (918.0, 1640.0)),
+    ("v5p", (459.0, 2765.0)),
+    ("v5", (197.0, 819.0)),      # v5e / "v5 lite"
+    ("v4", (275.0, 1228.0)),
+)
+
+
+def _roofline_peaks(kind):
+    """(peak_tflops, peak_gbps, source) for a device kind. Env overrides
+    PADDLE_TPU_PEAK_TFLOPS / PADDLE_TPU_PEAK_GBPS win over the table;
+    either value may be None (honest "unknown device" — never guessed)."""
+    tf = gb = None
+    env_tf = os.environ.get("PADDLE_TPU_PEAK_TFLOPS")
+    env_gb = os.environ.get("PADDLE_TPU_PEAK_GBPS")
+    try:
+        tf = float(env_tf) if env_tf else None
+    except ValueError:
+        tf = None
+    try:
+        gb = float(env_gb) if env_gb else None
+    except ValueError:
+        gb = None
+    if tf is not None and gb is not None:
+        return tf, gb, "env"
+    low = (kind or "").lower()
+    for sub, (t, g) in _ROOFLINE_PEAKS:
+        if sub in low:
+            return (tf if tf is not None else t,
+                    gb if gb is not None else g,
+                    "env+table" if (tf is not None or gb is not None)
+                    else "table")
+    if tf is not None or gb is not None:
+        return tf, gb, "env"
+    return None, None, None
+
+
+def cmd_roofline(agg, directory) -> int:
+    """Name the limiter: join each static step card (FLOPs, unfused HBM
+    bytes, collective operand bytes — analysis/cost_pass.py) with the
+    measured step spans and the per-device-kind peak table, and say
+    whether the config is compute-bound, memory-bound,
+    exposed-collective, or host-or-feed-bound — with achieved vs peak
+    TFLOP/s and GB/s so "MFU is low" becomes a named cause."""
+    import glob
+    cards = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "step_card*.json"))):
+        try:
+            with open(path) as f:
+                card = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(card, dict) and card.get("flops"):
+            cards.append((os.path.basename(path), card))
+    if not cards:
+        print("ptdoctor: no step_card*.json with a flops count under %s "
+              "(emit one with analysis.cost_pass.write_step_card)"
+              % directory)
+        return 2
+    events = agg.load_events(directory)
+    steps = [float(e["dur_ms"]) for e in events
+             if e.get("event") == "span" and e.get("name") == "step"
+             and isinstance(e.get("dur_ms"), (int, float))]
+    if not steps:
+        print("ptdoctor: no measured `step` spans under %s — roofline "
+              "needs both the static card and a measured run "
+              "(set PADDLE_TPU_TELEMETRY_DIR at run time)" % directory)
+        return 2
+    # steady-state step time: p50 when there is history, min for tiny
+    # smoke runs where the compile-bearing first step would skew p50
+    step_ms = (agg.percentile(steps, 50) if len(steps) >= 4
+               else min(steps))
+    # host/feed share from the span tree, with compile excluded — the
+    # question is what limits the steady-state step, not the first one
+    kids = {}
+    for e in events:
+        if e.get("event") == "span" and e.get("parent") == "step" \
+                and isinstance(e.get("dur_ms"), (int, float)):
+            name = e.get("name", "?")
+            kids[name] = kids.get(name, 0.0) + float(e["dur_ms"])
+    step_total = sum(steps)
+    noncompile = max(step_total - kids.get("compile", 0.0), 1e-9)
+    hostfeed = (kids.get("feed", 0.0) + kids.get("feed_wait", 0.0)
+                + kids.get("host", 0.0))
+    hostfeed_share = min(hostfeed / noncompile, 1.0)
+    rc = 0
+    for fname, card in cards:
+        flops = float(card.get("flops") or 0)
+        hbm = float(card.get("hbm_bytes") or 0)
+        col = card.get("collectives") or {}
+        col_bytes = float(col.get("bytes") or 0)
+        kind = card.get("device_kind") or "unknown"
+        tf, gb, src = _roofline_peaks(kind)
+        step_s = step_ms / 1e3
+        ach_tf = flops / step_s / 1e12
+        ach_gb = hbm / step_s / 1e9
+        print("== roofline: %s (%s)" % (card.get("label", "?"), fname))
+        print("  static: flops=%s  hbm_bytes=%s  collective_bytes=%s  "
+              "intensity=%.2f flop/byte" % (
+                  _fmt_qty(flops), _fmt_qty(hbm), _fmt_qty(col_bytes),
+                  flops / hbm if hbm else float("inf")))
+        print("  measured: step=%.3f ms (n=%d)  feed+host share=%.1f%% "
+              "of non-compile step time" % (step_ms, len(steps),
+                                            100.0 * hostfeed_share))
+        if tf is not None and gb is not None:
+            ideal_comp_ms = flops / (tf * 1e12) * 1e3
+            ideal_mem_ms = hbm / (gb * 1e9) * 1e3
+            headroom_ms = max(0.0, step_ms - max(ideal_comp_ms,
+                                                 ideal_mem_ms))
+            print("  peaks (%s, device %r): %.1f TFLOP/s, %.0f GB/s"
+                  % (src, kind, tf, gb))
+            print("  achieved: %.3f TFLOP/s (%.1f%% of peak)  "
+                  "%.2f GB/s (%.1f%% of peak)" % (
+                      ach_tf, 100.0 * ach_tf / tf,
+                      ach_gb, 100.0 * ach_gb / gb))
+            if col_bytes:
+                print("  exposed-collective headroom: %.3f ms/step "
+                      "(measured %.3f - ideal %.3f)" % (
+                          headroom_ms, step_ms,
+                          max(ideal_comp_ms, ideal_mem_ms)))
+            if hostfeed_share >= 0.4:
+                print("  limiter: host-or-feed-bound — feed+host is "
+                      "%.1f%% of non-compile step time"
+                      % (100.0 * hostfeed_share))
+            elif col_bytes and headroom_ms / step_ms >= 0.25:
+                print("  limiter: exposed-collective — %.1f%% of the "
+                      "step is neither ideal compute nor ideal HBM "
+                      "traffic and the card carries %s collective bytes"
+                      % (100.0 * headroom_ms / step_ms,
+                         _fmt_qty(col_bytes)))
+            elif ideal_comp_ms >= ideal_mem_ms:
+                print("  limiter: compute-bound — ideal compute %.3f ms "
+                      ">= ideal HBM %.3f ms at this intensity" % (
+                          ideal_comp_ms, ideal_mem_ms))
+            else:
+                print("  limiter: memory-bound — ideal HBM %.3f ms > "
+                      "ideal compute %.3f ms at this intensity" % (
+                          ideal_mem_ms, ideal_comp_ms))
+        else:
+            print("  peaks: unknown device %r — no table entry; set "
+                  "PADDLE_TPU_PEAK_TFLOPS and PADDLE_TPU_PEAK_GBPS to "
+                  "calibrate" % kind)
+            print("  achieved: %.3f TFLOP/s  %.2f GB/s (no peak to "
+                  "compare against)" % (ach_tf, ach_gb))
+            if hostfeed_share >= 0.4:
+                print("  limiter: host-or-feed-bound — feed+host is "
+                      "%.1f%% of non-compile step time"
+                      % (100.0 * hostfeed_share))
+            elif col_bytes and hbm and col_bytes >= 0.2 * hbm:
+                print("  limiter: exposed-collective (static) — "
+                      "collectives move %s of %s total HBM bytes"
+                      % (_fmt_qty(col_bytes), _fmt_qty(hbm)))
+            elif hbm and flops / hbm < 50.0:
+                print("  limiter: memory-bound (static heuristic — "
+                      "intensity %.2f flop/byte is below typical "
+                      "machine balance; peaks unknown)"
+                      % (flops / hbm))
+            else:
+                print("  limiter: compute-bound (static heuristic — "
+                      "intensity %.2f flop/byte; peaks unknown)"
+                      % (flops / hbm if hbm else float("inf")))
+    return rc
+
+
 def cmd_trace(directory, out=None) -> int:
     """Export the run dir's journals as one Perfetto/chrome-trace JSON
     (observability/traceview.py — same serializer the host profiler
@@ -638,9 +815,9 @@ def cmd_trace(directory, out=None) -> int:
 
 def _bench_rows(directory):
     """((sort_key, label, rows), ...) per BENCH_*.json file, oldest
-    first. Each row: {config, value, unit, step_ms, mfu, compile_s} with
-    absent fields None. Failed runs yield rows=None (listed, not
-    trended)."""
+    first. Each row: {config, value, unit, step_ms, mfu, compile_s,
+    hbm_peak} with absent fields None. Failed runs yield rows=None
+    (listed, not trended)."""
     import glob
     out = []
     for path in glob.glob(os.path.join(directory, "BENCH_*.json")):
@@ -666,7 +843,8 @@ def _bench_rows(directory):
                                  "unit": r.get("unit"),
                                  "step_ms": r.get("step_ms"),
                                  "mfu": r.get("mfu"),
-                                 "compile_s": r.get("compile_s")})
+                                 "compile_s": r.get("compile_s"),
+                                 "hbm_peak": r.get("hbm_peak")})
             # serving rows (inference_bench.py via the TPU window) trend
             # alongside training: throughput column = tokens_per_s, and
             # ttft p95 gets its own column + regression flag
@@ -692,7 +870,8 @@ def _bench_rows(directory):
                          "unit": parsed.get("unit"),
                          "step_ms": parsed.get("step_ms"),
                          "mfu": parsed.get("mfu"),
-                         "compile_s": parsed.get("compile_s")})
+                         "compile_s": parsed.get("compile_s"),
+                         "hbm_peak": parsed.get("hbm_peak")})
         out.append((key, base, rows))
     out.sort(key=lambda e: e[0])
     return out
@@ -703,8 +882,9 @@ def cmd_bench(directory) -> int:
     per config, rows oldest->newest, each compared against the BEST
     prior row (not the previous one — a single slow round must not
     reset the bar). Flags: step_ms >110% of best, MFU <90% of best,
-    compile_s >110% of best; serving rows (inference_bench) flag
-    tokens_per_s <90% of best and ttft_ms_p95 >110% of best."""
+    compile_s >110% of best, hbm_peak >110% of best; serving rows
+    (inference_bench) flag tokens_per_s <90% of best and ttft_ms_p95
+    >110% of best."""
     files = _bench_rows(directory)
     if not files:
         print("ptdoctor: no BENCH_*.json under %s" % directory)
@@ -718,15 +898,16 @@ def cmd_bench(directory) -> int:
         hist = by_config[config]
         unit = next((r.get("unit") for _, r in hist if r.get("unit")), "")
         print("== %s%s" % (config, "  (%s)" % unit if unit else ""))
-        print("  %-22s %12s %10s %7s %10s %9s  %s" %
-              ("run", "value", "step_ms", "mfu", "compile_s", "ttft_p95",
-               "flags"))
+        print("  %-22s %12s %10s %7s %10s %9s %9s  %s" %
+              ("run", "value", "step_ms", "mfu", "compile_s", "hbm_peak",
+               "ttft_p95", "flags"))
         best = {}                   # metric -> best value over PRIOR rows
         for label, row in hist:
             flags = []
             for metric, better_low, tol in (("step_ms", True, 1.10),
                                             ("mfu", False, 0.90),
                                             ("compile_s", True, 1.10),
+                                            ("hbm_peak", True, 1.10),
                                             ("tokens_per_s", False, 0.90),
                                             ("ttft_ms_p95", True, 1.10)):
                 v = row.get(metric)
@@ -739,7 +920,7 @@ def cmd_bench(directory) -> int:
                                  % (metric, v, b))
                 if b is None or (v < b if better_low else v > b):
                     best[metric] = v
-            print("  %-22s %12s %10s %7s %10s %9s  %s" % (
+            print("  %-22s %12s %10s %7s %10s %9s %9s  %s" % (
                 label,
                 "%.4g" % row["value"]
                 if isinstance(row.get("value"), (int, float)) else "-",
@@ -749,6 +930,9 @@ def cmd_bench(directory) -> int:
                 if isinstance(row.get("mfu"), (int, float)) else "-",
                 "%.4g" % row["compile_s"]
                 if isinstance(row.get("compile_s"), (int, float)) else "-",
+                _fmt_qty(row["hbm_peak"])
+                if isinstance(row.get("hbm_peak"),
+                              (int, float)) else "-",
                 "%.4g" % row["ttft_ms_p95"]
                 if isinstance(row.get("ttft_ms_p95"),
                               (int, float)) else "-",
@@ -764,7 +948,7 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     sub = ap.add_subparsers(dest="cmd", required=True)
     for name in ("summary", "timeline", "crash", "lint", "profile",
-                 "trace", "bench"):
+                 "roofline", "trace", "bench"):
         p = sub.add_parser(name)
         p.add_argument("dir", help="telemetry directory (--log_dir / "
                                    "telemetry_dir of the run); for "
@@ -792,6 +976,8 @@ def main(argv=None) -> int:
         return cmd_lint(agg, args.dir)
     if args.cmd == "profile":
         return cmd_profile(agg, args.dir)
+    if args.cmd == "roofline":
+        return cmd_roofline(agg, args.dir)
     return cmd_crash(agg, args.dir)
 
 
