@@ -291,6 +291,10 @@ def serving_gates(row):
         scales) vs a bf16 cache of identical geometry
       * int8_decode_compile_once — quantize-on-append must not break
         the compile-once contract
+      * fused_decode_tps_ge_einsum — the fused paged-decode megakernel
+        engine (ISSUE 15) must not be slower than the windowed-einsum
+        fallback engine on the same workload (TPU evidence only; rows
+        carry both fields only when the paths actually diverge)
 
     Same contract as the budget gates: a miss emits a
     `bench_gate_failed` journal event but never breaks the one-JSON-
@@ -321,6 +325,10 @@ def serving_gates(row):
     if isinstance(row.get("int8_decode_compiles"), (int, float)):
         gates["int8_decode_compile_once"] = \
             row["int8_decode_compiles"] == 1
+    if isinstance(row.get("fused_decode_tps"), (int, float)) and \
+            isinstance(row.get("einsum_decode_tps"), (int, float)):
+        gates["fused_decode_tps_ge_einsum"] = \
+            row["fused_decode_tps"] >= row["einsum_decode_tps"]
     if len(gates) < 3 or not all(gates.values()):
         _emit_bench_event(
             "bench_gate_failed", config=row.get("config"), gates=gates,
@@ -329,7 +337,9 @@ def serving_gates(row):
             speedup_x=row.get("speedup_x"),
             prefix_ttft_ratio=row.get("prefix_ttft_ratio"),
             int8_parity_tokens=row.get("int8_parity_tokens"),
-            int8_nbytes_ratio=row.get("int8_nbytes_ratio"))
+            int8_nbytes_ratio=row.get("int8_nbytes_ratio"),
+            fused_decode_tps=row.get("fused_decode_tps"),
+            einsum_decode_tps=row.get("einsum_decode_tps"))
     return gates
 
 

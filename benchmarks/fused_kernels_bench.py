@@ -1,7 +1,10 @@
 """Micro-benchmarks: Pallas fused kernels vs their XLA fallbacks on TPU.
 
 Run on a TPU host:  python benchmarks/fused_kernels_bench.py
-Prints one JSON line per kernel with pallas/xla times and speedup.
+Prints one JSON line per kernel (bench.py conventions: every row carries
+a "config" key) and ends with ONE machine-readable headline line
+(metric/value/unit/vs_baseline + the per-config rows under "results") so
+driver captures and `ptdoctor bench` can trend the kernels run-over-run.
 Shapes follow the GPT-2/ERNIE configs in BASELINE.md."""
 from __future__ import annotations
 
@@ -68,7 +71,8 @@ def bench_flash_attention(B=8, H=12, T=1024, D=64, dtype=jnp.bfloat16):
                 q, k, v, iters=3) / CHAIN
     tx = timeit(chain(lambda q, k, v: _xla_attention(q, k, v, True)),
                 q, k, v, iters=3) / CHAIN
-    return {"kernel": "flash_attention_fwd_bwd",
+    return {"config": "flash_attention_fwd_bwd",
+            "kernel": "flash_attention_fwd_bwd",
             "shape": [B, H, T, D], "dtype": str(dtype.__name__),
             "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
             "speedup": round(tx / tp, 2)}
@@ -112,7 +116,8 @@ def bench_fused_ln(N=8192, Hdim=768, p=0.1, dtype=jnp.bfloat16):
 
     tp = timeit(chain(fused), x, res, key, iters=3) / CHAIN
     tx = timeit(chain(unfused), x, res, key, iters=3) / CHAIN
-    return {"kernel": "fused_bias_dropout_residual_ln_fwd_bwd",
+    return {"config": "fused_bias_dropout_residual_ln_fwd_bwd",
+            "kernel": "fused_bias_dropout_residual_ln_fwd_bwd",
             "shape": [N, Hdim], "dtype": str(dtype.__name__),
             "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
             "speedup": round(tx / tp, 2)}
@@ -149,10 +154,143 @@ def bench_fused_adamw(numel=768 * 3072, dtype=jnp.float32):
                 iters=3) / CHAIN
     tx = timeit(chain(lambda *a: xla_fn(*a)), p, g, lr, t, m1, m2,
                 iters=3) / CHAIN
-    return {"kernel": "fused_adamw_update",
+    return {"config": "fused_adamw_update",
+            "kernel": "fused_adamw_update",
             "shape": list(shape), "dtype": str(np.dtype(dtype).name),
             "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
             "speedup": round(tx / tp, 2)}
+
+
+def bench_paged_decode(B=8, H=12, T=2048, D=64, live=256, quantized=True,
+                       dtype=jnp.float32):
+    """The serving megakernel vs the full-depth masked einsum it
+    replaces: CHAIN fused decode steps (cache threaded through, length
+    pinned at `live`) against the same steps as write + dequant + masked
+    einsum over all T positions. The speedup is the HBM-traffic ratio
+    the clamped BlockSpec buys (reads scale with `live`, not T)."""
+    from paddle_tpu.ops import pallas_kernels as pk
+    from paddle_tpu.inference.serving.cache import quantize_kv
+    blk = pk._paged_block(T)
+    interp = jax.default_backend() != "tpu"
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, 1, D), dtype)
+    nk = jnp.asarray(rs.randn(B, H, 1, D), dtype)
+    nv = jnp.asarray(rs.randn(B, H, 1, D), dtype)
+    kf = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    vf = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    lens = jnp.full((B,), live, jnp.int32)
+    if quantized:
+        kc, ks = quantize_kv(kf)
+        vc, vs = quantize_kv(vf)
+    else:
+        kc, vc, ks, vs = kf, vf, None, None
+
+    @jax.jit
+    def fused(q, kc, vc, ks, vs):
+        for _ in range(CHAIN):
+            out, kc, vc, ks2, vs2 = pk._paged_decode(
+                q, kc, vc, lens, nk, nv, ks, vs, block_k=blk,
+                interpret=interp)
+            if quantized:
+                ks, vs = ks2, vs2
+            q = (q + 1e-3 * out).astype(q.dtype)
+        return q
+
+    def _write(buf, new, ln):
+        z = jnp.int32(0)
+        return jax.lax.dynamic_update_slice(buf, new, (z, ln, z))
+
+    def _write_sc(buf, new, ln):
+        return jax.lax.dynamic_update_slice(buf, new, (jnp.int32(0), ln))
+
+    @jax.jit
+    def einsum(q, kc, vc, ks, vs):
+        for _ in range(CHAIN):
+            if quantized:
+                nkq, nks = quantize_kv(nk)
+                nvq, nvs = quantize_kv(nv)
+                kc = jax.vmap(_write)(kc, nkq, lens)
+                vc = jax.vmap(_write)(vc, nvq, lens)
+                ks = jax.vmap(_write_sc)(ks, nks, lens)
+                vs = jax.vmap(_write_sc)(vs, nvs, lens)
+                kw = kc.astype(jnp.float32) * ks[..., None]
+                vw = vc.astype(jnp.float32) * vs[..., None]
+            else:
+                kc = jax.vmap(_write)(kc, nk.astype(kc.dtype), lens)
+                vc = jax.vmap(_write)(vc, nv.astype(vc.dtype), lens)
+                kw, vw = kc.astype(jnp.float32), vc.astype(jnp.float32)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                           kw) * (float(D) ** -0.5)
+            valid = (jnp.arange(T)[None, None, None, :]
+                     <= lens[:, None, None, None])
+            s = jnp.where(valid, s, jnp.float32(-1e30))
+            out = jnp.einsum("bhqk,bhkd->bhqd",
+                             jax.nn.softmax(s, axis=-1), vw)
+            q = (q + 1e-3 * out).astype(q.dtype)
+        return q
+
+    tp = timeit(fused, q, kc, vc, ks, vs, iters=3) / CHAIN
+    tx = timeit(einsum, q, kc, vc, ks, vs, iters=3) / CHAIN
+    return {"config": "paged_decode_attention",
+            "kernel": "paged_decode_attention",
+            "shape": [B, H, T, D], "live_len": live,
+            "block_k": blk, "int8": bool(quantized),
+            "dtype": str(dtype.__name__),
+            "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
+            "speedup": round(tx / tp, 2)}
+
+
+def bench_decoder_block_tail(N=8192, Hdim=768, p=0.1, dtype=jnp.bfloat16):
+    """FLAGS_fused_block tail: ONE pass producing (ln_2(z), z) vs the
+    composed residual-add + separate LayerNorm read (fwd + bwd), the
+    exact pair of ops GPTDecoderLayer fuses between attention and MLP."""
+    from paddle_tpu.ops.pallas_kernels import (
+        fused_bias_dropout_residual_ln_arrays)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(N, Hdim), dtype)
+    res = jnp.asarray(rs.randn(N, Hdim), dtype)
+    gamma = jnp.ones((Hdim,), dtype)
+    beta = jnp.zeros((Hdim,), dtype)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def fused(x, res, key):
+        def f(x):
+            y, z = fused_bias_dropout_residual_ln_arrays(
+                x, res, None, gamma, beta, key, p, 1e-5, True,
+                "upscale_in_train")
+            return y.sum() + z.sum()    # both outputs consumed, like the
+        return jax.grad(f)(x)           # block (y→MLP, z→residual)
+
+    @jax.jit
+    def unfused(x, res, key):
+        def f(x):
+            keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+            z = res + jnp.where(keep, x / (1.0 - p), 0)
+            mean = z.mean(-1, keepdims=True)
+            var = ((z - mean) ** 2).mean(-1, keepdims=True)
+            y = (z - mean) * jax.lax.rsqrt(var + 1e-5) * gamma + beta
+            return y.sum() + z.sum()
+        return jax.grad(f)(x)
+
+    def chain(g):
+        @jax.jit
+        def step(x, res, key):
+            for _ in range(CHAIN):
+                x = (x + 1e-3 * g(x, res, key)).astype(x.dtype)
+            return x
+        return step
+
+    tp = timeit(chain(fused), x, res, key, iters=3) / CHAIN
+    tx = timeit(chain(unfused), x, res, key, iters=3) / CHAIN
+    return {"config": "decoder_block_tail",
+            "kernel": "decoder_block_tail_pair_fwd_bwd",
+            "shape": [N, Hdim], "dtype": str(dtype.__name__),
+            "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
+            "speedup": round(tx / tp, 2)}
+
+
+_METRIC = "fused_kernels_geomean_speedup"
 
 
 def main():
@@ -162,7 +300,9 @@ def main():
                       "non-TPU smoke run: tiny shapes, interpret-mode "
                       "pallas — timings not meaningful"}))
     if tpu:
-        benches = [bench_flash_attention, bench_fused_ln, bench_fused_adamw]
+        benches = [bench_flash_attention, bench_fused_ln,
+                   bench_fused_adamw, bench_paged_decode,
+                   bench_decoder_block_tail]
     else:
         benches = [
             functools.partial(bench_flash_attention, B=1, H=2, T=64, D=16,
@@ -170,16 +310,32 @@ def main():
             functools.partial(bench_fused_ln, N=64, Hdim=128,
                               dtype=jnp.float32),
             functools.partial(bench_fused_adamw, numel=128 * 16),
+            functools.partial(bench_paged_decode, B=2, H=2, T=128, D=16,
+                              live=16),
+            functools.partial(bench_decoder_block_tail, N=64, Hdim=128,
+                              dtype=jnp.float32),
         ]
+    rows = []
     for fn in benches:
+        name = getattr(fn, "__name__", getattr(
+            getattr(fn, "func", None), "__name__", "bench"))
         try:
-            print(json.dumps(fn()), flush=True)
+            row = fn()
+            rows.append(row)
+            print(json.dumps(row), flush=True)
         except Exception as e:
-            name = getattr(fn, "__name__", getattr(
-                getattr(fn, "func", None), "__name__", "bench"))
-            print(json.dumps({"kernel": name,
+            print(json.dumps({"config": name, "kernel": name,
                               "error": f"{type(e).__name__}: {e}"}),
                   flush=True)
+    # headline: ONE machine-readable line, bench.py conventions
+    speedups = [r["speedup"] for r in rows
+                if isinstance(r.get("speedup"), (int, float))
+                and r["speedup"] > 0]
+    geomean = (round(float(np.exp(np.mean(np.log(speedups)))), 3)
+               if speedups else None)
+    print(json.dumps({"metric": _METRIC, "value": geomean, "unit": "x",
+                      "vs_baseline": 0.0, "backend": jax.default_backend(),
+                      "results": rows}), flush=True)
 
 
 if __name__ == "__main__":

@@ -371,6 +371,46 @@ def bench_gpt2_prefix_int8(on_tpu):
     bf16 = PagedKVCache(len(model.gpt.layers), 2, attn.num_heads, 96,
                         attn.head_dim, kv_dtype="bfloat16")
 
+    # -- fused paged-decode megakernel vs windowed einsum (ISSUE 15) -----
+    # The tps pair (and the fused_decode_tps_ge_einsum gate keyed on it)
+    # is attached only when the paged_flash path actually traced for a
+    # fresh engine — on CPU both engines lower to the einsum fallback
+    # and the ratio would be pure noise.
+    from paddle_tpu.framework.flags import get_flags, set_flags
+    from paddle_tpu.ops.pallas_kernels import attention_path_counts
+    fused_fields = {}
+
+    def timed_decode(eng, steps=40):
+        for s in range(2):
+            eng.prefill(s, prompt)
+        toks = [int(t) for t in eng.decode()]     # warm / compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            toks.extend(int(t) for t in eng.decode())
+        wall = time.perf_counter() - t0
+        return toks, 2 * steps / wall
+
+    before = attention_path_counts().get("paged_flash", 0)
+    eng_fu = GenerationEngine(model, max_batch=2, max_seq_len=96,
+                              prefill_buckets=(16,), kv_dtype="int8",
+                              prefix_cache_bytes=0)
+    tok_fu, fused_tps = timed_decode(eng_fu)
+    if attention_path_counts().get("paged_flash", 0) > before:
+        saved = get_flags("paged_flash_decode")
+        set_flags({"paged_flash_decode": False})
+        try:
+            eng_ei = GenerationEngine(model, max_batch=2, max_seq_len=96,
+                                      prefill_buckets=(16,),
+                                      kv_dtype="int8",
+                                      prefix_cache_bytes=0)
+            tok_ei, einsum_tps = timed_decode(eng_ei)
+        finally:
+            set_flags(saved)
+        fused_fields = {"fused_decode_tps": round(fused_tps, 1),
+                        "einsum_decode_tps": round(einsum_tps, 1),
+                        "fused_einsum_parity_ok": tok_fu == tok_ei,
+                        "fused_decode_compiles": eng_fu.decode_compiles}
+
     row = {"config": "gpt2_prefix_int8", "infer": True,
            "model": "gpt-tiny-hd64", "n_requests": n_req,
            "max_batch": B, "max_seq_len": max_seq,
@@ -397,6 +437,7 @@ def bench_gpt2_prefix_int8(on_tpu):
            "int8_prefill_compiles": eng_q.prefill_compiles,
            "float_decode_compiles": eng_f.decode_compiles,
            "unit": "tokens/sec/chip"}
+    row.update(fused_fields)
     row["gates"] = serving_gates(row)
     return [row]
 

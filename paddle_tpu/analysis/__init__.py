@@ -32,7 +32,8 @@ __all__ = [
     "baseline_entries", "write_baseline", "findings_to_json",
     "emit_findings",
     "step_card", "step_card_from_jaxpr", "write_step_card",
-    "exposed_collective_findings",
+    "exposed_collective_findings", "fused_hbm_estimate",
+    "paged_decode_cost",
 ]
 
 
@@ -45,7 +46,8 @@ def __getattr__(name):
         return getattr(jaxpr_pass, name)
     if name in ("step_card", "step_card_from_jaxpr", "write_step_card",
                 "exposed_collective_findings", "COLLECTIVE_PRIMITIVES",
-                "OVERLAPPABLE_PRIMITIVES"):
+                "OVERLAPPABLE_PRIMITIVES", "fused_hbm_estimate",
+                "paged_decode_cost"):
         from . import cost_pass
         return getattr(cost_pass, name)
     raise AttributeError(name)

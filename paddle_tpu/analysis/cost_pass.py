@@ -33,7 +33,8 @@ from .jaxpr_pass import JAXPR_RULES, _nbytes, _walk_jaxprs
 
 __all__ = [
     "COLLECTIVE_PRIMITIVES", "OVERLAPPABLE_PRIMITIVES",
-    "exposed_collective_findings", "memory_analysis", "step_card",
+    "exposed_collective_findings", "fused_hbm_estimate",
+    "memory_analysis", "paged_decode_cost", "step_card",
     "step_card_from_jaxpr", "write_step_card",
 ]
 
@@ -112,6 +113,95 @@ def _eqn_bytes(eqn) -> int:
         if a is not None and getattr(a, "shape", None) is not None:
             n += _nbytes(a.shape, a.dtype)
     return n
+
+
+# primitives a fusing compiler (or a hand-written megakernel) keeps in
+# registers between producer and consumer — the elementwise arithmetic
+# set plus the free movement/layout prims that ride along in a fusion
+_FUSABLE = _ELEMENTWISE | frozenset({
+    "broadcast_in_dim", "convert_element_type", "copy", "iota",
+    "reshape", "select_n", "squeeze", "stop_gradient", "transpose",
+})
+
+
+def fused_hbm_estimate(closed_jaxpr) -> int:
+    """HBM bytes of the step if every producer→consumer elementwise
+    chain were fused into one pass (the megakernel target).
+
+    Same walk as `_eqn_bytes` but: a fusable eqn's operand read is
+    elided when its producer is also fusable (the value never left
+    registers), and its result write is elided when every consumer is
+    fusable and it is not a program output. Non-fusable eqns
+    (contractions, convs, scatters, collectives) pay full freight. The
+    gap to `hbm_bytes` is the **fusion headroom** `ptdoctor roofline`
+    reports — bytes a block-fusion kernel can remove without changing
+    any math."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    total = 0
+    for jx in _walk_jaxprs(jaxpr):
+        producer = {}
+        consumers: Dict[int, list] = {}
+        for eqn in jx.eqns:
+            for v in eqn.outvars:
+                producer[id(v)] = eqn
+            for v in eqn.invars:
+                consumers.setdefault(id(v), []).append(eqn)
+        out_ids = {id(v) for v in jx.outvars}
+        for eqn in jx.eqns:
+            fusable = eqn.primitive.name in _FUSABLE
+            for v in eqn.invars:
+                a = _aval(v)
+                if a is None or getattr(a, "shape", None) is None:
+                    continue
+                p = producer.get(id(v))
+                if (fusable and p is not None
+                        and p.primitive.name in _FUSABLE):
+                    continue
+                total += _nbytes(a.shape, a.dtype)
+            for v in eqn.outvars:
+                a = _aval(v)
+                if a is None or getattr(a, "shape", None) is None:
+                    continue
+                cs = consumers.get(id(v))
+                if (fusable and id(v) not in out_ids and cs
+                        and all(c.primitive.name in _FUSABLE for c in cs)):
+                    continue
+                total += _nbytes(a.shape, a.dtype)
+    return total
+
+
+def paged_decode_cost(batch: int, n_heads: int, t_max: int, head_dim: int,
+                      live_len: int, *, block_k: int = 128,
+                      quantized: bool = False,
+                      dtype_bytes: int = 4) -> dict:
+    """Analytic per-decode-step HBM read traffic of the paged KV cache,
+    einsum path vs fused Pallas megakernel — the static proof that the
+    fused path's bytes scale with LIVE length, not cache capacity.
+
+    The einsum path reads (and for int8, dequantizes to f32) the full
+    [B, H, t_max, D] K and V every step; with `windows` it reads the
+    smallest prefill bucket covering max(lens)+1, still shared across
+    the whole batch. The megakernel's clamped BlockSpec index map reads
+    only each slot's live blocks: ceil((live+1)/block_k)·block_k
+    positions per (slot, head). Scales add 4 bytes/position when
+    quantized. q/new-token/output traffic is identical on both paths
+    and omitted."""
+    kv_b = (1 if quantized else dtype_bytes) * head_dim
+    if quantized:
+        kv_b += 4                       # f32 per-token k/v scale
+    per_pos = 2 * kv_b                  # K and V
+    live_blocks = -(-min(live_len + 1, t_max) // block_k)
+    fused_pos = min(live_blocks * block_k, t_max)
+    einsum = batch * n_heads * t_max * per_pos
+    fused = batch * n_heads * fused_pos * per_pos
+    return {
+        "batch": batch, "n_heads": n_heads, "t_max": t_max,
+        "head_dim": head_dim, "live_len": live_len, "block_k": block_k,
+        "quantized": quantized,
+        "einsum_bytes": einsum,
+        "fused_bytes": fused,
+        "savings_ratio": round(1.0 - fused / einsum, 4) if einsum else 0.0,
+    }
 
 
 def _collective_record(eqn) -> dict:
@@ -217,15 +307,21 @@ def step_card_from_jaxpr(closed_jaxpr, label: str = "<step>", *,
                     "bytes": by,
                 })
     ranked.sort(key=lambda r: (r["flops"], r["bytes"]), reverse=True)
+    fused_bytes = fused_hbm_estimate(jaxpr)
     card = {
         "label": label,
         "eqns": n_eqns,
         "flops": total_flops,
         "hbm_bytes": total_bytes,
+        # hbm_bytes with every elementwise producer→consumer chain
+        # fused — the delta is the fusion headroom megakernels attack
+        "hbm_bytes_fused": fused_bytes,
         # bytes/flop: > ~1 means the step is bandwidth-shaped even
         # before fusion; the MFU ceiling is memory, not the MXU
         "arithmetic_intensity": round(total_flops / total_bytes, 3)
         if total_bytes else None,
+        "arithmetic_intensity_fused": round(total_flops / fused_bytes, 3)
+        if fused_bytes else None,
         "collectives": {
             "count": len(collectives),
             "bytes": sum(c["bytes"] for c in collectives),

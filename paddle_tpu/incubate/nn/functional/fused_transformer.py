@@ -40,6 +40,50 @@ def _fbdr_op(x, residual, bias, key, *, dropout_rate, training, mode):
     return z
 
 
+@primitive("fused_bias_dropout_residual_ln_pair")
+def _fbdrln_pair_op(x, residual, bias, ln_scale, ln_bias, key, *,
+                    dropout_rate, ln_epsilon, training, mode):
+    """Two-output variant backing the decoder-block fusion
+    (FLAGS_fused_block): ONE Pallas pass yields both
+    z = residual + dropout(x + bias) (the residual stream) and
+    y = LN(z) (the next sublayer's input), so the post-attention
+    activation is read from HBM once instead of once for the residual
+    add and again for the LN."""
+    return pk.fused_bias_dropout_residual_ln_arrays(
+        x, residual, bias, ln_scale, ln_bias, key, dropout_rate,
+        ln_epsilon, training, mode)
+
+
+def fused_bias_dropout_residual_ln_pair(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """(LN(z), z) with z = residual + dropout(x + bias), both outputs of
+    one fused Pallas pass — the decoder-block tail used by
+    GPTDecoderLayer under FLAGS_fused_block (y feeds the MLP, z carries
+    the residual stream to the MLP's own residual add). Gated on kernel
+    GEOMETRY only (not FLAGS_use_fused_dropout_ln — the caller's
+    FLAGS_fused_block is the opt-in); rejected shapes/backends take the
+    composed ops, which are also the parity oracle."""
+    if not pk.fused_ln_geometry_ok(pk.raw(x), dropout_rate, training):
+        h = x if bias is None else m.add(x, bias)
+        h = F.dropout(h, dropout_rate, training=training, mode=mode)
+        z = m.add(residual, h)
+        d = x.shape[-1]
+        return F.layer_norm(z, (d,), ln_scale, ln_bias, ln_epsilon), z
+    if ln_scale is None:
+        import paddle_tpu
+        ln_scale = paddle_tpu.ones((x.shape[-1],), x.dtype)
+    if ln_bias is None:
+        import paddle_tpu
+        ln_bias = paddle_tpu.zeros((x.shape[-1],), x.dtype)
+    return _fbdrln_pair_op(x, residual, bias, ln_scale, ln_bias,
+                           RNG.next_key(),
+                           dropout_rate=float(dropout_rate),
+                           ln_epsilon=float(ln_epsilon),
+                           training=bool(training), mode=str(mode))
+
+
 def fused_bias_dropout_residual(x, residual, bias=None, dropout_rate=0.5,
                                 training=True, mode="upscale_in_train",
                                 name=None):

@@ -74,16 +74,26 @@ class LayerCacheView:
     (None otherwise). `GPTAttention.forward` detects this type
     (duck-typed on `.lens`), writes the incoming K/V at each slot's
     `lens` offset (quantizing on append), attends over positions
-    `<= lens`, and stores the updated buffers back on the view."""
+    `<= lens`, and stores the updated buffers back on the view.
 
-    __slots__ = ("k", "v", "lens", "k_scale", "v_scale")
+    `windows`: optional static tuple of attend-window lengths (the
+    engine passes its prefill buckets + max_seq_len, sorted). The
+    einsum fallback in models/gpt.py uses it to `lax.switch` onto the
+    smallest window covering max(lens)+1 instead of attending (and,
+    for int8, dequantizing) the full T_max buffer every step. None →
+    full-depth attention (legacy callers). Shapes stay static either
+    way — the traced lens picks a branch, never a shape."""
 
-    def __init__(self, k, v, lens, k_scale=None, v_scale=None):
+    __slots__ = ("k", "v", "lens", "k_scale", "v_scale", "windows")
+
+    def __init__(self, k, v, lens, k_scale=None, v_scale=None,
+                 windows=None):
         self.k = k
         self.v = v
         self.lens = lens
         self.k_scale = k_scale
         self.v_scale = v_scale
+        self.windows = windows
 
 
 def bucket_for(length: int, buckets: Sequence[int]) -> int:

@@ -100,7 +100,10 @@ class GenerationEngine:
         from ...jit import compile_cache
         from ...ops.pallas_kernels import preprobe_pallas_health
         compile_cache.configure()
-        preprobe_pallas_health(needs_prng=False)
+        # needs_paged: probe the paged-decode megakernel tier now so the
+        # decode trace's gate reads a cached verdict (mid-trace probing
+        # would add a hidden compile to the decode-compiles-once budget)
+        preprobe_pallas_health(needs_prng=False, needs_paged=True)
 
         gpt = getattr(model, "gpt", model)
         if not hasattr(gpt, "layers") or not hasattr(gpt, "embeddings"):
@@ -144,6 +147,11 @@ class GenerationEngine:
             self._n_layers, self.max_batch, self._n_heads,
             self.max_seq_len, self._head_dim, kv_dtype=kv_dtype)
         self._last = jnp.zeros((self.max_batch, 1), jnp.int32)
+        # static attend windows for the einsum decode fallback: the
+        # prefill buckets + full depth, so short conversations pay for
+        # their bucket, not for max_seq_len (models/gpt.py lax.switch)
+        self._decode_windows = tuple(sorted(
+            set(self.buckets) | {self.max_seq_len}))
 
         budget = cache_mod.prefix_cache_budget(prefix_cache_bytes)
         self.prefix_cache = (cache_mod.PrefixCache(budget, self.buckets)
@@ -336,7 +344,8 @@ class GenerationEngine:
             views = [cache_mod.LayerCacheView(
                         kc[i], vc[i], lens,
                         None if ksc is None else ksc[i],
-                        None if vsc is None else vsc[i])
+                        None if vsc is None else vsc[i],
+                        windows=self._decode_windows)
                      for i in range(self._n_layers)]
             # new token's absolute position == tokens already resident;
             # clamped so idle slots that hit the wall index a real row

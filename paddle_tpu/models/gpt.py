@@ -89,22 +89,40 @@ def _paged_decode_attention(q, k, v, view):
 
     q/k/v: [B, nh, 1, hd]; view (inference/serving/cache.LayerCacheView)
     carries k/v buffers [B, nh, T_max, hd] + per-slot lengths int32 [B].
-    The new K/V is written at each slot's length index with a vmapped
-    `dynamic_update_slice` (a scatter — indices are traced, shapes are
-    not), then scores over positions > lens are masked off. Replaces
-    the growing `concat` cache so the decode step compiles once.
 
-    When the view carries scales (int8 cache), the step's K/V is
-    quantized before the write — the int8 payload and its float32
-    per-token scale land at the same index — and the whole buffer is
-    dequantized right next to the matmul (values x scale), the standard
-    quantized-paged-attention layout. Same shapes either way, so the
-    quantized decode is still one compiled program.
+    Fast path — the fused Pallas megakernel
+    (ops/pallas_kernels.paged_decode_attention_or_none): one launch per
+    step doing length-masked flash attention over only the LIVE cache
+    blocks, with the new-token append (incl. int8 quantize) and the
+    k_scale/v_scale dequant folded in, so per-token HBM traffic scales
+    with live length rather than cache capacity. Counter
+    pt_attn_path_total{path=paged_flash}.
+
+    Fallback (flag off / ineligible shape / unhealthy Mosaic / CPU) —
+    the windowed XLA einsum, counter {path=xla_paged}: the new K/V is
+    written at each slot's length index with a vmapped
+    `dynamic_update_slice`, then attention runs over a STATIC window
+    chosen by `lax.switch` from view.windows (the serving prefill
+    buckets + T_max): the smallest bucket covering max(lens)+1. Each
+    branch slices, dequantizes (int8) and attends that window only, so
+    even the non-Pallas path stops paying O(T_max) dequant+attend per
+    token while remaining one compiled program. A view without
+    `windows` attends full T_max (legacy callers). Both paths keep the
+    decode-compiles-once contract: shapes never depend on traced values.
     """
     import jax
     import jax.numpy as jnp
     qa, ka, va = q._data, k._data, v._data
     lens = view.lens
+    from ..ops import pallas_kernels as pk
+    fused = pk.paged_decode_attention_or_none(
+        qa, view.k, view.v, lens, ka, va, view.k_scale, view.v_scale)
+    if fused is not None:
+        out, view.k, view.v, ks, vs = fused
+        if view.k_scale is not None:
+            view.k_scale, view.v_scale = ks, vs
+        return Tensor(out.astype(qa.dtype), _internal=True)
+    pk._note_attn_path("xla_paged")
 
     def _write(buf, new, ln):
         z = jnp.int32(0)
@@ -115,7 +133,8 @@ def _paged_decode_attention(q, k, v, view):
         return jax.lax.dynamic_update_slice(
             buf, new, (jnp.int32(0), ln.astype(jnp.int32)))
 
-    if view.k_scale is not None:
+    quantized = view.k_scale is not None
+    if quantized:
         from ..inference.serving.cache import quantize_kv
         qk, k_sc = quantize_kv(ka)      # int8 [B,nh,1,hd] + f32 [B,nh,1]
         qv, v_sc = quantize_kv(va)
@@ -125,23 +144,47 @@ def _paged_decode_attention(q, k, v, view):
         vsb = jax.vmap(_write_scale)(view.v_scale, v_sc, lens)
         view.k, view.v = kb, vb
         view.k_scale, view.v_scale = ksb, vsb
-        kf = kb.astype(jnp.float32) * ksb[..., None]
-        vf = vb.astype(jnp.float32) * vsb[..., None]
     else:
         kb = jax.vmap(_write)(view.k, ka.astype(view.k.dtype), lens)
         vb = jax.vmap(_write)(view.v, va.astype(view.v.dtype), lens)
         view.k, view.v = kb, vb
-        kf = kb.astype(jnp.float32)
-        vf = vb.astype(jnp.float32)
+        ksb = vsb = None
     scale = 1.0 / math.sqrt(qa.shape[-1])
-    scores = jnp.einsum("bhqd,bhkd->bhqk", qa.astype(jnp.float32),
-                        kf) * scale
-    # the freshly written token sits AT index lens -> keep positions <= lens
-    valid = (jnp.arange(kb.shape[2])[None, None, None, :]
-             <= lens[:, None, None, None])
-    scores = jnp.where(valid, scores, jnp.float32(-1e30))
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
+    t_max = kb.shape[2]
+
+    def _attend(w):
+        """Attend the first `w` (static) cache positions."""
+        kw = jax.lax.slice_in_dim(kb, 0, w, axis=2)
+        vw = jax.lax.slice_in_dim(vb, 0, w, axis=2)
+        if quantized:
+            ksw = jax.lax.slice_in_dim(ksb, 0, w, axis=2)
+            vsw = jax.lax.slice_in_dim(vsb, 0, w, axis=2)
+            kf = kw.astype(jnp.float32) * ksw[..., None]
+            vf = vw.astype(jnp.float32) * vsw[..., None]
+        else:
+            kf = kw.astype(jnp.float32)
+            vf = vw.astype(jnp.float32)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qa.astype(jnp.float32),
+                            kf) * scale
+        # freshly written token sits AT index lens -> keep pos <= lens
+        valid = (jnp.arange(w)[None, None, None, :]
+                 <= lens[:, None, None, None])
+        scores = jnp.where(valid, scores, jnp.float32(-1e30))
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
+
+    windows = getattr(view, "windows", None)
+    if not windows or tuple(windows) == (t_max,):
+        out = _attend(t_max)
+    else:
+        windows = tuple(int(w) for w in windows)
+        # smallest window covering every live slot + the appended token;
+        # traced value selects a branch, never a shape
+        need = jnp.minimum(jnp.max(lens) + 1, t_max)
+        idx = jnp.searchsorted(jnp.asarray(windows, jnp.int32), need,
+                               side="left")
+        out = jax.lax.switch(
+            idx, [lambda w=w: _attend(w) for w in windows])
     return Tensor(out.astype(qa.dtype), _internal=True)
 
 
@@ -293,7 +336,30 @@ class GPTDecoderLayer(Layer):
                 mode=self.dropout.mode)
         return residual + self.dropout(h)
 
+    def _fused_block_ok(self):
+        """Decoder-block fusion opt-in (FLAGS_fused_block): the attention
+        epilogue (residual dropout-add) and ln_2 run as ONE Pallas pass
+        (fused_bias_dropout_residual_ln_pair), so the post-attention
+        activation never round-trips HBM between the residual add and
+        the LN read. Off-mesh only — under GSPMD meshes XLA owns layout
+        and fusing by hand would fight the partitioner."""
+        from ..framework import state
+        from ..framework.flags import flag
+        return flag("fused_block") and state.current_mesh() is None
+
     def forward(self, x, cache=None):
+        if cache is None and self._fused_block_ok():
+            from ..incubate.nn.functional import (
+                fused_bias_dropout_residual_ln_pair)
+            a = self.attn(self.ln_1(x))
+            # y = ln_2(z), z = x + dropout(a): one pass, two outputs
+            y, z = fused_bias_dropout_residual_ln_pair(
+                a, x, None, self.ln_2.weight, self.ln_2.bias,
+                self.dropout.p, self.ln_2._epsilon, self.training,
+                self.dropout.mode)
+            x = self._residual_dropout(self.mlp(y), z)
+            x = constrain(x, _seq_spec())
+            return x
         if cache is None:
             x = self._residual_dropout(self.attn(self.ln_1(x)), x)
         else:

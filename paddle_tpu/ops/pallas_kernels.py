@@ -68,7 +68,8 @@ _PROBE_FAILURES = {}
 def pallas_health_reasons():
     """Per-tier probe failure strings ({} when every probed tier passed).
     Keys: "base" (plain flash fwd+bwd kernels), "prng" (in-kernel dropout
-    PRNG tier). Values are one-line diagnoses — exception class + message
+    PRNG tier), "paged" (paged-decode megakernel tier). Values are
+    one-line diagnoses — exception class + message
     for compile/runtime failures, an oracle-mismatch note for silent
     miscompiles, or the env-override provenance."""
     return dict(_PROBE_FAILURES)
@@ -80,7 +81,8 @@ def _note_probe_failure(tier, reason, forced=False):
     decision, not a failure."""
     _PROBE_FAILURES[tier] = reason
     import warnings
-    label = {"base": "TPU", "prng": "PRNG"}.get(tier, tier)
+    label = {"base": "TPU", "prng": "PRNG",
+             "paged": "paged-decode"}.get(tier, tier)
     warnings.warn("Pallas %s probe failed: %s" % (label, reason))
     if forced:
         return
@@ -836,9 +838,13 @@ def _fbdrln_block_n(n, hdim):
 
 
 def _fbdrln_call(kernel, n_out, rng, arrs, out_dtypes, *, p, scale, eps,
-                 has_rng, with_ln, interpret):
+                 has_rng, with_ln, interpret, block_n=None):
     n, hdim = arrs[0].shape
-    bn = _fbdrln_block_n(n, hdim)
+    # an autotuned override must still be legal (divide n, or be the whole
+    # array) — a stale persisted entry for a different n falls back to the
+    # deterministic chooser rather than producing a ragged grid
+    bn = (block_n if block_n and (n % block_n == 0 or block_n == n)
+          else _fbdrln_block_n(n, hdim))
     if bn is None:
         # gated entries never get here (fused_ln_shapes_ok checks); direct
         # callers of the public array API can
@@ -882,7 +888,7 @@ def _fbdrln_make_rng(key, x2d, p, has_rng):
 
 
 def _fbdrln_vjp_fwd(x2d, res2d, bias, gamma, beta, key, p, scale, eps,
-                    has_rng, interpret):
+                    has_rng, interpret, block_n=None):
     rng = _fbdrln_make_rng(key, x2d, p, has_rng)
     with_ln = gamma is not None
     g2 = gamma if with_ln else jnp.ones((1, 1), x2d.dtype)
@@ -891,26 +897,30 @@ def _fbdrln_vjp_fwd(x2d, res2d, bias, gamma, beta, key, p, scale, eps,
         y, z = _fbdrln_call(
             _fbdrln_fwd_kernel, 2, rng, [x2d, res2d, bias, g2, b2],
             [x2d.dtype, x2d.dtype], p=p, scale=scale, eps=eps,
-            has_rng=has_rng, with_ln=True, interpret=interpret)
+            has_rng=has_rng, with_ln=True, interpret=interpret,
+            block_n=block_n)
     else:
         # no-LN: y IS z — single kernel output, half the HBM writes
         (z,) = _fbdrln_call(
             _fbdrln_fwd_noln_kernel, 1, rng, [x2d, res2d, bias, g2, b2],
             [x2d.dtype], p=p, scale=scale, eps=eps, has_rng=has_rng,
-            with_ln=False, interpret=interpret)
+            with_ln=False, interpret=interpret, block_n=block_n)
         y = z
     return (y, z), (z, gamma, rng, key)
 
 
-def _fbdrln_vjp_bwd(p, scale, eps, has_rng, interpret, resids, gs):
+def _fbdrln_vjp_bwd(p, scale, eps, has_rng, interpret, block_n, resids, gs):
     z, gamma, rng, key = resids
     dy, dz_extra = gs
     with_ln = gamma is not None
     g2 = gamma if with_ln else jnp.ones((1, 1), z.dtype)
+    # forward and backward MUST use the same row block: the dropout mask
+    # is regenerated per program from (seed + program_id), so a block
+    # mismatch would silently change which rows were dropped
     dx, dres = _fbdrln_call(
         _fbdrln_bwd_kernel, 2, rng, [z, dy, dz_extra, g2],
         [z.dtype, z.dtype], p=p, scale=scale, eps=eps, has_rng=has_rng,
-        with_ln=with_ln, interpret=interpret)
+        with_ln=with_ln, interpret=interpret, block_n=block_n)
     dbias = jnp.sum(dx, axis=0, keepdims=True).astype(z.dtype)
     if with_ln:
         # LN scale/shift grads: cheap XLA column reductions off saved z
@@ -930,11 +940,11 @@ def _fbdrln_vjp_bwd(p, scale, eps, has_rng, interpret, resids, gs):
 
 # Both y and z grads flow in practice (z feeds the next residual chain), so
 # the public entry exposes the (y, z) pair under one custom_vjp.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
 def _fbdrln_pair(x2d, res2d, bias, gamma, beta, key, p, scale, eps,
-                 has_rng, interpret):
+                 has_rng, interpret, block_n=None):
     (y, z), _ = _fbdrln_vjp_fwd(x2d, res2d, bias, gamma, beta, key, p,
-                                scale, eps, has_rng, interpret)
+                                scale, eps, has_rng, interpret, block_n)
     return y, z
 
 
@@ -942,14 +952,17 @@ _fbdrln_pair.defvjp(_fbdrln_vjp_fwd, _fbdrln_vjp_bwd)
 
 
 def fused_bias_dropout_residual_ln_arrays(x, residual, bias, gamma, beta,
-                                          key, p, eps, training, mode):
+                                          key, p, eps, training, mode,
+                                          block_n=None):
     """Array-level entry: x/residual [..., H] → (y, z) with
     z = residual + dropout(x + bias), y = LN(z) (or z when gamma is None).
 
     Dropout semantics mirror paddle's modes (reference
     python/paddle/fluid/layers/nn.py dropout): upscale_in_train scales kept
     values by 1/(1-p) at train time; downscale_in_infer keeps them unscaled
-    at train and scales by (1-p) at eval."""
+    at train and scales by (1-p) at eval. `block_n` overrides the row
+    block (fused_block_rows autotune); None uses the deterministic
+    chooser."""
     shape = x.shape
     hdim = shape[-1]
     n = 1
@@ -978,21 +991,23 @@ def fused_bias_dropout_residual_ln_arrays(x, residual, bias, gamma, beta,
             scale = 1.0
     has_rng = jax.default_backend() == "tpu"
     interpret = jax.default_backend() != "tpu"
+    if block_n is None:
+        block_n = fused_block_rows(n, hdim, x2d.dtype)
     y, z = _fbdrln_pair(x2d, res2d, b2, g2, be2, key, p_eff, scale,
-                        float(eps), has_rng, interpret)
+                        float(eps), has_rng, interpret, block_n)
     return y.reshape(shape), z.reshape(shape)
 
 
-def fused_ln_shapes_ok(x, dropout_p=None, training=True):
-    """Gate for the fused dropout-LN chain. On TPU an ACTIVE dropout
-    (training and p>0 — or unknown: dropout_p=None is conservative)
-    additionally requires the PRNG health tier, because the kernel then
-    generates its keep-mask from the on-chip PRNG; a PRNG-only Mosaic
-    regression must route those calls to the composed XLA fallback while
-    p=0/eval calls may still fuse."""
-    from ..framework.flags import flag
-    if not flag("use_fused_dropout_ln"):
-        return False
+def fused_ln_geometry_ok(x, dropout_p=None, training=True):
+    """Backend/shape/health eligibility for the fused dropout-LN chain,
+    WITHOUT any feature-flag check — shared by fused_ln_shapes_ok (the
+    FLAGS_use_fused_dropout_ln entry) and the FLAGS_fused_block decoder
+    fusion, which gate the same kernel under independent switches. On TPU
+    an ACTIVE dropout (training and p>0 — or unknown: dropout_p=None is
+    conservative) additionally requires the PRNG health tier, because the
+    kernel then generates its keep-mask from the on-chip PRNG; a
+    PRNG-only Mosaic regression must route those calls to the composed
+    XLA fallback while p=0/eval calls may still fuse."""
     hdim = x.shape[-1]
     n = 1
     for s in x.shape[:-1]:
@@ -1004,6 +1019,15 @@ def fused_ln_shapes_ok(x, dropout_p=None, training=True):
         return False
     return (pallas_tpu_healthy() and hdim % 128 == 0 and hdim <= 16384
             and _fbdrln_block_n(n, hdim) is not None)
+
+
+def fused_ln_shapes_ok(x, dropout_p=None, training=True):
+    """Gate for the FLAGS_use_fused_dropout_ln entry points: the flag
+    plus the shared backend/shape/health geometry check."""
+    from ..framework.flags import flag
+    if not flag("use_fused_dropout_ln"):
+        return False
+    return fused_ln_geometry_ok(x, dropout_p, training)
 
 
 # ---------------------------------------------------------------------------
@@ -1262,6 +1286,145 @@ def flash_block_sizes(bh, Tq, Tk, D, dtype, causal):
     return blocks
 
 
+# --- fused dropout-LN row-block autotune (FLAGS_fused_block) ---------------
+# Same scheme as the flash autotune: in-process cache → persisted
+# <PADDLE_TPU_TELEMETRY_DIR>/fused_block_autotune.json → one timed sweep
+# over the legal row blocks. The key is (rows, hdim, dtype); entries are
+# consulted by fused_bias_dropout_residual_ln_arrays for every fused
+# chain, so the decoder-block fusion and the plain fused-LN entry share
+# one table. Gated by FLAGS_flash_autotune_blocks (one switch for all
+# Pallas block sweeps); off-TPU the deterministic _fbdrln_block_n chooser
+# stands.
+
+_FBDRLN_SWEEP_CACHE = {}   # (n, hdim, dtype_str) -> block_n
+_FBDRLN_FILE_LOADED = False
+
+
+def _fused_block_cache_path():
+    import os
+    d = os.environ.get("PADDLE_TPU_TELEMETRY_DIR", "")
+    return os.path.join(d, "fused_block_autotune.json") if d else None
+
+
+def _fused_block_load():
+    global _FBDRLN_FILE_LOADED
+    if _FBDRLN_FILE_LOADED:
+        return
+    _FBDRLN_FILE_LOADED = True
+    path = _fused_block_cache_path()
+    if not path:
+        return
+    try:
+        import json
+        import os
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            data = json.load(f)
+        for key_s, bn in data.items():
+            parts = key_s.split("|")
+            if len(parts) != 3:
+                continue
+            _FBDRLN_SWEEP_CACHE.setdefault(
+                (int(parts[0]), int(parts[1]), parts[2]), int(bn))
+    except Exception:
+        pass  # torn/corrupt cache must never break a train step
+
+
+def _fused_block_save():
+    path = _fused_block_cache_path()
+    if not path:
+        return
+    try:
+        import json
+        import os
+        payload = {"|".join(str(p) for p in key): bn
+                   for key, bn in _FBDRLN_SWEEP_CACHE.items()}
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def _fbdrln_block_candidates(n, hdim):
+    """All legal row blocks for an (n, hdim) fused-LN kernel (the values
+    _fbdrln_block_n picks from, not just its first hit)."""
+    cap = max(1, (2 << 20) // (4 * hdim))
+    cands = [bn for bn in (256, 128, 64, 32, 16, 8)
+             if bn <= cap and n % bn == 0]
+    if not cands and n <= cap:
+        cands = [n]
+    return cands
+
+
+def fused_block_rows(n, hdim, dtype):
+    """Autotuned row block for the fused dropout-LN chain at (n, hdim,
+    dtype), or None to use the deterministic chooser. TPU + healthy +
+    FLAGS_flash_autotune_blocks only; the sweep times the full fwd+bwd
+    pair (the fusion's real cost) per candidate and persists the pick."""
+    if not flag("flash_autotune_blocks"):
+        return None
+    if jax.default_backend() != "tpu" or not pallas_tpu_healthy():
+        return None
+    key = (int(n), int(hdim), str(jnp.dtype(dtype)))
+    _fused_block_load()
+    hit = _FBDRLN_SWEEP_CACHE.get(key)
+    if hit is not None:
+        return hit
+    cands = _fbdrln_block_candidates(n, hdim)
+    if len(cands) <= 1:
+        bn = cands[0] if cands else None
+        if bn is not None:
+            _FBDRLN_SWEEP_CACHE[key] = bn
+        return bn
+    import time as _time
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(n, hdim), dtype)
+    res = jnp.asarray(rs.randn(n, hdim), dtype)
+    bias = jnp.zeros((1, hdim), dtype)
+    g2 = jnp.ones((1, hdim), dtype)
+    b2 = jnp.zeros((1, hdim), dtype)
+    seed = jnp.zeros((1,), jnp.int32)
+    timings = {}
+    best = None
+    for bn in cands:
+        def run(x, _bn=bn):
+            y, z = _fbdrln_pair(x, res, bias, g2, b2, seed, 0.1,
+                                1.0 / 0.9, 1e-5, True, False, _bn)
+            return (y.astype(jnp.float32).sum()
+                    + z.astype(jnp.float32).sum())
+
+        try:
+            vg = jax.value_and_grad(run)
+            with jax.ensure_compile_time_eval():
+                jax.block_until_ready(vg(x))  # compile + warm
+                t = []
+                for _ in range(2):
+                    t0 = _time.perf_counter()
+                    jax.block_until_ready(vg(x))
+                    t.append(_time.perf_counter() - t0)
+            dt = min(t)
+        except Exception:
+            continue
+        timings[str(bn)] = round(dt * 1e3, 3)
+        if best is None or dt < best[0]:
+            best = (dt, bn)
+    if best is None:
+        return None
+    _FBDRLN_SWEEP_CACHE[key] = best[1]
+    _fused_block_save()
+    try:
+        from ..observability import journal
+        journal.emit("fused_block_autotune", n=int(n), hdim=int(hdim),
+                     dtype=str(jnp.dtype(dtype)), block_n=best[1],
+                     timings_ms=timings)
+    except Exception:
+        pass
+    return best[1]
+
+
 # Which attention implementation actually traced — incremented at trace
 # time, so after one compiled step the counters say whether the hot model
 # really hit the Pallas kernels (VERDICT r3: "log which path ran").
@@ -1269,7 +1432,7 @@ def flash_block_sizes(bh, Tq, Tk, D, dtype, causal):
 # the metrics registry (pt_attn_path_total{path=}) via _note_attn_path so
 # bench.py and ptdoctor report from one source.
 _ATTN_PATHS = {"flash": 0, "flash_dropout": 0, "xla_sdpa": 0,
-               "xla_chunked": 0}
+               "xla_chunked": 0, "paged_flash": 0, "xla_paged": 0}
 
 _ATTN_HELP = "Attention implementations traced, by path"
 
@@ -1311,7 +1474,7 @@ def attention_path_totals():
     return out
 
 
-def preprobe_pallas_health(needs_prng=True):
+def preprobe_pallas_health(needs_prng=True, needs_paged=False):
     """Run the Mosaic health probes now IF the backend is TPU — called by
     compile entry points (make_train_step, static executor, predictor) at
     a clean, untraced moment so the gates consulted during their traces
@@ -1321,6 +1484,12 @@ def preprobe_pallas_health(needs_prng=True):
     eval-time traces never consult it (dropout_p=0 / training=False), and
     the extra flash-dropout compile is a whole Mosaic round trip on
     tunnel backends.
+
+    needs_paged=True (the serving engine) additionally probes the
+    paged-decode megakernel tier, so the decode trace's
+    paged_decode_attention_or_none gate reads a cached verdict instead of
+    running a probe compile mid-trace (which would double-count the
+    decode-compiles-exactly-once contract's compile).
 
     The first TPU preprobe also journals a `pallas_health` verdict event
     (tiers + failure reasons) and sets the pt_pallas_healthy{tier=}
@@ -1332,6 +1501,10 @@ def preprobe_pallas_health(needs_prng=True):
         prng = pallas_prng_healthy()  # probes the base tier internally
     else:
         prng = None
+    if needs_paged:
+        paged = paged_flash_healthy()  # probes the base tier internally
+    else:
+        paged = None
     base = pallas_tpu_healthy()
     global _HEALTH_EVENT_EMITTED
     if _HEALTH_EVENT_EMITTED:
@@ -1345,8 +1518,11 @@ def preprobe_pallas_health(needs_prng=True):
         g.labels("base").set(1.0 if base else 0.0)
         if prng is not None:
             g.labels("prng").set(1.0 if prng else 0.0)
+        if paged is not None:
+            g.labels("paged").set(1.0 if paged else 0.0)
         journal.emit("pallas_health", base=bool(base),
                      prng=(None if prng is None else bool(prng)),
+                     paged=(None if paged is None else bool(paged)),
                      reasons=pallas_health_reasons() or None)
     except Exception:
         pass
@@ -1411,3 +1587,370 @@ def flash_attention_or_none(query, key, value, attn_mask, is_causal,
     return _flash_op(query, key, value, rng_arr, causal=bool(is_causal),
                      interpret=interpret, dropout_p=float(dropout_p),
                      block_q=int(block_q), block_k=int(block_k))
+
+
+# ---------------------------------------------------------------------------
+# Fused paged-decode attention (the serving megakernel)
+#
+# One Pallas program family per decode step over grid (slot, head, k-block):
+# length-masked flash-style attention over the paged KV cache that READS
+# only the live blocks of each slot (the k/v BlockSpec index map clamps the
+# block index to lens[slot]//block_k, so Mosaic's revisiting optimization
+# never fetches the empty tail — per-token HBM traffic scales with live
+# length, not T_max). Folded into the same pass:
+#   * the new-token KV append: the incoming k/v row is substituted into the
+#     fetched append block in-register (and, for int8 caches, quantized
+#     in-kernel with quantize_kv's exact absmax rule) and the block is
+#     written back through the cache outputs — the einsum path's separate
+#     quantize + dynamic_update_slice round trip disappears;
+#   * int8 dequantization: k_scale multiplies the QK scores and v_scale the
+#     softmax probabilities (per-key scalars commute with the row dot
+#     products), so the f32 dequantized cache is never materialised.
+# Output blocks beyond a slot's live region are never written; those cache
+# positions are garbage by contract (exactly like the einsum path's
+# never-written tail) and masked out of every read.
+#
+# Dispatch: paged_decode_attention_or_none (gated like the other kernels —
+# flag, shape legality, Mosaic health incl. a dedicated value-checked probe
+# on TPU, FLAGS_paged_flash_interpret for the CPU emulator). Falls back to
+# models/gpt.py's windowed einsum (pt_attn_path_total{path=xla_paged}).
+# ---------------------------------------------------------------------------
+
+_PAGED_FLASH_HEALTHY = None
+_KV_QUANT_EPS = 1e-8  # quantize_kv's zero-row guard (cache.py)
+
+
+def _paged_block(T):
+    """k-block size for a T_max-deep paged cache: the largest standard
+    block that tiles T exactly (None → shape ineligible, take the einsum
+    fallback). Smaller blocks read less dead tail past lens (reads round
+    up to one block); larger blocks amortize grid steps — 128 matches the
+    flash kernel's default lane-friendly block."""
+    for b in (128, 64, 32, 16, 8):
+        if b <= T and T % b == 0:
+            return b
+    return None
+
+
+def _kernel_quantize_row(x):
+    """In-kernel int8 row quantization — MUST mirror
+    inference.serving.cache.quantize_kv exactly (same absmax, eps floor,
+    /127.0, round-to-nearest-even) or fused vs einsum engines lose greedy
+    parity. x: [1, d] f32 → ([1, d] int8, [1, 1] f32 scale)."""
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, _KV_QUANT_EPS) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def _paged_core(lens_ref, q_ref, nk_ref, nv_ref, k_ref, v_ref, ks_ref,
+                vs_ref, o_ref, ko_ref, vo_ref, kso_ref, vso_ref, acc_ref,
+                m_ref, l_ref, *, block_k, t_max, sm_scale):
+    """Grid (B, H, T//block_k); this body runs once per k-block of one
+    (slot, head). State (acc/m/l) lives in VMEM scratch across the j steps
+    of a (slot, head) and is reset at j == 0. Steps past the append block
+    (j > jm) do nothing — their k/v fetch was clamped to block jm by the
+    index map, so they cost neither HBM traffic nor compute."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nblk = pl.num_programs(2)
+    ln = lens_ref[b]                          # live length, pre-append
+    cl = jnp.minimum(ln, t_max - 1)           # append row (the einsum path's
+    jm = cl // block_k                        # dynamic_update_slice clamp)
+    quantized = ks_ref is not None
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j <= jm)
+    def _step():
+        d = q_ref.shape[1]
+        # global key positions of this block; the append column/row masks
+        # are exact because cl lands in block jm and nowhere else
+        pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)                       # [1, bk]
+        app_lane = pos == cl
+        row_sel = jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, d), 0) == (cl - j * block_k)  # [bk, d]
+        if quantized:
+            nkq, nks = _kernel_quantize_row(
+                nk_ref[...].astype(jnp.float32))
+            nvq, nvs = _kernel_quantize_row(
+                nv_ref[...].astype(jnp.float32))
+            kq = jnp.where(row_sel, jax.lax.broadcast_in_dim(
+                nkq, row_sel.shape, (0, 1)), k_ref[...])
+            vq = jnp.where(row_sel, jax.lax.broadcast_in_dim(
+                nvq, row_sel.shape, (0, 1)), v_ref[...])
+            ks = jnp.where(app_lane, nks, ks_ref[...])         # [1, bk]
+            vs = jnp.where(app_lane, nvs, vs_ref[...])
+            ko_ref[...] = kq
+            vo_ref[...] = vq
+            kso_ref[...] = ks
+            vso_ref[...] = vs
+        else:
+            kq = jnp.where(row_sel, jax.lax.broadcast_in_dim(
+                nk_ref[...].astype(ko_ref.dtype), row_sel.shape, (0, 1)),
+                k_ref[...])
+            vq = jnp.where(row_sel, jax.lax.broadcast_in_dim(
+                nv_ref[...].astype(vo_ref.dtype), row_sel.shape, (0, 1)),
+                v_ref[...])
+            ko_ref[...] = kq
+            vo_ref[...] = vq
+            ks = vs = None
+        q = q_ref[...].astype(jnp.float32) * sm_scale          # [1, d]
+        s = jax.lax.dot_general(q, kq.astype(jnp.float32),
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if quantized:
+            s = s * ks     # per-key k_scale commutes with the D-dot
+        s = jnp.where(pos <= ln, s, _NEG_INF)
+        m_prev = jnp.max(m_ref[...], axis=1, keepdims=True)    # [1, 1]
+        l_prev = jnp.max(l_ref[...], axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                                 # [1, bk]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pd = p * vs if quantized else p  # fold v_scale into the probs
+        # The append block's rows past ln are uninitialized cache (this
+        # kernel never writes the dead tail) — a NaN row there would
+        # poison the PV dot through 0*NaN, so hard-select both factors
+        # to zero rather than relying on p == 0.
+        pd = jnp.where(pos <= ln, pd, 0.0)
+        vrow = (j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, d), 0)) <= ln
+        vf = jnp.where(vrow, vq.astype(jnp.float32), 0.0)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            pd, vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jax.lax.broadcast_in_dim(m_new, m_ref.shape, (0, 1))
+        l_ref[...] = jax.lax.broadcast_in_dim(l_new, l_ref.shape, (0, 1))
+
+    @pl.when(j == nblk - 1)
+    def _finish():
+        ell = jnp.max(l_ref[...], axis=1, keepdims=True)
+        # l > 0 always: the appended token (pos == cl <= ln) is live
+        o_ref[...] = (acc_ref[...] / ell).astype(o_ref.dtype)
+
+
+def _paged_f_kernel(lens_ref, q_ref, nk_ref, nv_ref, k_ref, v_ref, o_ref,
+                    ko_ref, vo_ref, acc_ref, m_ref, l_ref, *, block_k,
+                    t_max, sm_scale):
+    _paged_core(lens_ref, q_ref, nk_ref, nv_ref, k_ref, v_ref, None, None,
+                o_ref, ko_ref, vo_ref, None, None, acc_ref, m_ref, l_ref,
+                block_k=block_k, t_max=t_max, sm_scale=sm_scale)
+
+
+def _paged_q_kernel(lens_ref, q_ref, nk_ref, nv_ref, k_ref, v_ref, ks_ref,
+                    vs_ref, o_ref, ko_ref, vo_ref, kso_ref, vso_ref,
+                    acc_ref, m_ref, l_ref, *, block_k, t_max, sm_scale):
+    _paged_core(lens_ref, q_ref, nk_ref, nv_ref, k_ref, v_ref, ks_ref,
+                vs_ref, o_ref, ko_ref, vo_ref, kso_ref, vso_ref, acc_ref,
+                m_ref, l_ref, block_k=block_k, t_max=t_max,
+                sm_scale=sm_scale)
+
+
+def _paged_decode(q, k_cache, v_cache, lens, new_k, new_v, k_scale,
+                  v_scale, *, block_k, interpret):
+    """Run the megakernel. q/new_k/new_v: [B, H, 1, D]; caches
+    [B, H, T, D] (+f32 scales [B, H, T] when int8). Returns
+    (out, k_cache', v_cache', k_scale'|None, v_scale'|None)."""
+    B, H, _, D = q.shape
+    T = k_cache.shape[2]
+    quantized = k_scale is not None
+    sm_scale = float(D) ** -0.5
+
+    def kv_map(b, h, j, lens):
+        jm = jnp.minimum(lens[b], T - 1) // block_k
+        return (b, h, jnp.minimum(j, jm), _I0)
+
+    def sc_map(b, h, j, lens):
+        jm = jnp.minimum(lens[b], T - 1) // block_k
+        return (b, h, jnp.minimum(j, jm))
+
+    def tok_map(b, h, j, lens):
+        return (b, h, _I0, _I0)
+
+    kv_spec = pl.BlockSpec((None, None, block_k, D), kv_map)
+    sc_spec = pl.BlockSpec((None, 1, block_k), sc_map)
+    tok_spec = pl.BlockSpec((None, None, 1, D), tok_map)
+    in_specs = [tok_spec, tok_spec, tok_spec, kv_spec, kv_spec]
+    out_specs = [tok_spec, kv_spec, kv_spec]
+    out_shape = [jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+                 jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                 jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)]
+    operands = [q, new_k, new_v, k_cache, v_cache]
+    if quantized:
+        in_specs += [sc_spec, sc_spec]
+        out_specs += [sc_spec, sc_spec]
+        out_shape += [jax.ShapeDtypeStruct(k_scale.shape, jnp.float32),
+                      jax.ShapeDtypeStruct(v_scale.shape, jnp.float32)]
+        operands += [k_scale, v_scale]
+        kernel = _paged_q_kernel
+    else:
+        kernel = _paged_f_kernel
+    kern = functools.partial(kernel, block_k=block_k, t_max=T,
+                             sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, H, T // block_k),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[pltpu.VMEM((1, D), jnp.float32),
+                        pltpu.VMEM((1, _LANES), jnp.float32),
+                        pltpu.VMEM((1, _LANES), jnp.float32)])
+    outs = _pallas_call(kern, grid_spec=grid_spec, out_shape=out_shape,
+                        interpret=interpret)(
+        lens.astype(jnp.int32), *operands)
+    if quantized:
+        out, ko, vo, kso, vso = outs
+        return out, ko, vo, kso, vso
+    out, ko, vo = outs
+    return out, ko, vo, None, None
+
+
+def _paged_probe_exec():
+    """Run the float megakernel on TPU at a small-but-representative shape
+    (multi-block, ragged lens incl. an idle slot) and value-check output
+    AND the written cache region against the einsum oracle. Returns
+    (ok, detail). Split out so tests can inject failures."""
+    B, H, T, D = 2, 2, 256, 64
+    blk = _paged_block(T)
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, 1, D), jnp.float32)
+    nk = jnp.asarray(rs.randn(B, H, 1, D), jnp.float32)
+    nv = jnp.asarray(rs.randn(B, H, 1, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+    lens = jnp.asarray([0, 130], jnp.int32)
+
+    def run(q):
+        out, ko, vo, _, _ = _paged_decode(
+            q, k, v, lens, nk, nv, None, None, block_k=blk,
+            interpret=False)
+        return out, ko, vo
+
+    # same ambient-trace dance as _probe_exec: a plain jit under a clean
+    # EvalTrace, ensure_compile_time_eval ONLY when probed mid-trace —
+    # wrapping jit in ensure_compile_time_eval breaks pallas kernel
+    # tracing (program_id binds against the ambient eval trace)
+    try:
+        from jax.core import trace_ctx
+        clean = type(trace_ctx.trace).__name__ == "EvalTrace"
+    except Exception:
+        clean = False
+    if clean:
+        out, ko, vo = jax.jit(run)(q)
+    else:
+        with jax.ensure_compile_time_eval():
+            out, ko, vo = run(q)
+
+    def wr(buf, new, ln):
+        z = jnp.int32(0)
+        return jax.lax.dynamic_update_slice(buf, new, (z, ln, z))
+
+    kb = jax.vmap(wr)(k, nk, lens)
+    vb = jax.vmap(wr)(v, nv, lens)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kb) * (float(D) ** -0.5)
+    valid = (jnp.arange(T)[None, None, None, :]
+             <= lens[:, None, None, None])
+    s = jnp.where(valid, s, jnp.float32(_NEG_INF))
+    want = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vb)
+    out_ok = np.allclose(np.asarray(out), np.asarray(want), rtol=2e-3,
+                         atol=2e-3)
+    # cache check restricted to live+append positions: the tail past lens
+    # is garbage by contract (never written, never read unmasked) — select
+    # rather than multiply so a NaN tail cannot leak into the comparison
+    live = np.asarray(valid)[:, :1, 0, :, None]                # [B,1,T,1]
+
+    def _live_eq(got, want):
+        return bool(np.allclose(np.where(live, np.asarray(got), 0.0),
+                                np.where(live, np.asarray(want), 0.0)))
+
+    cache_ok = _live_eq(ko, kb) and _live_eq(vo, vb)
+    if out_ok and cache_ok:
+        return True, ""
+    err = float(np.nanmax(np.abs(np.asarray(out, np.float64)
+                                 - np.asarray(want, np.float64))))
+    return False, ("value check failed vs einsum oracle (out ok=%s "
+                   "cache ok=%s max|out-want|=%.3e)"
+                   % (out_ok, cache_ok, err))
+
+
+def paged_flash_healthy():
+    """True iff the paged-decode megakernel compiles and matches the
+    einsum oracle on this TPU backend (probed once; cached). Failures
+    journal `pallas_probe_failed` {tier=paged} and count in
+    pt_pallas_probe_failures_total, and the serving decode falls back to
+    the windowed einsum (path counter xla_paged) — the engine keeps
+    serving either way. Env override: PADDLE_TPU_PAGED_FLASH_HEALTH=0|1.
+    Only meaningful on TPU (interpret mode never touches Mosaic)."""
+    global _PAGED_FLASH_HEALTHY
+    if _PAGED_FLASH_HEALTHY is not None:
+        return _PAGED_FLASH_HEALTHY
+    if not pallas_tpu_healthy():
+        _PAGED_FLASH_HEALTHY = False
+        return False
+    import os
+    env = os.environ.get("PADDLE_TPU_PAGED_FLASH_HEALTH", "")
+    if env in ("0", "1"):
+        _PAGED_FLASH_HEALTHY = env == "1"
+        if not _PAGED_FLASH_HEALTHY:
+            _note_probe_failure(
+                "paged", "forced off via PADDLE_TPU_PAGED_FLASH_HEALTH=0",
+                forced=True)
+        return _PAGED_FLASH_HEALTHY
+    try:
+        ok, detail = _paged_probe_exec()
+        _PAGED_FLASH_HEALTHY = bool(ok)
+        if not ok:
+            _note_probe_failure(
+                "paged", detail + " — paged decode falls back to the "
+                "windowed XLA einsum for this process")
+    except Exception as e:  # MosaicError, RPC/tunnel failures, ...
+        _note_probe_failure(
+            "paged",
+            "%s: %s — paged decode falls back to the windowed XLA einsum "
+            "for this process" % (type(e).__name__, str(e)[:400]))
+        _PAGED_FLASH_HEALTHY = False
+    return _PAGED_FLASH_HEALTHY
+
+
+def paged_decode_attention_or_none(q, k_cache, v_cache, lens, new_k,
+                                   new_v, k_scale=None, v_scale=None):
+    """Gate + dispatch for the fused paged-decode attention kernel.
+
+    Arrays only (the Tensor-level caller is models/gpt.py's
+    _paged_decode_attention): q/new_k/new_v [B, H, 1, D], caches
+    [B, H, T, D] (+ scales [B, H, T] for int8), lens [B] int32 = live
+    length per slot BEFORE this token. Returns (out, k_cache', v_cache',
+    k_scale', v_scale') — the updated cache carries the appended token —
+    or None when the caller must take the windowed einsum fallback
+    (flag off, ineligible shape, unhealthy Mosaic, or interpret mode
+    without FLAGS_paged_flash_interpret). Bumps
+    pt_attn_path_total{path=paged_flash} at trace time when it fires."""
+    if not _HAS_PALLAS or pltpu is None:
+        return None
+    if not flag("paged_flash_decode"):
+        return None
+    if q.ndim != 4 or q.shape[2] != 1 or k_cache.ndim != 4:
+        return None
+    B, H, _, D = q.shape
+    T = k_cache.shape[2]
+    blk = _paged_block(T)
+    if blk is None or D % 8 != 0 or D > 256:
+        return None
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    if interpret:
+        if not flag("paged_flash_interpret"):
+            return None
+        if T > 1024 or B * H > 64 or D > 128:
+            return None  # keep the emulator cheap (CPU tests/smoke only)
+    elif not paged_flash_healthy():  # consults the base tier internally
+        return None
+    _note_attn_path("paged_flash")
+    return _paged_decode(q, k_cache, v_cache, lens, new_k, new_v, k_scale,
+                         v_scale, block_k=blk, interpret=interpret)

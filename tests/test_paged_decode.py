@@ -1,0 +1,467 @@
+"""Megakernel tier (ISSUE 15): fused paged-decode attention and the
+decoder-block tail fusion, checked in interpret mode against einsum /
+composed-XLA oracles.
+
+Three layers of evidence:
+
+  * kernel-level — `_paged_decode` vs a numpy oracle that replays the
+    exact serving semantics (append the new token at position lens[b],
+    dequantize the int8 window, attend over pos <= lens[b]), across
+    dtype (f32 / bf16 / int8-cache), ragged lens including idle slots,
+    NaN garbage in the unwritten tail, and the full-slot clamp;
+  * dispatch/engine-level — the gate chain (flag, shape, interpret
+    caps), probe-failure capture (journal event + counter + fallback),
+    the compile-once contract, prefix-hit suffix admission through the
+    fused path, and token parity against the windowed-einsum engine;
+  * block-fusion level — the (y, z) pair primitive and the
+    FLAGS_fused_block decoder-layer wiring vs the unfused model.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.framework.flags import get_flags, set_flags
+from paddle_tpu.ops import pallas_kernels as pk
+
+jax.config.update("jax_platforms", "cpu")
+
+VOCAB = 64
+
+
+def _quantize_np(x):
+    """quantize_kv's rule in numpy: symmetric absmax int8 per row."""
+    amax = np.abs(x).astype(np.float32).max(-1)
+    scale = np.maximum(amax, 1e-8) / np.float32(127.0)
+    q = np.clip(np.round(x.astype(np.float32) / scale[..., None]),
+                -127.0, 127.0).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def _oracle(q, kc, vc, lens, nk, nv, ks=None, vs=None):
+    """Numpy replay of the megakernel contract. Returns
+    (out, kc', vc', ks', vs') with the new token appended at lens[b]."""
+    q = np.asarray(q, np.float32)
+    B, H, _, D = q.shape
+    kc, vc = np.array(kc), np.array(vc)
+    quant = ks is not None
+    if quant:
+        ks, vs = np.array(ks), np.array(vs)
+        nkq, nks = _quantize_np(np.asarray(nk))
+        nvq, nvs = _quantize_np(np.asarray(nv))
+    out = np.zeros((B, H, 1, D), np.float32)
+    for b in range(B):
+        ln = int(lens[b])
+        if quant:
+            kc[b, :, ln] = nkq[b, :, 0]
+            vc[b, :, ln] = nvq[b, :, 0]
+            ks[b, :, ln] = nks[b, :, 0]
+            vs[b, :, ln] = nvs[b, :, 0]
+            kw = kc[b, :, :ln + 1].astype(np.float32) \
+                * ks[b, :, :ln + 1, None]
+            vw = vc[b, :, :ln + 1].astype(np.float32) \
+                * vs[b, :, :ln + 1, None]
+        else:
+            kc[b, :, ln] = np.asarray(nk)[b, :, 0].astype(kc.dtype)
+            vc[b, :, ln] = np.asarray(nv)[b, :, 0].astype(vc.dtype)
+            kw = kc[b, :, :ln + 1].astype(np.float32)
+            vw = vc[b, :, :ln + 1].astype(np.float32)
+        s = np.einsum("hd,hkd->hk", q[b, :, 0] * D ** -0.5, kw)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        out[b, :, 0] = np.einsum("hk,hkd->hd", p, vw)
+    return out, kc, vc, (ks if quant else None), (vs if quant else None)
+
+
+def _mk(B=2, H=2, T=96, D=16, lens=(5, 40), dtype=jnp.float32,
+        quantized=False, nan_tail=True, seed=0):
+    """Inputs with the cache tail PAST lens left as NaN garbage — the
+    hostile shape the engine actually produces (unwritten pages are
+    uninitialized memory)."""
+    rs = np.random.RandomState(seed)
+    lens = np.asarray(lens, np.int32)
+    q = jnp.asarray(rs.randn(B, H, 1, D), dtype)
+    nk = jnp.asarray(rs.randn(B, H, 1, D), dtype)
+    nv = jnp.asarray(rs.randn(B, H, 1, D), dtype)
+    kf = rs.randn(B, H, T, D)
+    vf = rs.randn(B, H, T, D)
+    if quantized:
+        kc, ks = _quantize_np(kf)
+        vc, vs = _quantize_np(vf)
+        if nan_tail:     # scales past lens are garbage; payload is int8
+            for b in range(B):
+                ks[b, :, lens[b]:] = np.nan
+                vs[b, :, lens[b]:] = np.nan
+        return (q, jnp.asarray(kc), jnp.asarray(vc),
+                jnp.asarray(lens), nk, nv,
+                jnp.asarray(ks), jnp.asarray(vs))
+    if nan_tail:
+        for b in range(B):
+            kf[b, :, lens[b]:] = np.nan
+            vf[b, :, lens[b]:] = np.nan
+    return (q, jnp.asarray(kf, dtype), jnp.asarray(vf, dtype),
+            jnp.asarray(lens), nk, nv, None, None)
+
+
+def _run(args, T=96):
+    blk = pk._paged_block(T)
+    return pk._paged_decode(*args, block_k=blk, interpret=True)
+
+
+def _check(args, atol, T=96):
+    out = _run(args, T=T)
+    ref = _oracle(*args)
+    lens = np.asarray(args[3])
+    np.testing.assert_allclose(np.asarray(out[0], np.float32), ref[0],
+                               atol=atol, rtol=atol)
+    for got, want, name in ((out[1], ref[1], "k"), (out[2], ref[2], "v")):
+        got, want = np.asarray(got), np.asarray(want)
+        for b in range(lens.shape[0]):     # live region incl. the append
+            np.testing.assert_allclose(
+                got[b, :, :lens[b] + 1].astype(np.float32),
+                want[b, :, :lens[b] + 1].astype(np.float32),
+                atol=atol, rtol=atol, err_msg=name)
+    if args[6] is not None:
+        for got, want in ((out[3], ref[3]), (out[4], ref[4])):
+            got, want = np.asarray(got), np.asarray(want)
+            for b in range(lens.shape[0]):
+                np.testing.assert_allclose(got[b, :, :lens[b] + 1],
+                                           want[b, :, :lens[b] + 1],
+                                           atol=2e-7, rtol=2e-5)
+
+
+class TestPagedDecodeKernel:
+    def test_f32_multiblock_vs_oracle(self):
+        _check(_mk(lens=(5, 40)), atol=1e-5)
+
+    def test_bf16_cache(self):
+        _check(_mk(lens=(17, 63), dtype=jnp.bfloat16), atol=2e-2)
+
+    def test_int8_cache_fused_dequant(self):
+        _check(_mk(lens=(5, 40), quantized=True), atol=1e-4)
+
+    def test_ragged_lens_with_idle_slots(self):
+        # idle slot (lens=0) sees ONLY its appended token; garbage in
+        # every other position must not reach the output
+        _check(_mk(B=4, lens=(0, 1, 33, 95)), atol=1e-5)
+
+    def test_int8_idle_and_full_slots(self):
+        _check(_mk(B=4, lens=(0, 2, 64, 95), quantized=True), atol=1e-4)
+
+    def test_full_slot_clamp(self):
+        # lens == T-1: append lands in the last position of the last
+        # block; the clamped index map must not read past the cache
+        _check(_mk(lens=(95, 95)), atol=1e-5)
+
+    def test_sequential_decode_crosses_blocks(self):
+        # grow one slot across a block boundary (32-wide blocks), cache
+        # threaded kernel-to-kernel, vs the oracle at every step
+        T, D = 96, 16
+        args = list(_mk(B=1, H=2, T=T, D=D, lens=(30,)))
+        ref = [np.array(a) if a is not None else None for a in args]
+        rs = np.random.RandomState(9)
+        for step in range(6):
+            out = _run(tuple(args), T=T)
+            want = _oracle(*ref)
+            np.testing.assert_allclose(np.asarray(out[0], np.float32),
+                                       want[0], atol=1e-5, rtol=1e-5)
+            ln = int(np.asarray(args[3])[0]) + 1
+            args[1], args[2] = out[1], out[2]
+            ref[1], ref[2] = want[1], want[2]
+            args[3] = jnp.asarray([ln], jnp.int32)
+            ref[3] = np.asarray([ln], np.int32)
+            nk = rs.randn(1, 2, 1, D)
+            nv = rs.randn(1, 2, 1, D)
+            args[4], args[5] = jnp.asarray(nk, jnp.float32), \
+                jnp.asarray(nv, jnp.float32)
+            ref[4], ref[5] = nk, nv
+
+    def test_paged_block_chooser(self):
+        assert pk._paged_block(2048) == 128
+        assert pk._paged_block(96) == 32
+        assert pk._paged_block(64) == 64
+        assert pk._paged_block(7) is None
+
+
+class TestDispatchGate:
+    @pytest.fixture
+    def interp_on(self):
+        saved = get_flags(["paged_flash_decode", "paged_flash_interpret"])
+        set_flags({"paged_flash_decode": True,
+                   "paged_flash_interpret": True})
+        yield
+        set_flags(saved)
+
+    def test_interpret_dispatch_fires(self, interp_on):
+        q, kc, vc, lens, nk, nv, _, _ = _mk(nan_tail=False)
+        before = pk.attention_path_counts()["paged_flash"]
+        out = pk.paged_decode_attention_or_none(q, kc, vc, lens, nk, nv)
+        assert out is not None
+        assert pk.attention_path_counts()["paged_flash"] == before + 1
+
+    def test_flag_off_returns_none(self, interp_on):
+        set_flags({"paged_flash_decode": False})
+        q, kc, vc, lens, nk, nv, _, _ = _mk(nan_tail=False)
+        assert pk.paged_decode_attention_or_none(
+            q, kc, vc, lens, nk, nv) is None
+
+    def test_interpret_caps_reject_big_shapes(self, interp_on):
+        q, kc, vc, lens, nk, nv, _, _ = _mk(B=16, H=8, T=64, D=16,
+                                            lens=(1,) * 16,
+                                            nan_tail=False)
+        assert pk.paged_decode_attention_or_none(
+            q, kc, vc, lens, nk, nv) is None     # B*H = 128 > 64
+
+    def test_odd_head_dim_rejected(self, interp_on):
+        q, kc, vc, lens, nk, nv, _, _ = _mk(D=12, nan_tail=False)
+        assert pk.paged_decode_attention_or_none(
+            q, kc, vc, lens, nk, nv) is None     # D % 8 != 0
+
+
+class TestProbeFailure:
+    def _fail_counter(self):
+        from paddle_tpu.observability import metrics
+        c = metrics.counter("pt_pallas_probe_failures_total",
+                            "Pallas Mosaic health-probe failures, by tier",
+                            labelnames=("tier",))
+        return sum(int(ch.value) for labels, ch in c._series()
+                   if labels.get("tier") == "paged")
+
+    def test_probe_exception_journals_and_counts(self, monkeypatch):
+        from paddle_tpu.observability import journal
+        events = []
+        monkeypatch.setattr(
+            journal, "emit",
+            lambda event, **kw: events.append((event, kw)) or True)
+        monkeypatch.setattr(pk, "_PROBE_FAILURES", {})
+        monkeypatch.setattr(pk, "_PAGED_FLASH_HEALTHY", None)
+        monkeypatch.setattr(pk, "_PALLAS_TPU_HEALTHY", True)
+
+        def boom():
+            raise RuntimeError("mosaic lowering exploded")
+        monkeypatch.setattr(pk, "_paged_probe_exec", boom)
+        before = self._fail_counter()
+        with pytest.warns(UserWarning, match="paged-decode probe failed"):
+            assert pk.paged_flash_healthy() is False
+        assert pk.paged_flash_healthy() is False        # cached verdict
+        assert self._fail_counter() == before + 1       # counted ONCE
+        assert [e for e, _ in events] == ["pallas_probe_failed"]
+        assert events[0][1]["tier"] == "paged"
+        assert "mosaic lowering exploded" in events[0][1]["reason"]
+        assert "paged" in pk.pallas_health_reasons()
+
+    def test_value_mismatch_journals(self, monkeypatch):
+        from paddle_tpu.observability import journal
+        events = []
+        monkeypatch.setattr(
+            journal, "emit",
+            lambda event, **kw: events.append((event, kw)) or True)
+        monkeypatch.setattr(pk, "_PROBE_FAILURES", {})
+        monkeypatch.setattr(pk, "_PAGED_FLASH_HEALTHY", None)
+        monkeypatch.setattr(pk, "_PALLAS_TPU_HEALTHY", True)
+        monkeypatch.setattr(pk, "_paged_probe_exec",
+                            lambda: (False, "max err 0.5 vs oracle"))
+        with pytest.warns(UserWarning, match="paged-decode probe failed"):
+            assert pk.paged_flash_healthy() is False
+        assert events and events[0][1]["tier"] == "paged"
+
+    def test_env_force_off(self, monkeypatch):
+        monkeypatch.setattr(pk, "_PROBE_FAILURES", {})
+        monkeypatch.setattr(pk, "_PAGED_FLASH_HEALTHY", None)
+        monkeypatch.setattr(pk, "_PALLAS_TPU_HEALTHY", True)
+        monkeypatch.setenv("PADDLE_TPU_PAGED_FLASH_HEALTH", "0")
+        monkeypatch.setattr(
+            pk, "_paged_probe_exec",
+            lambda: pytest.fail("env override must skip the probe"))
+        with pytest.warns(UserWarning, match="paged-decode probe failed"):
+            assert pk.paged_flash_healthy() is False
+        assert "paged" in pk.pallas_health_reasons()
+
+    def test_probe_passes_on_cpu_interpret(self, monkeypatch):
+        # the probe body itself (kernel + value check) passes when its
+        # pallas_call is emulated — this is the oracle the TPU probe
+        # compiles for real (interpret=False is probe-only, so force it)
+        real = pk._paged_decode
+        monkeypatch.setattr(
+            pk, "_paged_decode",
+            lambda *a, **kw: real(*a, **{**kw, "interpret": True}))
+        ok, detail = pk._paged_probe_exec()
+        assert ok, detail
+
+
+def _tiny(**kw):
+    from paddle_tpu.models import gpt_tiny
+    m = gpt_tiny(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                 num_heads=4, intermediate_size=64,
+                 max_position_embeddings=64, **kw)
+    m.eval()
+    return m
+
+
+class TestEngineFusedPath:
+    @pytest.fixture
+    def interp_on(self):
+        saved = get_flags(["paged_flash_decode", "paged_flash_interpret"])
+        set_flags({"paged_flash_decode": True,
+                   "paged_flash_interpret": True})
+        yield
+        set_flags(saved)
+
+    def _greedy(self, model, kv_dtype, steps=20):
+        from paddle_tpu.inference.serving import GenerationEngine
+        eng = GenerationEngine(model, max_batch=2, max_seq_len=32,
+                               prefill_buckets=(8,), kv_dtype=kv_dtype)
+        rs = np.random.RandomState(4)
+        toks = [[int(eng.prefill(s, rs.randint(1, VOCAB, (5,)).tolist()))]
+                for s in range(2)]
+        for _ in range(steps - 1):
+            out = eng.decode()
+            for s in range(2):
+                toks[s].append(int(out[s]))
+        return toks, eng
+
+    @pytest.mark.parametrize("kv_dtype", ["float32", "int8"])
+    def test_parity_and_compile_once(self, interp_on, kv_dtype):
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        model = _tiny()
+        before = pk.attention_path_counts()
+        fused_toks, fused_eng = self._greedy(model, kv_dtype)
+        after = pk.attention_path_counts()
+        assert after["paged_flash"] > before["paged_flash"]
+        assert after["xla_paged"] == before["xla_paged"]
+        assert fused_eng.decode_compiles == 1
+
+        set_flags({"paged_flash_decode": False})
+        plain_toks, plain_eng = self._greedy(model, kv_dtype)
+        assert pk.attention_path_counts()["paged_flash"] == \
+            after["paged_flash"]
+        assert plain_eng.decode_compiles == 1
+        assert fused_toks == plain_toks
+
+    def test_prefix_hit_suffix_admission(self, interp_on):
+        # a prefix-cache HIT admits via the suffix-prefill path; the
+        # following decode steps must still ride the fused kernel and
+        # match the unfused engine token-for-token
+        import paddle_tpu as paddle
+        from paddle_tpu.inference.serving import (ContinuousBatcher,
+                                                  GenerationEngine,
+                                                  Request)
+        paddle.seed(0)
+        model = _tiny()
+        rs = np.random.RandomState(8)
+        head = rs.randint(1, VOCAB, (16,))
+        reqs = [np.concatenate([head, rs.randint(1, VOCAB, (3,))]),
+                np.concatenate([head, rs.randint(1, VOCAB, (4,))])]
+
+        def serve():
+            eng = GenerationEngine(model, max_batch=2, max_seq_len=32,
+                                   prefill_buckets=(8, 16, 24),
+                                   prefix_cache_bytes=16 << 20)
+            b = ContinuousBatcher(eng)
+            out = []
+            for p in reqs:
+                r = Request(prompt=p.copy(), max_new_tokens=5)
+                b.submit(r)
+                b.run_until_idle()
+                out.append((list(r.tokens), r.prefix_len))
+            return out, eng
+
+        before = pk.attention_path_counts()
+        fused, feng = serve()
+        after = pk.attention_path_counts()
+        assert after["paged_flash"] > before["paged_flash"]
+        assert after["xla_paged"] == before["xla_paged"]
+        assert fused[1][1] > 0          # second request was a prefix HIT
+        assert feng.decode_compiles == 1
+
+        set_flags({"paged_flash_decode": False})
+        plain, _ = serve()
+        assert [t for t, _ in fused] == [t for t, _ in plain]
+
+    def test_cpu_default_takes_einsum_fallback(self):
+        # without FLAGS_paged_flash_interpret the CPU engine must land
+        # on the windowed-einsum path counter, never the kernel
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        before = pk.attention_path_counts()
+        toks, eng = self._greedy(_tiny(), "float32", steps=4)
+        after = pk.attention_path_counts()
+        assert after["xla_paged"] > before["xla_paged"]
+        assert after["paged_flash"] == before["paged_flash"]
+        assert eng.decode_compiles == 1
+
+
+class TestFusedBlock:
+    def test_pair_api_parity_and_grads(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.incubate.nn.functional as IF
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        B, T, E = 2, 8, 64
+        x = paddle.randn([B, T, E])
+        res = paddle.randn([B, T, E])
+        gamma = paddle.ones([E])
+        beta = paddle.zeros([E])
+        for t in (x, res, gamma, beta):
+            t.stop_gradient = False
+        y, z = IF.fused_bias_dropout_residual_ln_pair(
+            x, res, None, gamma, beta, 0.0, 1e-5, True)
+        zr = res + x
+        yr = F.layer_norm(zr, (E,), gamma, beta, 1e-5)
+        np.testing.assert_allclose(z.numpy(), zr.numpy(), atol=1e-6,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(y.numpy(), yr.numpy(), atol=1e-5,
+                                   rtol=1e-5)
+        (y.sum() + z.sum()).backward()
+        gx = x.grad.numpy().copy()
+        for t in (x, res, gamma, beta):
+            t.clear_gradient()
+        (yr.sum() + zr.sum()).backward()
+        np.testing.assert_allclose(gx, x.grad.numpy(), atol=1e-4,
+                                   rtol=1e-4)
+
+    @pytest.fixture
+    def fused_block(self):
+        saved = get_flags("fused_block")
+        set_flags({"fused_block": True})
+        yield
+        set_flags(saved)
+
+    def test_decoder_layer_eval_parity(self, fused_block):
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        model = _tiny()
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, VOCAB, (2, 12)))
+        set_flags({"fused_block": False})
+        ref = model(ids).numpy()
+        set_flags({"fused_block": True})
+        out = model(ids).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_decoder_layer_train_grads(self, fused_block):
+        # p=0 dropouts make fused and unfused training steps comparable
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        model = _tiny(attn_dropout_prob=0.0, hidden_dropout_prob=0.0)
+        model.train()
+        ids = paddle.to_tensor(
+            np.random.RandomState(3).randint(0, VOCAB, (2, 12)))
+
+        def grads():
+            model.clear_gradients()
+            loss = (model(ids) ** 2).mean()
+            loss.backward()
+            return {n: p.grad.numpy().copy()
+                    for n, p in model.named_parameters()
+                    if p.grad is not None}
+
+        set_flags({"fused_block": False})
+        ref = grads()
+        set_flags({"fused_block": True})
+        got = grads()
+        assert set(got) == set(ref) and got
+        for n in ref:
+            np.testing.assert_allclose(got[n], ref[n], atol=2e-5,
+                                       rtol=2e-4, err_msg=n)

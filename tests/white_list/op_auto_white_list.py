@@ -65,6 +65,7 @@ WHITE_LIST = {
     "uniform_random": "rng",
     "scaled_dot_product_attention": "rng (dropout key); flash/sdpa parity in test_rnn_transformer + test_pallas_fused",
     "fused_bias_dropout_residual_layer_norm": "rng; dedicated coverage in test_pallas_fused",
+    "fused_bias_dropout_residual_ln_pair": "rng; tuple output; dedicated coverage in test_paged_decode",
     "fused_bias_dropout_residual": "rng; dedicated coverage in test_pallas_fused + transformer tests",
     "rnn": "rng (dropout key) + list weights; parity in test_rnn_transformer",
     # dynamic shapes

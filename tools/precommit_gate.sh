@@ -650,6 +650,73 @@ EOF
     fi
 fi
 
+# Megakernel smoke (docs/PERFORMANCE.md "Megakernels"): staggered
+# serving requests with the fused paged-decode kernel forced on in
+# interpret mode must (a) trace the paged_flash path and NEVER fall
+# back to the windowed einsum (xla_paged == 0), (b) keep the
+# decode-compiles-exactly-once contract, and (c) produce token-for-token
+# greedy parity against a second engine with the kernel disabled.
+if [ "$rc" -eq 0 ]; then
+    timeout -k 10 240 env JAX_PLATFORMS=cpu FLAGS_paged_flash_interpret=1 \
+        python - <<'EOF'
+import time
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.inference.serving import InferenceServer
+from paddle_tpu.models import gpt_tiny
+from paddle_tpu.ops.pallas_kernels import attention_path_counts
+
+paddle.seed(0)
+m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+             intermediate_size=64, max_position_embeddings=64)
+m.eval()
+rs = np.random.RandomState(3)
+prompts = [rs.randint(1, 64, (n,)) for n in (3, 6, 9, 12)]
+
+
+def serve():
+    toks = []
+    with InferenceServer(m, max_batch=4, max_seq_len=64,
+                         prefill_buckets=(8, 16),
+                         kv_dtype="int8") as srv:
+        handles = []
+        for p in prompts:      # staggered -> mid-flight slot admission
+            handles.append(srv.submit(p.copy(), max_new_tokens=6))
+            time.sleep(0.02)
+        toks = [list(h.result(timeout=120)) for h in handles]
+        compiles = srv.engines[0].decode_compiles
+    return toks, compiles
+
+
+before = attention_path_counts()
+fused_toks, fused_compiles = serve()
+after = attention_path_counts()
+paged = after["paged_flash"] - before["paged_flash"]
+fell_back = after["xla_paged"] - before["xla_paged"]
+assert paged > 0, after
+assert fell_back == 0, after
+assert fused_compiles == 1, fused_compiles
+
+set_flags({"paged_flash_decode": False})   # force the einsum fallback
+plain_toks, plain_compiles = serve()
+after2 = attention_path_counts()
+assert after2["paged_flash"] == after["paged_flash"], after2
+assert plain_compiles == 1, plain_compiles
+assert fused_toks == plain_toks, (fused_toks, plain_toks)
+print("MEGAKERNEL_SMOKE=ok (4 staggered requests: %d paged_flash traces, "
+      "0 einsum fallbacks, decode compiled once, %d/%d greedy tokens "
+      "match the unfused engine)"
+      % (paged, sum(len(t) for t in fused_toks),
+         sum(len(t) for t in fused_toks)))
+EOF
+    smoke_rc=$?
+    if [ "$smoke_rc" -ne 0 ]; then
+        echo "MEGAKERNEL_SMOKE=FAILED (rc=$smoke_rc)"
+        rc=$smoke_rc
+    fi
+fi
+
 # HTTP smoke (docs/OBSERVABILITY.md "Live endpoints & trace viewing"):
 # a 2-step fit with PADDLE_TPU_HTTP_PORT=0 must publish its ephemeral
 # endpoint through endpoint-rank0.json, answer a valid Prometheus

@@ -734,6 +734,14 @@ def cmd_roofline(agg, directory) -> int:
               "intensity=%.2f flop/byte" % (
                   _fmt_qty(flops), _fmt_qty(hbm), _fmt_qty(col_bytes),
                   flops / hbm if hbm else float("inf")))
+        fused = float(card.get("hbm_bytes_fused") or 0)
+        if fused and hbm and fused < hbm:
+            print("  fusion headroom: %s of %s HBM bytes (%.1f%%) are "
+                  "elementwise chain round-trips a fused kernel removes "
+                  "-> fused intensity %.2f flop/byte" % (
+                      _fmt_qty(hbm - fused), _fmt_qty(hbm),
+                      100.0 * (hbm - fused) / hbm,
+                      flops / fused if fused else float("inf")))
         print("  measured: step=%.3f ms (n=%d)  feed+host share=%.1f%% "
               "of non-compile step time" % (step_ms, len(steps),
                                             100.0 * hostfeed_share))
@@ -813,6 +821,15 @@ def cmd_trace(directory, out=None) -> int:
     return 0
 
 
+def _fused_kernel_row(r):
+    """Trend row for a fused_kernels_bench result: value column is the
+    speedup-vs-XLA ratio; pallas_ms/speedup get regression flags."""
+    return {"config": r["config"], "value": r.get("speedup"),
+            "unit": "x vs xla",
+            "pallas_ms": r.get("pallas_ms"),
+            "speedup": r.get("speedup")}
+
+
 def _bench_rows(directory):
     """((sort_key, label, rows), ...) per BENCH_*.json file, oldest
     first. Each row: {config, value, unit, step_ms, mfu, compile_s,
@@ -837,14 +854,18 @@ def _bench_rows(directory):
         rows = []
         if "results" in data:                   # tools/bench.py --save shape
             for r in data.get("results") or []:
-                if isinstance(r, dict) and r.get("config"):
-                    rows.append({"config": r["config"],
-                                 "value": r.get("throughput"),
-                                 "unit": r.get("unit"),
-                                 "step_ms": r.get("step_ms"),
-                                 "mfu": r.get("mfu"),
-                                 "compile_s": r.get("compile_s"),
-                                 "hbm_peak": r.get("hbm_peak")})
+                if not isinstance(r, dict) or not r.get("config"):
+                    continue
+                if "pallas_ms" in r:            # fused_kernels_bench row
+                    rows.append(_fused_kernel_row(r))
+                    continue
+                rows.append({"config": r["config"],
+                             "value": r.get("throughput"),
+                             "unit": r.get("unit"),
+                             "step_ms": r.get("step_ms"),
+                             "mfu": r.get("mfu"),
+                             "compile_s": r.get("compile_s"),
+                             "hbm_peak": r.get("hbm_peak")})
             # serving rows (inference_bench.py via the TPU window) trend
             # alongside training: throughput column = tokens_per_s, and
             # ttft p95 gets its own column + regression flag
@@ -872,6 +893,12 @@ def _bench_rows(directory):
                          "mfu": parsed.get("mfu"),
                          "compile_s": parsed.get("compile_s"),
                          "hbm_peak": parsed.get("hbm_peak")})
+            # fused_kernels_bench headline carries its per-kernel rows
+            # inline; trend each kernel as its own config block
+            for r in parsed.get("results") or []:
+                if isinstance(r, dict) and r.get("config") \
+                        and "pallas_ms" in r:
+                    rows.append(_fused_kernel_row(r))
         out.append((key, base, rows))
     out.sort(key=lambda e: e[0])
     return out
@@ -884,7 +911,8 @@ def cmd_bench(directory) -> int:
     reset the bar). Flags: step_ms >110% of best, MFU <90% of best,
     compile_s >110% of best, hbm_peak >110% of best; serving rows
     (inference_bench) flag tokens_per_s <90% of best and ttft_ms_p95
-    >110% of best."""
+    >110% of best; fused-kernel rows (fused_kernels_bench) flag
+    pallas_ms >110% of best and speedup <90% of best."""
     files = _bench_rows(directory)
     if not files:
         print("ptdoctor: no BENCH_*.json under %s" % directory)
@@ -909,7 +937,9 @@ def cmd_bench(directory) -> int:
                                             ("compile_s", True, 1.10),
                                             ("hbm_peak", True, 1.10),
                                             ("tokens_per_s", False, 0.90),
-                                            ("ttft_ms_p95", True, 1.10)):
+                                            ("ttft_ms_p95", True, 1.10),
+                                            ("pallas_ms", True, 1.10),
+                                            ("speedup", False, 0.90)):
                 v = row.get(metric)
                 if not isinstance(v, (int, float)):
                     continue
