@@ -329,6 +329,35 @@ def serving_gates(row):
             isinstance(row.get("einsum_decode_tps"), (int, float)):
         gates["fused_decode_tps_ge_einsum"] = \
             row["fused_decode_tps"] >= row["einsum_decode_tps"]
+    # SLO overload gates (ISSUE 17), keyed on the gpt2_overload row's
+    # fields: at 3x offered load the admission-controlled engine must
+    # keep goodput >= 90% of measured capacity while the p99 TTFT of
+    # ADMITTED requests holds the budget; the shedding-disabled arm
+    # must demonstrably collapse (p99 past the budget, TTFT growing
+    # with the queue); and the chaos-drilled brownout arm proves
+    # shed-never-crash (zero crash bundles, every request resolved).
+    if isinstance(row.get("overload_goodput_ratio"), (int, float)):
+        gates["overload_goodput_ge_0.9x_capacity"] = \
+            row["overload_goodput_ratio"] >= 0.9
+    if isinstance(row.get("overload_admitted_p99_ms"), (int, float)) and \
+            isinstance(row.get("slo_budget_ms"), (int, float)):
+        gates["overload_admitted_p99_le_budget"] = \
+            row["overload_admitted_p99_ms"] <= row["slo_budget_ms"]
+    if isinstance(row.get("noshed_ttft_p99_ms"), (int, float)) and \
+            isinstance(row.get("slo_budget_ms"), (int, float)):
+        collapse = row["noshed_ttft_p99_ms"] > row["slo_budget_ms"]
+        if isinstance(row.get("noshed_growth_x"), (int, float)):
+            collapse = collapse and row["noshed_growth_x"] > 1.0
+        gates["noshed_collapses"] = collapse
+    if isinstance(row.get("overload_shed"), (int, float)):
+        gates["overload_sheds_fired"] = row["overload_shed"] >= 1
+    if isinstance(row.get("crash_bundles"), (int, float)):
+        gates["overload_zero_crash_bundles"] = row["crash_bundles"] == 0
+    if isinstance(row.get("brownout_shed"), (int, float)):
+        gates["brownout_shed_never_crash"] = \
+            row["brownout_shed"] >= 1 and \
+            bool(row.get("brownout_all_resolved")) and \
+            row.get("crash_bundles") == 0
     if len(gates) < 3 or not all(gates.values()):
         _emit_bench_event(
             "bench_gate_failed", config=row.get("config"), gates=gates,
@@ -339,7 +368,12 @@ def serving_gates(row):
             int8_parity_tokens=row.get("int8_parity_tokens"),
             int8_nbytes_ratio=row.get("int8_nbytes_ratio"),
             fused_decode_tps=row.get("fused_decode_tps"),
-            einsum_decode_tps=row.get("einsum_decode_tps"))
+            einsum_decode_tps=row.get("einsum_decode_tps"),
+            overload_goodput_ratio=row.get("overload_goodput_ratio"),
+            overload_admitted_p99_ms=row.get("overload_admitted_p99_ms"),
+            slo_budget_ms=row.get("slo_budget_ms"),
+            noshed_ttft_p99_ms=row.get("noshed_ttft_p99_ms"),
+            crash_bundles=row.get("crash_bundles"))
     return gates
 
 

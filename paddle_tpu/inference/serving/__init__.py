@@ -17,7 +17,11 @@ from .cache import LayerCacheView, PagedKVCache, bucket_for
 from .engine import GenerationEngine
 from .scheduler import ContinuousBatcher, Request, run_open_loop
 from .server import InferenceServer, ServeHandle
+from .slo import (AdmissionController, ShedError, SLOPolicy,
+                  VirtualClock, WindowedPercentile)
 
 __all__ = ["LayerCacheView", "PagedKVCache", "bucket_for",
            "GenerationEngine", "ContinuousBatcher", "Request",
-           "run_open_loop", "InferenceServer", "ServeHandle"]
+           "run_open_loop", "InferenceServer", "ServeHandle",
+           "SLOPolicy", "AdmissionController", "ShedError",
+           "VirtualClock", "WindowedPercentile"]

@@ -24,6 +24,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...observability import journal, metrics, spans
+from .slo import AdmissionController, ShedError, SLOPolicy
 
 __all__ = ["Request", "ContinuousBatcher", "run_open_loop"]
 
@@ -62,6 +63,8 @@ class Request:
     prefix_len: int = 0                   # cached-prefix tokens reused
     on_complete: Optional[Callable[["Request"], None]] = None
     span: Optional[object] = None         # serve_request spans.begin handle
+    outcome: Optional[str] = None         # completed|shed|deadline_expired
+    error: Optional[BaseException] = None  # ShedError when shed/expired
 
     @property
     def done(self) -> bool:
@@ -80,10 +83,19 @@ class ContinuousBatcher:
     """
 
     def __init__(self, engine, admit_mid_flight: bool = True,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, slo=None):
         self.engine = engine
         self.admit_mid_flight = admit_mid_flight
         self._clock = clock
+        # SLO admission control (ROADMAP item 4): an SLOPolicy (wrapped
+        # in a controller on the batcher's own clock) or a shared
+        # AdmissionController (the threaded server passes one across
+        # all workers). None — the default — keeps submit/step behavior
+        # byte-identical to a policy-free build: unbounded queue, no
+        # deadlines, `serve_shed` never fires.
+        if isinstance(slo, SLOPolicy):
+            slo = AdmissionController(slo, clock=clock)
+        self.slo: Optional[AdmissionController] = slo
         self.waiting: deque = deque()
         self.slots: List[Optional[Request]] = [None] * engine.max_batch
         self.steps = 0
@@ -131,12 +143,36 @@ class ContinuousBatcher:
             # direct-batcher callers get the root span here; the threaded
             # server begins it earlier, in the submitter's own thread
             req.span = spans.begin("serve_request", rid=req.rid)
+        if self.slo is not None:
+            err = self.slo.check_admit(len(self.waiting))
+            if err is not None:
+                self._shed(req, err, queued=False)
+                raise err
         self.waiting.append(req)
         return req
+
+    def _shed(self, req: Request, err: ShedError, queued: bool) -> None:
+        """Reject a request (at submit) or drop it (expired in queue):
+        end its span with the shed outcome and journal the decision —
+        a named `serve_shed` beats a silent timeout."""
+        req.outcome = err.reason if err.reason == "deadline_expired" \
+            else "shed"
+        req.error = err
+        spans.end(req.span, outcome=req.outcome, reason=err.reason)
+        journal.emit("serve_shed", rid=req.rid, reason=err.reason,
+                     state=err.state,
+                     retry_after_s=round(err.retry_after_s, 3),
+                     queue_depth=len(self.waiting),
+                     waited_s=round(self._clock() - req.submit_ts, 6))
+        if queued and req.on_complete is not None:
+            # a queued-then-expired request still owes its caller an
+            # answer; submit-time rejects answer via the raised error
+            req.on_complete(req)
 
     def _complete(self, req: Request, completed: List[Request]) -> None:
         req.latency_s = self._clock() - req.submit_ts
         req.slot = None
+        req.outcome = "completed"
         COMPLETED.inc()
         REQ_SECONDS.observe(req.latency_s)
         if len(req.tokens) > 1:
@@ -146,7 +182,7 @@ class ContinuousBatcher:
                          (req.latency_s - req.ttft_s) * 1e3,
                          parent="serve_request", rid=req.rid,
                          steps=len(req.tokens) - 1)
-        spans.end(req.span, tokens=len(req.tokens))
+        spans.end(req.span, tokens=len(req.tokens), outcome="completed")
         journal.emit("serve_complete", rid=req.rid,
                      tokens=len(req.tokens),
                      ttft_s=round(req.ttft_s, 6),
@@ -160,6 +196,18 @@ class ContinuousBatcher:
         if not self.admit_mid_flight and self.active > 0:
             return
         for slot, r in enumerate(self.slots):
+            if self.slo is not None:
+                # drop expired waiters BEFORE spending a prefill on
+                # them: past its deadline a request can only steal
+                # decode steps from ones that could still make theirs
+                while self.waiting and \
+                        self.slo.expire(self.waiting[0].submit_ts):
+                    expired = self.waiting.popleft()
+                    self._shed(expired, ShedError(
+                        "deadline_expired",
+                        self.slo.retry_after_s(len(self.waiting)),
+                        state=self.slo.state), queued=True)
+                    completed.append(expired)
             if not self.waiting:
                 return
             if r is not None:
@@ -198,6 +246,11 @@ class ContinuousBatcher:
             ADMITTED.inc()
             TOKENS.inc()
             TTFT.observe(req.ttft_s)
+            if self.slo is not None:
+                # the measured TTFT/queue-wait of every admission IS
+                # the control signal — no separate sampling path
+                self.slo.observe_queue_wait(t_pre - req.submit_ts)
+                self.slo.observe_ttft(req.ttft_s)
             journal.emit("serve_admit", rid=req.rid, slot=slot,
                          prompt_len=n, bucket=info["bucket"],
                          prefix_len=req.prefix_len)
@@ -238,7 +291,7 @@ class ContinuousBatcher:
 def run_open_loop(batcher: ContinuousBatcher,
                   arrivals: Sequence[Tuple[float, Request]],
                   clock=time.perf_counter,
-                  sleep=time.sleep) -> List[Request]:
+                  sleep=None) -> List[Request]:
     """Drive the batcher under an open-loop arrival process.
 
     `arrivals` is [(offset_seconds, request)]: each request is submitted
@@ -246,14 +299,28 @@ def run_open_loop(batcher: ContinuousBatcher,
     the open-loop property), the batcher steps whenever there is live
     work, and the call returns when everything has completed. TTFT and
     per-request latency are measured from each request's actual submit
-    time, so queueing delay under load is included."""
+    time, so queueing delay under load is included.
+
+    With a fake clock (`slo.VirtualClock` or anything exposing
+    `sleep()`), idle gaps advance the clock instead of the wall —
+    no `time.sleep` in the hot loop, so overload benches and SLO tests
+    replay an arrival schedule deterministically on CPU CI. Requests a
+    bounded-queue batcher sheds at submit are returned too (their
+    `outcome`/`error` name the shed) — an open-loop driver must not
+    crash because the system under test protected itself."""
+    if sleep is None:
+        sleep = getattr(clock, "sleep", time.sleep)
     pend = deque(sorted(arrivals, key=lambda p: p[0]))
     completed: List[Request] = []
     t0 = clock()
     while pend or not batcher.idle:
         now = clock() - t0
         while pend and pend[0][0] <= now:
-            batcher.submit(pend.popleft()[1])
+            req = pend.popleft()[1]
+            try:
+                batcher.submit(req)
+            except ShedError:
+                completed.append(req)
         if batcher.idle and pend:
             delay = pend[0][0] - (clock() - t0)
             if delay > 0:

@@ -24,6 +24,7 @@ from ...observability import flight, httpd, metrics, spans
 from ...resilience import health
 from .engine import GenerationEngine
 from .scheduler import ContinuousBatcher, Request
+from .slo import AdmissionController, ShedError, SLOPolicy
 
 __all__ = ["InferenceServer", "ServeHandle"]
 
@@ -44,17 +45,24 @@ class ServeHandle:
         self._error = error
         self._event.set()
 
-    def _completed(self, _req) -> None:
-        self._finish()
+    def _completed(self, req) -> None:
+        # admission control answers through the same callback: a queued
+        # request whose deadline expired carries its ShedError
+        self._finish(getattr(req, "error", None))
 
     def done(self) -> bool:
         return self._event.is_set()
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
-        """Block for the generated tokens (raises on server failure)."""
+        """Block for the generated tokens. Raises ShedError (with
+        `retry_after_s`) when admission control rejected the request —
+        the replica is degraded but alive, retry later — and
+        RuntimeError when the serving loop actually failed."""
         if not self._event.wait(timeout):
             raise TimeoutError("request %d not complete within %ss"
                                % (self.request.rid, timeout))
+        if isinstance(self._error, ShedError):
+            raise self._error
         if self._error is not None:
             raise RuntimeError(
                 "serving loop failed while handling request %d"
@@ -74,9 +82,20 @@ class InferenceServer:
                  prefill_buckets: Sequence[int] = (32, 64, 128),
                  pad_id: int = 0, workers: int = 1,
                  poll_s: float = 0.002, http_port=None,
-                 kv_dtype: str = "float32", prefix_cache_bytes=None):
+                 kv_dtype: str = "float32", prefix_cache_bytes=None,
+                 slo=None):
         if workers < 1:
             raise ValueError("workers must be >= 1")
+        # SLO admission control: explicit SLOPolicy/AdmissionController,
+        # or PADDLE_TPU_SLO_TTFT_MS from the environment; absent both,
+        # None — submit/step behavior identical to a policy-free build.
+        # ONE controller is shared across all workers so the live p99
+        # and the admission state reflect the whole replica.
+        if slo is None:
+            slo = SLOPolicy.from_env()
+        if isinstance(slo, SLOPolicy):
+            slo = AdmissionController(slo)
+        self._slo: Optional[AdmissionController] = slo
         # each worker gets its OWN prefix cache (an engine's stored K/V
         # slices must never outlive into another engine's donation
         # lifecycle); kv_dtype="int8" halves each worker's cache bytes
@@ -130,14 +149,25 @@ class InferenceServer:
         dead = [t.name for t in self._threads if not t.is_alive()]
         if self._started and not self._stop.is_set() and dead:
             return False, "dead serving worker(s): %s" % ",".join(dead)
-        return True, "%d/%d workers alive" % (
+        detail = "%d/%d workers alive" % (
             sum(t.is_alive() for t in self._threads), len(self._threads))
+        if self._slo is not None and self._slo.state != "healthy":
+            # degraded-but-alive: shedding load is the replica WORKING,
+            # not dying — stay 200 (a 503 here would make the router
+            # drain exactly the replica that is protecting itself);
+            # the detail names the brownout so operators see it
+            detail += "; admission=%s (degraded, shedding load)" \
+                % self._slo.state
+        return True, detail
 
     def _http_status(self) -> dict:
-        return {"workers": len(self._threads),
-                "alive": sum(t.is_alive() for t in self._threads),
-                "queue_depth": self._queue.qsize(),
-                "stopping": self._stop.is_set()}
+        st = {"workers": len(self._threads),
+              "alive": sum(t.is_alive() for t in self._threads),
+              "queue_depth": self._queue.qsize(),
+              "stopping": self._stop.is_set()}
+        if self._slo is not None:
+            st["degraded"] = self._slo.state != "healthy"
+        return st
 
     def stop(self, timeout: float = 60.0) -> None:
         self._stop.set()
@@ -191,7 +221,7 @@ class InferenceServer:
             handle._finish(exc)
 
     def _loop(self, engine: GenerationEngine) -> None:
-        batcher = ContinuousBatcher(engine)
+        batcher = ContinuousBatcher(engine, slo=self._slo)
         try:
             while True:
                 self._drain_into(batcher)

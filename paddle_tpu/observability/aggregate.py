@@ -230,6 +230,24 @@ def _serving_fold(src: str, name: str, series: List[dict],
             t["sum"] += float(s.get("sum", 0.0))
 
 
+def _slo_fold(src: str, name: str, series: List[dict], acc: dict) -> None:
+    """Fold one snapshot's SLO-control-plane gauges (`pt_slo_*` and
+    `pt_admission_state`) into the slo block. Fleet reduction is MAX
+    per series: the fleet's admission state is its WORST rank's state
+    (one browned-out replica is a browned-out fleet as far as a router
+    is concerned), and the fleet p99 is the worst live p99 — summing
+    level readings would be meaningless."""
+    per_src = acc["per_source"].setdefault(src, {})
+    worst = acc["worst"]
+    for s in series:
+        if not isinstance(s.get("value"), (int, float)):
+            continue
+        key = _series_key(name, s.get("labels") or {})
+        val = float(s["value"])
+        per_src[key] = max(per_src.get(key, val), val)
+        worst[key] = max(worst.get(key, val), val)
+
+
 def _hbm_fold(src: str, name: str, series: List[dict], acc: dict) -> None:
     """Fold one snapshot's `pt_hbm_*` gauges into the hbm block: gauges
     are level readings, so ranks combine by MAX (the fleet high-water
@@ -259,12 +277,16 @@ def rollup_metrics(directory: str,
     (count, sum, mean) — so `ptdoctor summary` can show the fleet view
     without re-reading every snapshot. `pt_hbm_*` gauges fold into an
     `hbm` block (per-rank detail + max-across-ranks high_water) that
-    the launcher's fleet /statusz surfaces.
+    the launcher's fleet /statusz surfaces. `pt_slo_*` and
+    `pt_admission_state` gauges fold into an `slo` block (per-rank
+    detail + worst-across-ranks), so the fleet view names its most
+    degraded replica.
     """
     per_series: dict = {}
     hist_counts: dict = {}
     serving = {"per_source": {}, "totals": {}}
     hbm = {"per_source": {}, "high_water": {}}
+    slo = {"per_source": {}, "worst": {}}
     sources = []
     for path in _snapshot_files(directory):
         try:
@@ -283,6 +305,9 @@ def rollup_metrics(directory: str,
             if name.startswith("pt_hbm_"):
                 _hbm_fold(os.path.basename(path), name,
                           meta.get("series", []), hbm)
+            if name.startswith("pt_slo_") or name == "pt_admission_state":
+                _slo_fold(os.path.basename(path), name,
+                          meta.get("series", []), slo)
             for s in meta.get("series", []):
                 key = _series_key(name, s.get("labels") or {})
                 if "value" in s:
@@ -311,6 +336,8 @@ def rollup_metrics(directory: str,
         out["serving"] = serving
     if hbm["per_source"]:
         out["hbm"] = hbm
+    if slo["per_source"]:
+        out["slo"] = slo
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "w") as f:
         json.dump(out, f, indent=1)

@@ -293,6 +293,37 @@ def build_status() -> dict:
         serving["latency_ms"] = latency
     if serving:
         st["serving"] = serving
+    # SLO control plane (serving/slo.py): present only once an
+    # AdmissionController exists in-process — the budget gauge is its
+    # registration mark, so a policy-free build keeps /statusz
+    # byte-identical
+    budget = _scalar("pt_slo_ttft_budget_ms")
+    if budget:
+        slo: dict = {"ttft_budget_ms": budget}
+        state = _scalar("pt_admission_state") or 0
+        slo["state"] = {0: "healthy", 1: "shedding",
+                        2: "brownout"}.get(int(state), "?")
+        p99 = _scalar("pt_slo_ttft_p99_ms")
+        if p99 is not None:
+            slo["ttft_p99_ms"] = round(p99, 3)
+        shed_by_reason = _by_label("pt_serve_shed_total", "reason")
+        shed_total = sum(shed_by_reason.values())
+        slo["shed_total"] = int(shed_total)
+        if shed_by_reason:
+            slo["shed_by_reason"] = {
+                k: int(v) for k, v in sorted(shed_by_reason.items())}
+        admitted = _scalar("pt_serve_admitted_total") or 0
+        seen = admitted + shed_total
+        slo["shed_rate"] = round(shed_total / seen, 4) if seen else 0.0
+        expired = _scalar("pt_serve_deadline_expired_total")
+        if expired:
+            slo["deadline_expired"] = int(expired)
+        limit = _scalar("pt_slo_max_queue_depth")
+        depth = _scalar("pt_serve_queue_depth")
+        if limit:
+            slo["max_queue_depth"] = int(limit)
+            slo["queue_headroom"] = max(0, int(limit) - int(depth or 0))
+        st["slo"] = slo
     hbm: dict = {}
     for key, name in (("in_use", "pt_hbm_bytes_in_use"),
                       ("peak", "pt_hbm_peak_bytes")):
@@ -370,7 +401,7 @@ def fleet_status(fleet_dir: str, timeout_s: float = 2.0) -> dict:
 # ----------------------------------------------------------------- journal
 _SECRET = re.compile(
     r'(?i)("(?:[^"]*(?:token|secret|passw|credential|authorization|'
-    r'api_?key|access_key|private)[^"]*)"\s*:\s*)'
+    r'api_?key|access_key|private|bearer|cookie)[^"]*)"\s*:\s*)'
     r'("(?:[^"\\]|\\.)*"|[^,}\]\s]+)')
 
 

@@ -10,10 +10,13 @@ chrome://tracing and https://ui.perfetto.dev open directly:
     thread), named via metadata events;
   * every span as a complete ("X") slice — span journal events record
     their END timestamp plus ``dur_ms``, so slice start = ts - dur;
-  * ``serve_admit`` / ``serve_complete`` as instant events and a flow
-    arrow per request (id = rid) from the ``serve_request`` slice's
-    start to its completion — the submit-to-finish line SERVING.md
-    describes, drawn across threads.
+  * ``serve_admit`` / ``serve_complete`` / ``serve_shed`` as instant
+    events and a flow arrow per request (id = rid) from the
+    ``serve_request`` slice's start to its completion — the
+    submit-to-finish line SERVING.md describes, drawn across threads.
+    A shed request (``outcome`` of ``shed`` / ``deadline_expired``) is
+    an instant only: no slice body, no flow arrow — the arrows stay
+    reserved for traffic that actually served.
 
 Also home to the ONE trace-event serializer in the tree:
 ``trace_event()`` / ``dump_trace()`` are shared with
@@ -130,10 +133,12 @@ def build_trace(records: List[dict]) -> List[dict]:
               and isinstance(r.get("ts"), (int, float))]
     completes = [r for r in records if r.get("event") == "serve_complete"
                  and isinstance(r.get("ts"), (int, float))]
-    if not spans_ and not admits and not completes:
+    sheds = [r for r in records if r.get("event") == "serve_shed"
+             and isinstance(r.get("ts"), (int, float))]
+    if not spans_ and not admits and not completes and not sheds:
         return []
     starts = [r["ts"] - r["dur_ms"] / 1e3 for r in spans_]
-    starts += [r["ts"] for r in admits + completes]
+    starts += [r["ts"] for r in admits + completes + sheds]
     t0 = min(starts)
 
     def us(ts: float) -> float:
@@ -157,11 +162,21 @@ def build_trace(records: List[dict]) -> List[dict]:
                 args[key] = r[key]
         if isinstance(r.get("attrs"), dict):
             args.update(r["attrs"])
+        attrs = r.get("attrs") or {}
+        if name == "serve_request" and attrs.get("outcome") in (
+                "shed", "deadline_expired"):
+            # a shed request never produced a token: an instant at the
+            # shed point (no slice body, no flow arrow) keeps the lane
+            # readable — the arrows stay reserved for served traffic
+            events.append(trace_event(name, us(r["ts"]), pid=pid,
+                                      tid=tid, cat="serve", ph="i",
+                                      s="t", args=args or None))
+            continue
         events.append(trace_event(name, start_us, r["dur_ms"] * 1e3,
                                   pid=pid, tid=tid, cat=_cat_of(name),
                                   args=args or None))
         if name == "serve_request":
-            rid = (r.get("attrs") or {}).get("rid")
+            rid = attrs.get("rid")
             if rid is None:
                 continue
             # flow arrow: submit (slice start) -> completion
@@ -177,11 +192,12 @@ def build_trace(records: List[dict]) -> List[dict]:
             events.append(trace_event(
                 "serve_request", fin_us, pid=fin_pid, tid=fin_tid,
                 cat="serve", ph="f", bp="e", id=int(rid)))
-    for r in admits + completes:
+    for r in admits + completes + sheds:
         pid, tid = _rank_of(r), _tid_of(r)
         tracks[(pid, tid)] = None
         args = {k: r[k] for k in ("rid", "slot", "prefill_bucket",
-                                  "ttft_s", "latency_s", "tokens")
+                                  "ttft_s", "latency_s", "tokens",
+                                  "reason", "retry_after_s", "state")
                 if r.get(k) is not None}
         events.append(trace_event(str(r["event"]), us(r["ts"]), pid=pid,
                                   tid=tid, cat="serve", ph="i", s="t",

@@ -150,6 +150,25 @@ class TestServer:
         assert "abc" not in red and '"p"' not in red
         assert '"step": 3' in red
 
+    def test_redact_bearer_and_cookie(self):
+        line = json.dumps({"event": "cfg", "bearer": "b-sekrit",
+                           "Cookie": "sid=deadbeef",
+                           "session_cookie": "c-sekrit",
+                           "bearer_auth": "x-sekrit", "step": 7})
+        red = httpd.redact_line(line)
+        assert "b-sekrit" not in red and "deadbeef" not in red
+        assert "c-sekrit" not in red and "x-sekrit" not in red
+        assert red.count("[REDACTED]") == 4
+        assert '"step": 7' in red
+
+    def test_redact_negative_lookalikes(self):
+        # near-miss keys must survive verbatim: redaction is keyed on
+        # the KEY, and none of these contain a secret pattern
+        line = json.dumps({"event": "cfg", "barrier": "sync-1",
+                           "cook_time_s": 12, "bear": "animal",
+                           "lr": 0.1})
+        assert httpd.redact_line(line) == line
+
     def test_singleton_ensure_and_shutdown(self, plane, tmp_path):
         srv = httpd.ensure_server(port=0, endpoint_dir=str(tmp_path))
         assert srv is not None
@@ -359,9 +378,18 @@ def _write_fixture(d):
                                         "bucket": 8}},
         {"event": "span", "ts": 100.055, "dur_ms": 30.0,
          "name": "serve_request", "trace": "gold", "rank": 1, "tid": 2,
-         "attrs": {"rid": 7}},
+         "attrs": {"rid": 7, "outcome": "completed"}},
         {"event": "serve_complete", "ts": 100.055, "rank": 1, "tid": 3,
          "rid": 7, "ttft_s": 0.01, "latency_s": 0.03, "tokens": 5},
+        # a request shed by admission control: serve_shed instant plus a
+        # serve_request span with the shed outcome — rendered as an
+        # instant WITHOUT a flow arrow (arrows = served traffic only)
+        {"event": "serve_shed", "ts": 100.027, "rank": 1, "tid": 3,
+         "rid": 9, "reason": "queue_full", "retry_after_s": 0.25,
+         "state": "shedding", "queue_depth": 4},
+        {"event": "span", "ts": 100.027, "dur_ms": 0.05,
+         "name": "serve_request", "trace": "gold", "rank": 1, "tid": 3,
+         "attrs": {"rid": 9, "outcome": "shed", "reason": "queue_full"}},
     ]
     for name, recs in (("journal-rank0.jsonl", r0),
                        ("journal-rank1.jsonl", r1)):
@@ -384,10 +412,21 @@ class TestTraceview:
         names = {e["args"]["name"] for e in evs
                  if e["ph"] == "M" and e["name"] == "process_name"}
         assert names == {"rank 0", "rank 1"}
-        # flow arrow start/finish for the served request
+        # flow arrow start/finish for the served request — and ONLY the
+        # served one: the shed request (rid 9) must not grow arrows
         flows = [e for e in evs if e["ph"] in ("s", "f")]
         assert {e["ph"] for e in flows} == {"s", "f"}
         assert all(e["id"] == 7 for e in flows)
+        # the shed request renders as instants: the serve_shed journal
+        # marker plus the serve_request span demoted to ph="i"
+        shed_evs = [e for e in evs
+                    if (e.get("args") or {}).get("rid") == 9
+                    or (e.get("args") or {}).get("reason") == "queue_full"]
+        assert shed_evs and all(e["ph"] == "i" for e in shed_evs)
+        shed_span = [e for e in shed_evs if e["name"] == "serve_request"]
+        assert shed_span and shed_span[0]["args"]["outcome"] == "shed"
+        assert not any(e["name"] == "serve_shed" and e["ph"] != "i"
+                       for e in evs)
         # suffix-prefill admission: serve_suffix slice in the serve cat,
         # nested under prefill over the identical interval
         (sx,) = [e for e in evs if e["name"] == "serve_suffix"]

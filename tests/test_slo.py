@@ -1,0 +1,436 @@
+"""SLO control plane (paddle_tpu/inference/serving/slo.py — ROADMAP
+item 4, docs/SERVING.md "Admission control").
+
+The contracts under test:
+  * `WindowedPercentile` matches numpy's default linear interpolation
+    EXACTLY over the live window (count- and age-bounded eviction,
+    shed-heavy bimodal distributions included) and agrees with the
+    coarser Prometheus-style `hist_quantile` within one bucket width;
+  * the `AdmissionController` state machine walks
+    healthy -> shedding -> brownout on the live p99 and recovers with
+    hysteresis, shedding by the per-state queue rule;
+  * `ContinuousBatcher` enforces the policy at submit (bounded queue,
+    ShedError with retry_after_s > 0, `serve_shed` journal event) and
+    at admission (deadline-expired waiters dropped, their callbacks
+    answered);
+  * parity — slo=None keeps the queue unbounded and `serve_shed`
+    never fires;
+  * `VirtualClock` replays open-loop arrival schedules without wall
+    sleeps, and `InferenceServer` surfaces ShedError through
+    `ServeHandle.result()` while the loop stays alive.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import (AdmissionController,
+                                          ContinuousBatcher,
+                                          GenerationEngine,
+                                          InferenceServer, Request,
+                                          ShedError, SLOPolicy,
+                                          VirtualClock,
+                                          WindowedPercentile,
+                                          run_open_loop)
+from paddle_tpu.inference.serving import slo as slo_mod
+from paddle_tpu.observability import journal as journal_mod
+from paddle_tpu.observability import read_journal
+from paddle_tpu.observability.httpd import hist_quantile
+
+VOCAB = 64
+_CACHE = {}
+
+
+def _tiny():
+    if "model" not in _CACHE:
+        paddle.seed(0)
+        m = paddle.models.gpt_tiny(
+            vocab_size=VOCAB, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, max_position_embeddings=64)
+        m.eval()
+        _CACHE["model"] = m
+    return _CACHE["model"]
+
+
+def _shared_engine():
+    if "engine" not in _CACHE:
+        _CACHE["engine"] = GenerationEngine(
+            _tiny(), max_batch=2, max_seq_len=32, prefill_buckets=(8,))
+    return _CACHE["engine"]
+
+
+def _prompt(rs, n=4):
+    return rs.randint(0, VOCAB, (n,)).astype(np.int64)
+
+
+# ------------------------------------------------- WindowedPercentile
+class TestWindowedPercentile:
+    def test_matches_numpy_exactly(self):
+        rs = np.random.RandomState(0)
+        data = rs.gamma(2.0, 10.0, 200)
+        wp = WindowedPercentile(window=256)
+        for i, v in enumerate(data):
+            wp.observe(float(v), now=float(i))
+        for q in (0.0, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0):
+            assert wp.quantile(q) == pytest.approx(
+                float(np.quantile(data, q)), abs=1e-12)
+
+    def test_count_eviction_keeps_newest_window(self):
+        rs = np.random.RandomState(1)
+        data = rs.uniform(0, 100, 300)
+        wp = WindowedPercentile(window=64)
+        for i, v in enumerate(data):
+            wp.observe(float(v), now=float(i))
+        assert len(wp) == 64
+        tail = data[-64:]
+        for q in (0.5, 0.99):
+            assert wp.quantile(q) == pytest.approx(
+                float(np.quantile(tail, q)), abs=1e-12)
+
+    def test_age_eviction(self):
+        wp = WindowedPercentile(window=1000, max_age_s=10.0)
+        for t in range(20):                      # one sample per second
+            wp.observe(float(t), now=float(t))
+        # at now=19 the cutoff is 9.0: samples 0..8 evicted
+        assert len(wp) == 11
+        assert wp.quantile(0.0, now=19.0) == 9.0
+        # querying later with no new samples keeps evicting
+        assert wp.quantile(0.0, now=25.0) == 15.0
+        assert wp.quantile(1.0, now=40.0) is None
+
+    def test_bimodal_shed_heavy(self):
+        # the exact regime admission control lives in: most requests
+        # fast, a shed-heavy tail two orders of magnitude out
+        rs = np.random.RandomState(2)
+        fast = rs.normal(5e-3, 1e-3, 160)
+        slow = rs.normal(0.5, 0.05, 40)
+        data = np.concatenate([fast, slow])
+        rs.shuffle(data)
+        wp = WindowedPercentile(window=256)
+        for i, v in enumerate(data):
+            wp.observe(float(v), now=float(i))
+        for q in (0.5, 0.75, 0.9, 0.99):
+            assert wp.quantile(q) == pytest.approx(
+                float(np.quantile(data, q)), abs=1e-12)
+        assert wp.quantile(0.5) < 0.02      # bulk stays fast
+        assert wp.quantile(0.99) > 0.3      # tail is the shed signal
+
+    def test_agrees_with_hist_quantile_within_bucket(self):
+        # same samples through the window estimator and through
+        # Prometheus-style cumulative buckets: the coarse estimate must
+        # land within one bucket width of the exact one
+        rs = np.random.RandomState(3)
+        data = rs.gamma(2.0, 5.0, 500)
+        edges = [2.0 * i for i in range(1, 26)] + [float("inf")]
+        wp = WindowedPercentile(window=500)
+        for i, v in enumerate(data):
+            wp.observe(float(v), now=float(i))
+        cum = [(le, int(np.sum(data <= le))) for le in edges]
+        for q in (0.5, 0.9, 0.95):
+            exact = wp.quantile(q)
+            coarse = hist_quantile(cum, q)
+            assert coarse is not None
+            assert abs(coarse - exact) <= 2.0 + 1e-9
+
+    def test_edge_cases(self):
+        wp = WindowedPercentile(window=8)
+        assert wp.quantile(0.5) is None
+        assert wp.mean() is None
+        wp.observe(7.0, now=0.0)
+        assert wp.quantile(0.0) == wp.quantile(1.0) == 7.0
+        with pytest.raises(ValueError):
+            wp.quantile(1.5)
+        with pytest.raises(ValueError):
+            WindowedPercentile(window=0)
+
+
+# ------------------------------------------------------- VirtualClock
+class TestVirtualClock:
+    def test_call_sleep_advance(self):
+        clk = VirtualClock(start=5.0)
+        assert clk() == 5.0
+        clk.sleep(2.5)
+        assert clk() == 7.5
+        clk.sleep(-1.0)                  # negative sleep is a no-op
+        assert clk() == 7.5
+        clk.advance(0.5)
+        assert clk() == 8.0
+
+    def test_open_loop_without_wall_sleep(self):
+        # 5 arrivals spanning 2.5 VIRTUAL seconds replay in well under
+        # that on the wall: idle gaps advance the clock, not the host
+        rs = np.random.RandomState(4)
+        clk = VirtualClock()
+        b = ContinuousBatcher(_shared_engine(), clock=clk)
+        # warm the executables OUTSIDE the timed region — the wall
+        # bound below measures the loop, not XLA compile time
+        b.submit(Request(prompt=_prompt(rs), max_new_tokens=2))
+        b.run_until_idle()
+        arrivals = [(0.5 * i, Request(prompt=_prompt(rs),
+                                      max_new_tokens=2))
+                    for i in range(5)]
+        w0 = time.perf_counter()
+        done = run_open_loop(b, arrivals, clock=clk)
+        wall = time.perf_counter() - w0
+        assert len(done) == 5
+        assert all(r.outcome == "completed" for r in done)
+        assert clk() >= 2.0              # virtual time actually passed
+        assert wall < 2.0                # the wall did not
+
+
+# ----------------------------------------------- AdmissionController
+def _ctl(clk, budget_ms=100.0, **kw):
+    kw.setdefault("min_samples", 4)
+    kw.setdefault("max_queue_depth", 8)
+    return AdmissionController(
+        SLOPolicy(ttft_budget_ms=budget_ms, **kw), clock=clk)
+
+
+def _feed(ctl, ttft_s, n=1):
+    for _ in range(n):
+        ctl.observe_ttft(ttft_s)
+
+
+class TestAdmissionController:
+    def test_stays_healthy_below_min_samples(self):
+        clk = VirtualClock()
+        ctl = _ctl(clk, min_samples=4)
+        _feed(ctl, 10.0, n=3)            # breach, but too few samples
+        assert ctl.state == slo_mod.STATE_HEALTHY
+        _feed(ctl, 10.0)
+        assert ctl.state == slo_mod.STATE_BROWNOUT
+
+    def test_walk_up_and_recover_with_hysteresis(self):
+        clk = VirtualClock()
+        ctl = _ctl(clk, budget_ms=100.0, window=8)
+        _feed(ctl, 0.05, n=8)
+        assert ctl.state == slo_mod.STATE_HEALTHY
+        _feed(ctl, 0.15, n=8)            # p99 > budget
+        assert ctl.state == slo_mod.STATE_SHEDDING
+        _feed(ctl, 0.25, n=8)            # p99 > 2x budget
+        assert ctl.state == slo_mod.STATE_BROWNOUT
+        _feed(ctl, 0.15, n=8)            # back under 2x: step down
+        assert ctl.state == slo_mod.STATE_SHEDDING
+        # hysteresis: between recover_frac x budget and budget we HOLD
+        _feed(ctl, 0.09, n=8)
+        assert ctl.state == slo_mod.STATE_SHEDDING
+        _feed(ctl, 0.05, n=8)            # below 0.8x budget: recovered
+        assert ctl.state == slo_mod.STATE_HEALTHY
+
+    def test_check_admit_by_state(self):
+        clk = VirtualClock()
+        ctl = _ctl(clk, max_queue_depth=8)
+        # healthy: only a full queue sheds
+        assert ctl.check_admit(7) is None
+        err = ctl.check_admit(8)
+        assert err is not None and err.reason == "queue_full"
+        # shedding: effective bound halves
+        _feed(ctl, 0.15, n=8)
+        assert ctl.check_admit(3) is None
+        err = ctl.check_admit(4)
+        assert err is not None and err.reason == "slo_breach"
+        # brownout: only an empty queue admits
+        _feed(ctl, 0.25, n=8)
+        assert ctl.check_admit(0) is None
+        err = ctl.check_admit(1)
+        assert err is not None and err.reason == "brownout"
+        assert ctl.shed_counts["queue_full"] == 1
+        assert ctl.shed_counts["slo_breach"] == 1
+        assert ctl.shed_counts["brownout"] == 1
+
+    def test_retry_after_scales_with_queue(self):
+        clk = VirtualClock()
+        ctl = _ctl(clk)
+        assert ctl.retry_after_s(0) >= 0.01
+        _feed(ctl, 0.05, n=4)
+        assert ctl.retry_after_s(9) == pytest.approx(10 * 0.05, rel=0.01)
+        assert ctl.retry_after_s(19) > ctl.retry_after_s(3)
+
+    def test_expire_against_deadline(self):
+        clk = VirtualClock()
+        ctl = _ctl(clk, budget_ms=100.0)    # deadline defaults to 400ms
+        t0 = clk()
+        assert not ctl.expire(t0)
+        clk.advance(0.399)
+        assert not ctl.expire(t0)
+        clk.advance(0.002)
+        assert ctl.expire(t0)
+        assert ctl.shed_counts["deadline_expired"] == 1
+
+    def test_status_block(self):
+        clk = VirtualClock()
+        ctl = _ctl(clk, budget_ms=100.0, max_queue_depth=8)
+        _feed(ctl, 0.05, n=4)
+        assert ctl.check_admit(0) is None    # one admit, then one shed
+        ctl.check_admit(8)
+        st = ctl.status(queue_depth=3)
+        assert st["state"] == "healthy"
+        assert st["ttft_budget_ms"] == 100.0
+        assert st["ttft_p99_ms"] == pytest.approx(50.0)
+        assert st["shed_total"] == 1
+        assert st["shed_by_reason"] == {"queue_full": 1}
+        assert st["queue_depth"] == 3 and st["queue_headroom"] == 5
+        assert 0 < st["shed_rate"] < 1
+
+    def test_shed_metrics_counters(self):
+        clk = VirtualClock()
+        before = slo_mod.SHED.labels("queue_full").value
+        dl_before = slo_mod.DEADLINE_EXPIRED.value
+        ctl = _ctl(clk)
+        ctl.check_admit(8)
+        assert slo_mod.SHED.labels("queue_full").value == before + 1
+        ctl.expire(clk() - 1.0)
+        assert slo_mod.DEADLINE_EXPIRED.value == dl_before + 1
+
+
+# -------------------------------------------------- SLOPolicy.from_env
+class TestFromEnv:
+    def test_unset_means_off(self):
+        assert SLOPolicy.from_env(env={}) is None
+
+    def test_budget_knob(self):
+        pol = SLOPolicy.from_env(env={slo_mod.ENV_SLO_TTFT_MS: "250"})
+        assert pol is not None
+        assert pol.ttft_budget_ms == 250.0
+        assert pol.max_queue_depth == 64
+        assert pol.deadline_s == pytest.approx(1.0)
+
+    def test_queue_knob(self):
+        pol = SLOPolicy.from_env(env={slo_mod.ENV_SLO_TTFT_MS: "100",
+                                      slo_mod.ENV_MAX_QUEUE_DEPTH: "4"})
+        assert pol.max_queue_depth == 4
+
+    def test_invalid_values_stay_off(self):
+        assert SLOPolicy.from_env(
+            env={slo_mod.ENV_SLO_TTFT_MS: "banana"}) is None
+        assert SLOPolicy.from_env(
+            env={slo_mod.ENV_SLO_TTFT_MS: "-5"}) is None
+
+
+# ------------------------------------------------- batcher integration
+class TestBatcherShedding:
+    def test_bounded_queue_sheds_at_submit(self, tmp_path):
+        rs = np.random.RandomState(5)
+        j = journal_mod.RunJournal(str(tmp_path), filename="j.jsonl")
+        prev = journal_mod.set_journal(j)
+        try:
+            pol = SLOPolicy(ttft_budget_ms=1e6, max_queue_depth=2)
+            b = ContinuousBatcher(_shared_engine(), slo=pol)
+            admitted, shed = [], []
+            for _ in range(8):          # no step(): queue fills, then sheds
+                r = Request(prompt=_prompt(rs), max_new_tokens=2)
+                try:
+                    b.submit(r)
+                    admitted.append(r)
+                except ShedError as e:
+                    shed.append((r, e))
+            assert len(admitted) == 2 and len(shed) == 6
+            for r, e in shed:
+                assert e.reason == "queue_full"
+                assert e.retry_after_s > 0
+                assert r.outcome == "shed" and r.error is e
+            done = b.run_until_idle()
+            assert len(done) == 2       # every admitted request completes
+            assert all(r.outcome == "completed" for r in admitted)
+        finally:
+            journal_mod.set_journal(prev)
+            j.close()
+        evs = read_journal(str(tmp_path / "j.jsonl"))
+        sheds = [e for e in evs if e["event"] == "serve_shed"]
+        assert len(sheds) == 6
+        assert all(e["reason"] == "queue_full" and e["retry_after_s"] > 0
+                   for e in sheds)
+
+    def test_deadline_expiry_in_queue(self, tmp_path):
+        rs = np.random.RandomState(6)
+        j = journal_mod.RunJournal(str(tmp_path), filename="j.jsonl")
+        prev = journal_mod.set_journal(j)
+        answered = []
+        try:
+            clk = VirtualClock()
+            pol = SLOPolicy(ttft_budget_ms=100.0, deadline_ms=200.0,
+                            max_queue_depth=8)
+            b = ContinuousBatcher(_shared_engine(), clock=clk, slo=pol)
+            reqs = []
+            for _ in range(4):
+                r = Request(prompt=_prompt(rs), max_new_tokens=2)
+                r.on_complete = answered.append
+                reqs.append(b.submit(r))
+            clk.advance(0.5)            # every waiter is past its deadline
+            done = b.run_until_idle()
+            assert len(done) == 4
+            assert all(r.outcome == "deadline_expired" for r in reqs)
+            assert all(isinstance(r.error, ShedError) for r in reqs)
+            # queued-then-expired requests still answer their callers
+            assert len(answered) == 4
+        finally:
+            journal_mod.set_journal(prev)
+            j.close()
+        evs = read_journal(str(tmp_path / "j.jsonl"))
+        sheds = [e for e in evs if e["event"] == "serve_shed"]
+        assert len(sheds) == 4
+        assert all(e["reason"] == "deadline_expired" for e in sheds)
+        assert all(e["waited_s"] >= 0.5 for e in sheds)
+
+    def test_parity_no_policy_no_behavior_change(self, tmp_path):
+        rs = np.random.RandomState(7)
+        j = journal_mod.RunJournal(str(tmp_path), filename="j.jsonl")
+        prev = journal_mod.set_journal(j)
+        try:
+            b = ContinuousBatcher(_shared_engine())
+            assert b.slo is None
+            for _ in range(50):         # far past any default bound
+                b.submit(Request(prompt=_prompt(rs), max_new_tokens=1))
+            assert len(b.waiting) == 50
+            done = b.run_until_idle()
+            assert len(done) == 50
+            assert all(r.outcome == "completed" for r in done)
+        finally:
+            journal_mod.set_journal(prev)
+            j.close()
+        evs = read_journal(str(tmp_path / "j.jsonl"))
+        assert not [e for e in evs if e["event"] == "serve_shed"]
+
+    def test_virtual_clock_overload_deterministic(self):
+        # open-loop burst at t=0 against a 1-deep queue: the batcher
+        # sheds the overflow and still completes every admitted request
+        # — zero wall sleeps, fully replayable
+        rs = np.random.RandomState(8)
+        clk = VirtualClock()
+        pol = SLOPolicy(ttft_budget_ms=1e6, max_queue_depth=1)
+        b = ContinuousBatcher(_shared_engine(), clock=clk, slo=pol)
+        arrivals = [(0.0, Request(prompt=_prompt(rs), max_new_tokens=2))
+                    for _ in range(6)]
+        done = run_open_loop(b, arrivals, clock=clk)
+        assert len(done) == 6           # shed AND served both returned
+        outcomes = {r.outcome for r in done}
+        assert outcomes == {"completed", "shed"}
+        assert sum(r.outcome == "shed" for r in done) == 5
+
+
+# --------------------------------------------------- server integration
+class TestServerShedding:
+    def test_shed_error_through_handle(self):
+        pol = SLOPolicy(ttft_budget_ms=1e6, max_queue_depth=1)
+        srv = InferenceServer(_tiny(), max_batch=1, max_seq_len=32,
+                              prefill_buckets=(8,), workers=1,
+                              poll_s=0.001, slo=pol)
+        with srv:
+            handles = [srv.submit([1, 2, 3], max_new_tokens=8)
+                       for _ in range(12)]
+            results, sheds = [], []
+            for h in handles:
+                try:
+                    results.append(h.result(timeout=120))
+                except ShedError as e:
+                    sheds.append(e)
+            # the burst must overflow a 1-deep queue on a 1-slot engine
+            assert sheds, "no request was shed by the burst"
+            assert all(e.retry_after_s > 0 for e in sheds)
+            assert all(e.reason in ("queue_full", "deadline_expired")
+                       for e in sheds)
+            assert results, "no request completed during the burst"
+            # degraded is not dead: the loop still serves new traffic
+            again = srv.submit([1, 2, 3], max_new_tokens=2)
+            assert len(again.result(timeout=120)) == 2

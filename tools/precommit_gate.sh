@@ -650,6 +650,95 @@ EOF
     fi
 fi
 
+# Overload smoke (docs/SERVING.md "SLO admission control"): a burst
+# past max_queue_depth on a tiny single-slot engine must shed with a
+# positive retry_after_s while everything admitted completes in full,
+# the shed ledger must agree across all three surfaces (ShedError
+# count == serve_shed journal events == pt_serve_shed_total), the
+# replica must stay 200 on /healthz (degraded is not dead — a fresh
+# submit after the burst still serves), and shedding must leave ZERO
+# crash bundles behind.
+if [ "$rc" -eq 0 ]; then
+    OV_DIR="$(mktemp -d /tmp/pt_overload_smoke_XXXXXX)"
+    timeout -k 10 240 env JAX_PLATFORMS=cpu PT_OV_SMOKE_DIR="$OV_DIR" \
+        python - <<'EOF'
+import glob
+import json
+import os
+import urllib.request
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import InferenceServer, ShedError, SLOPolicy
+from paddle_tpu.inference.serving.slo import DEADLINE_EXPIRED, SHED
+from paddle_tpu.models import gpt_tiny
+from paddle_tpu.observability import flight
+from paddle_tpu.observability import journal as journal_mod
+
+d = os.environ["PT_OV_SMOKE_DIR"]
+flight.configure(d, rank=0)
+journal_mod.set_journal(journal_mod.RunJournal(d, rank=0))
+paddle.seed(0)
+m = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+             intermediate_size=64, max_position_embeddings=64)
+m.eval()
+rs = np.random.RandomState(0)
+# huge budget: only the queue bound actuates -> every shed is queue_full
+policy = SLOPolicy(ttft_budget_ms=1e6, max_queue_depth=1)
+with InferenceServer(m, max_batch=1, max_seq_len=64, prefill_buckets=(8,),
+                     slo=policy, http_port=0) as srv:
+    # warm: compile prefill+decode so the burst measures admission
+    srv.submit(rs.randint(0, 64, (4,)), max_new_tokens=2).result(timeout=120)
+    url = srv._http.url
+    handles = [srv.submit(rs.randint(0, 64, (4,)), max_new_tokens=8)
+               for _ in range(12)]
+    assert urllib.request.urlopen(url + "/healthz",
+                                  timeout=10).status == 200
+    done, shed = [], []
+    for h in handles:
+        try:
+            done.append(h.result(timeout=120))
+        except ShedError as e:
+            shed.append(e)
+    # degraded is not dead: a post-burst submit still serves, and the
+    # probe never flipped the replica to 503
+    tail = srv.submit(rs.randint(0, 64, (4,)),
+                      max_new_tokens=3).result(timeout=120)
+    assert urllib.request.urlopen(url + "/healthz",
+                                  timeout=10).status == 200
+assert shed, "burst past max_queue_depth shed nothing"
+assert done, "burst shed everything -- nothing served"
+assert all(e.retry_after_s > 0 for e in shed), \
+    [e.retry_after_s for e in shed]
+assert all(e.reason == "queue_full" for e in shed), \
+    sorted({e.reason for e in shed})
+assert all(len(t) == 8 for t in done), [len(t) for t in done]
+assert len(tail) == 3, len(tail)
+metric_sheds = int(sum(
+    SHED.labels(r).value
+    for r in ("queue_full", "slo_breach", "brownout", "deadline_expired")))
+journal_sheds = sum(
+    1
+    for p in glob.glob(os.path.join(d, "journal-*.jsonl"))
+    for line in open(p)
+    if json.loads(line).get("event") == "serve_shed")
+assert journal_sheds == len(shed) == metric_sheds, \
+    (journal_sheds, len(shed), metric_sheds)
+assert DEADLINE_EXPIRED.value == 0.0, DEADLINE_EXPIRED.value
+bundles = glob.glob(os.path.join(d, "crash", "*", "MANIFEST.json"))
+assert not bundles, bundles
+print("OVERLOAD_SMOKE=ok (%d served + %d shed of 12, retry_after>0, "
+      "journal==metrics==%d sheds, /healthz 200, 0 crash bundles)"
+      % (len(done), len(shed), metric_sheds))
+EOF
+    smoke_rc=$?
+    if [ "$smoke_rc" -ne 0 ]; then
+        echo "OVERLOAD_SMOKE=FAILED (rc=$smoke_rc, logs in $OV_DIR)"
+        rc=$smoke_rc
+    else
+        rm -rf "$OV_DIR"
+    fi
+fi
+
 # Megakernel smoke (docs/PERFORMANCE.md "Megakernels"): staggered
 # serving requests with the fused paged-decode kernel forced on in
 # interpret mode must (a) trace the paged_flash path and NEVER fall

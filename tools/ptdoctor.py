@@ -198,6 +198,78 @@ def _counter_total(agg, directory, name):
     return total if seen else None
 
 
+def _gauge_worst(agg, directory, name):
+    """MAX of a gauge across every metrics*.json snapshot (a level
+    reading: the fleet value is its worst rank's), or None."""
+    worst = None
+    for path in agg._snapshot_files(directory):
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        meta = (snap.get("metrics") or {}).get(name) \
+            if isinstance(snap, dict) else None
+        if not isinstance(meta, dict):
+            continue
+        for s in meta.get("series", []):
+            if isinstance(s.get("value"), (int, float)):
+                v = float(s["value"])
+                worst = v if worst is None else max(worst, v)
+    return worst
+
+
+_SLO_STATES = {0: "healthy", 1: "shedding", 2: "brownout"}
+
+
+def _slo_section(agg, directory, events) -> None:
+    """Print the slo block of `summary`: shed counters, live p99 vs
+    budget, and the overload verdict. Silent when no SLO policy ever
+    ran (the budget gauge is the controller's registration mark)."""
+    budget = _gauge_worst(agg, directory, "pt_slo_ttft_budget_ms")
+    shed_by = _counter_by_label(agg, directory,
+                                "pt_serve_shed_total", "reason")
+    shed_events = sum(1 for e in events if e.get("event") == "serve_shed")
+    if budget is None and not shed_by and not shed_events:
+        return
+    p99 = _gauge_worst(agg, directory, "pt_slo_ttft_p99_ms")
+    state = _gauge_worst(agg, directory, "pt_admission_state")
+    expired = _counter_total(agg, directory,
+                             "pt_serve_deadline_expired_total") or 0
+    shed_total = sum(shed_by.values()) or shed_events
+    crashes = len(_manifests(directory))
+    line = "  slo:"
+    if budget is not None:
+        line += " budget=%.0fms" % budget
+    if p99 is not None:
+        line += "  live_p99=%.1fms" % p99
+    if state is not None:
+        line += "  state=%s" % _SLO_STATES.get(int(state), "?")
+    line += "  shed=%d  deadline_expired=%d" % (int(shed_total),
+                                                int(expired))
+    print(line)
+    if shed_by:
+        print("    shed by reason: " + "  ".join(
+            "%s=%d" % (k, int(v)) for k, v in sorted(shed_by.items())))
+    # the overload verdict: collapsed (p99 blew the budget — shedding
+    # absent or insufficient), shed-and-held (load was rejected and the
+    # admitted traffic kept its SLO), or under-budget (never pressured)
+    if budget is not None and p99 is not None and p99 > budget:
+        verdict = "collapsed (live p99 %.1fms > budget %.0fms%s)" % (
+            p99, budget, "" if shed_total else ", no shedding configured")
+    elif shed_total:
+        verdict = "shed-and-held (%d shed, admitted traffic %s)" % (
+            int(shed_total),
+            "p99 %.1fms <= budget %.0fms" % (p99, budget)
+            if budget is not None and p99 is not None else "within SLO")
+    else:
+        verdict = "under-budget (no shedding needed)"
+    if crashes and shed_total:
+        verdict += " — but %d crash bundle(s): shed-never-crash VIOLATED" \
+            % crashes
+    print("    verdict: %s" % verdict)
+
+
 def cmd_summary(agg, directory) -> int:
     stats = {}
     events = agg.load_events(directory, stats=stats)
@@ -380,6 +452,10 @@ def cmd_summary(agg, directory) -> int:
                              (1e3 * ttft["sum"] / ttft["count"]))
             if parts:
                 print("    %s: %s" % (src, "  ".join(parts)))
+    # SLO control plane (serving/slo.py): shed counters + the live
+    # p99-vs-budget gauges reduce to an overload verdict — did the
+    # engine collapse, shed-and-hold, or never come under pressure?
+    _slo_section(agg, directory, events)
     # static-analysis findings recorded into this run dir (ptlint
     # --telemetry-dir, or emit_findings from a test harness)
     lint = _counter_by_label(agg, directory, "pt_lint_findings_total",
