@@ -12,9 +12,10 @@ into the control signal:
     the live p99 tracks the CURRENT overload, not the whole run).
   * `SLOPolicy` — the budget: fleet TTFT-p99 target, per-request
     deadline, and the queue bound. `SLOPolicy.from_env()` reads
-    PADDLE_TPU_SLO_TTFT_MS / PADDLE_TPU_MAX_QUEUE_DEPTH and returns
-    None when neither is set — the whole plane is off by default and
-    submit/step behavior stays byte-identical to a policy-free build.
+    PADDLE_TPU_SLO_TTFT_MS (+ optional PADDLE_TPU_MAX_QUEUE_DEPTH) and
+    returns None while the TTFT budget is unset — the whole plane is
+    off by default and submit/step behavior stays byte-identical to a
+    policy-free build.
   * `AdmissionController` — the healthy -> shedding -> brownout state
     machine. Decisions are enforced at `ContinuousBatcher.submit()`
     (bounded queue, reject with a computed `retry_after_s`) and at
@@ -30,7 +31,9 @@ steals those steps from requests that still could.
 from __future__ import annotations
 
 import os
+import threading
 import time
+import warnings
 from collections import deque
 from dataclasses import dataclass
 from typing import Optional
@@ -123,6 +126,14 @@ class WindowedPercentile:
     (`numpy.quantile(window, q)`) exactly over the live window; windows
     are control-loop sized (hundreds), so the sort-per-query cost is
     noise next to a prefill dispatch.
+
+    Thread-safe: the server shares one AdmissionController across all
+    worker threads, so observe() (append/popleft) and quantile()/mean()
+    (iteration) race on the same deque — concurrent mutation during
+    iteration raises RuntimeError and would kill a worker loop. A
+    single lock around every touch of `_samples` keeps the window
+    consistent; contention is one dict-sized critical section per
+    request, invisible next to a prefill.
     """
 
     def __init__(self, window: int = 256,
@@ -132,16 +143,20 @@ class WindowedPercentile:
         self.window = int(window)
         self.max_age_s = max_age_s
         self._samples: deque = deque()     # (ts, value), oldest first
+        self._lock = threading.Lock()
 
     def __len__(self) -> int:
-        return len(self._samples)
+        with self._lock:
+            return len(self._samples)
 
     def observe(self, value: float, now: Optional[float] = None) -> None:
         ts = time.perf_counter() if now is None else float(now)
-        self._samples.append((ts, float(value)))
-        self._evict(ts)
+        with self._lock:
+            self._samples.append((ts, float(value)))
+            self._evict_locked(ts)
 
-    def _evict(self, now: float) -> None:
+    def _evict_locked(self, now: float) -> None:
+        # caller holds self._lock
         while len(self._samples) > self.window:
             self._samples.popleft()
         if self.max_age_s is not None:
@@ -155,11 +170,12 @@ class WindowedPercentile:
         default method), or None while the window is empty."""
         if not 0.0 <= q <= 1.0:
             raise ValueError("q must be in [0, 1]")
-        if now is not None:
-            self._evict(float(now))
-        if not self._samples:
-            return None
-        vs = sorted(v for _, v in self._samples)
+        with self._lock:
+            if now is not None:
+                self._evict_locked(float(now))
+            if not self._samples:
+                return None
+            vs = sorted(v for _, v in self._samples)
         if len(vs) == 1:
             return vs[0]
         pos = q * (len(vs) - 1)
@@ -169,9 +185,10 @@ class WindowedPercentile:
         return vs[lo] + frac * (vs[hi] - vs[lo])
 
     def mean(self) -> Optional[float]:
-        if not self._samples:
-            return None
-        return sum(v for _, v in self._samples) / len(self._samples)
+        with self._lock:
+            if not self._samples:
+                return None
+            return sum(v for _, v in self._samples) / len(self._samples)
 
 
 @dataclass(frozen=True)
@@ -211,16 +228,27 @@ class SLOPolicy:
     @classmethod
     def from_env(cls, env=os.environ) -> Optional["SLOPolicy"]:
         """Policy from PADDLE_TPU_SLO_TTFT_MS (+ optional
-        PADDLE_TPU_MAX_QUEUE_DEPTH), or None when unset — the parity
-        contract: no knob, no policy, no behavior change."""
+        PADDLE_TPU_MAX_QUEUE_DEPTH), or None when the TTFT budget is
+        unset — the parity contract: no budget knob, no policy, no
+        behavior change (queue depth alone never activates a policy).
+
+        A set-but-unparsable value is an operator typo, and silently
+        returning None would disable overload protection with no
+        signal — so it warns loudly instead."""
         raw = env.get(ENV_SLO_TTFT_MS, "").strip()
         if not raw:
             return None
         try:
             budget = float(raw)
         except ValueError:
+            warnings.warn(
+                "%s=%r is not a number; SLO admission control DISABLED"
+                % (ENV_SLO_TTFT_MS, raw), RuntimeWarning, stacklevel=2)
             return None
         if budget <= 0:
+            warnings.warn(
+                "%s=%r must be > 0; SLO admission control DISABLED"
+                % (ENV_SLO_TTFT_MS, raw), RuntimeWarning, stacklevel=2)
             return None
         kw = {}
         raw_q = env.get(ENV_MAX_QUEUE_DEPTH, "").strip()
@@ -228,7 +256,10 @@ class SLOPolicy:
             try:
                 kw["max_queue_depth"] = max(1, int(raw_q))
             except ValueError:
-                pass
+                warnings.warn(
+                    "%s=%r is not an integer; using default queue depth"
+                    % (ENV_MAX_QUEUE_DEPTH, raw_q),
+                    RuntimeWarning, stacklevel=2)
         return cls(ttft_budget_ms=budget, **kw)
 
 
@@ -250,11 +281,18 @@ class AdmissionController:
     backlog drain time (queued x windowed mean TTFT, floored at 10ms),
     so callers back off proportionally to the actual congestion.
 
-    Thread-safety: decisions and observations happen on the scheduler's
-    own thread (submit and _admit are batcher calls); the server shares
-    one controller across workers, and the worst-case race is one
-    request shed or admitted a step late — acceptable for a control
-    loop, and lock-free on the hot path.
+    Thread-safety: the server shares ONE controller across all worker
+    threads. The sample windows are internally locked (see
+    `WindowedPercentile`), so concurrent observe/quantile calls are
+    safe. The state machine and shed counters themselves are updated
+    without a lock: a torn read there costs at most one request shed or
+    admitted a step late — acceptable for a control loop — whereas a
+    torn window iteration would raise and kill a worker.
+
+    Note `check_admit` takes the CALLER's queue depth: each batcher
+    passes its own `len(waiting)`, so with `workers` > 1 the bound is
+    per-worker and the replica-wide backlog cap is
+    `workers x max_queue_depth` (documented in SERVING.md).
     """
 
     def __init__(self, policy: SLOPolicy, clock=time.perf_counter):

@@ -144,6 +144,50 @@ class TestWindowedPercentile:
         with pytest.raises(ValueError):
             WindowedPercentile(window=0)
 
+    def test_concurrent_observe_and_quantile(self):
+        # the server shares one AdmissionController across worker
+        # threads: observe() mutates the deque while quantile()/mean()
+        # iterate it. Unsynchronized, CPython raises "deque mutated
+        # during iteration", which would escape a worker loop and kill
+        # the thread — the exact shed-never-crash regime this guards.
+        import threading
+
+        wp = WindowedPercentile(window=64, max_age_s=0.05)
+        errors = []
+        stop = threading.Event()
+
+        def writer():
+            t = 0.0
+            while not stop.is_set():
+                try:
+                    wp.observe(t % 1.0, now=t)
+                except Exception as e:       # pragma: no cover
+                    errors.append(e)
+                    return
+                t += 0.001
+
+        def reader():
+            t = 0.0
+            while not stop.is_set():
+                try:
+                    wp.quantile(0.99, now=t)
+                    wp.mean()
+                    len(wp)
+                except Exception as e:       # pragma: no cover
+                    errors.append(e)
+                    return
+                t += 0.001
+
+        threads = [threading.Thread(target=writer) for _ in range(2)] \
+            + [threading.Thread(target=reader) for _ in range(2)]
+        for th in threads:
+            th.start()
+        time.sleep(0.5)
+        stop.set()
+        for th in threads:
+            th.join(timeout=5.0)
+        assert not errors, errors
+
 
 # ------------------------------------------------------- VirtualClock
 class TestVirtualClock:
@@ -301,11 +345,23 @@ class TestFromEnv:
                                       slo_mod.ENV_MAX_QUEUE_DEPTH: "4"})
         assert pol.max_queue_depth == 4
 
-    def test_invalid_values_stay_off(self):
-        assert SLOPolicy.from_env(
-            env={slo_mod.ENV_SLO_TTFT_MS: "banana"}) is None
-        assert SLOPolicy.from_env(
-            env={slo_mod.ENV_SLO_TTFT_MS: "-5"}) is None
+    def test_invalid_values_stay_off_but_warn(self):
+        # a typo'd knob disables overload protection — that must be
+        # loud, not silent
+        with pytest.warns(RuntimeWarning, match="DISABLED"):
+            assert SLOPolicy.from_env(
+                env={slo_mod.ENV_SLO_TTFT_MS: "banana"}) is None
+        with pytest.warns(RuntimeWarning, match="DISABLED"):
+            assert SLOPolicy.from_env(
+                env={slo_mod.ENV_SLO_TTFT_MS: "-5"}) is None
+
+    def test_invalid_queue_depth_warns_keeps_default(self):
+        with pytest.warns(RuntimeWarning, match="default queue depth"):
+            pol = SLOPolicy.from_env(
+                env={slo_mod.ENV_SLO_TTFT_MS: "100",
+                     slo_mod.ENV_MAX_QUEUE_DEPTH: "many"})
+        assert pol is not None
+        assert pol.max_queue_depth == 64
 
 
 # ------------------------------------------------- batcher integration
